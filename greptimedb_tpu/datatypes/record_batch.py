@@ -1,0 +1,140 @@
+"""RecordBatch: a schema + equal-length vectors.
+
+Reference behavior: src/common/recordbatch/src/ — the unit of data flowing
+between scan, compute and protocol layers. Interops with pyarrow for
+Parquet/Flight/IPC, and exposes the SoA numpy view the device path consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .schema import Schema
+from .vector import Vector
+
+
+class RecordBatch:
+    def __init__(self, schema: Schema, columns: Sequence[Vector]):
+        assert len(schema) == len(columns), \
+            f"schema has {len(schema)} cols, got {len(columns)} vectors"
+        lens = {len(c) for c in columns}
+        assert len(lens) <= 1, f"ragged columns: {lens}"
+        self.schema = schema
+        self.columns: List[Vector] = list(columns)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, idx_or_name) -> Vector:
+        if isinstance(idx_or_name, str):
+            return self.columns[self.schema.column_index(idx_or_name)]
+        return self.columns[idx_or_name]
+
+    # ---- constructors ----
+    @staticmethod
+    def from_pydict(schema: Schema, data: Dict[str, Sequence[Any]]) -> "RecordBatch":
+        cols = []
+        for c in schema.column_schemas:
+            cols.append(Vector.from_pylist(list(data[c.name]), c.dtype))
+        return RecordBatch(schema, cols)
+
+    @staticmethod
+    def empty(schema: Schema) -> "RecordBatch":
+        return RecordBatch(schema, [Vector.from_pylist([], c.dtype)
+                                    for c in schema.column_schemas])
+
+    @staticmethod
+    def from_arrow(batch: pa.RecordBatch | pa.Table,
+                   schema: Optional[Schema] = None) -> "RecordBatch":
+        if schema is None:
+            schema = Schema.from_arrow(batch.schema)
+        cols = [Vector.from_arrow(batch.column(i)) for i in range(batch.num_columns)]
+        return RecordBatch(schema, cols)
+
+    # ---- conversions ----
+    def to_arrow(self) -> pa.RecordBatch:
+        return pa.RecordBatch.from_arrays(
+            [c.to_arrow() for c in self.columns], schema=self.schema.to_arrow())
+
+    def to_pydict(self) -> Dict[str, list]:
+        return {c.name: v.to_pylist()
+                for c, v in zip(self.schema.column_schemas, self.columns)}
+
+    def to_pylist(self) -> List[dict]:
+        cols = self.to_pydict()
+        names = self.schema.names()
+        return [dict(zip(names, row)) for row in zip(*[cols[n] for n in names])]
+
+    def rows(self) -> Iterable[tuple]:
+        lists = [c.to_pylist() for c in self.columns]
+        return zip(*lists) if lists else iter(())
+
+    # ---- ops ----
+    def project(self, names: Sequence[str]) -> "RecordBatch":
+        idxs = [self.schema.column_index(n) for n in names]
+        return RecordBatch(self.schema.project(names), [self.columns[i] for i in idxs])
+
+    def slice(self, start: int, length: int) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.slice(start, length) for c in self.columns])
+
+    def filter(self, mask: np.ndarray) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.filter(mask) for c in self.columns])
+
+    def take(self, indices: np.ndarray) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.take(indices) for c in self.columns])
+
+    @staticmethod
+    def concat(batches: Sequence["RecordBatch"]) -> "RecordBatch":
+        assert batches, "cannot concat zero batches"
+        if len(batches) == 1:
+            return batches[0]
+        schema = batches[0].schema
+        cols = [Vector.concat([b.columns[i] for b in batches])
+                for i in range(len(schema))]
+        return RecordBatch(schema, cols)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"RecordBatch[{self.num_rows}x{self.num_columns}]"
+
+
+def pretty_print(batches: Sequence[RecordBatch]) -> str:
+    """Render batches as an ASCII table (for CLI / sqlness-style tests)."""
+    if not batches:
+        return "(empty)"
+    schema = batches[0].schema
+    names = schema.names()
+    rows: List[List[str]] = []
+    for b in batches:
+        for row in b.rows():
+            rows.append(["NULL" if v is None else _fmt(v, schema.column_schemas[i])
+                         for i, v in enumerate(row)])
+    widths = [len(n) for n in names]
+    for r in rows:
+        for i, v in enumerate(r):
+            widths[i] = max(widths[i], len(v))
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep, "|" + "|".join(f" {n:<{w}} " for n, w in zip(names, widths)) + "|", sep]
+    for r in rows:
+        out.append("|" + "|".join(f" {v:<{w}} " for v, w in zip(r, widths)) + "|")
+    out.append(sep)
+    return "\n".join(out)
+
+
+def _fmt(v: Any, col) -> str:
+    if col.dtype.is_timestamp:
+        from ..common.time import Timestamp
+        return Timestamp(v, col.dtype.time_unit).to_datetime().strftime(
+            "%Y-%m-%dT%H:%M:%S.%f")[:-3]
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)

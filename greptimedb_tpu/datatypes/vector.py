@@ -1,0 +1,215 @@
+"""Columnar vectors: host SoA arrays with Arrow interop.
+
+Reference behavior: src/datatypes/src/vectors/ — a `Vector` is a typed,
+nullable column. The TPU-first design keeps the canonical host representation
+as numpy arrays (object arrays for strings) plus an optional validity bitmap,
+so columns move to the device with zero reshaping; Arrow is the interchange
+format (Parquet, Flight, IPC/WAL payloads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from . import data_type as dt
+from .data_type import ConcreteDataType, from_arrow_type
+
+
+class Vector:
+    """A typed nullable column.
+
+    data: np.ndarray — for String/Binary this is an object array; for
+          timestamps an int64 array of ticks in the type's unit.
+    validity: optional boolean np.ndarray, True = valid. None = all valid.
+    """
+
+    __slots__ = ("dtype", "data", "validity")
+
+    def __init__(self, dtype: ConcreteDataType, data: np.ndarray,
+                 validity: Optional[np.ndarray] = None):
+        self.dtype = dtype
+        self.data = data
+        if validity is not None and validity.all():
+            validity = None
+        self.validity = validity
+
+    # ---- constructors ----
+    @staticmethod
+    def from_pylist(values: Sequence[Any], dtype: ConcreteDataType) -> "Vector":
+        n = len(values)
+        validity = np.ones(n, dtype=bool)
+        if dtype.is_string or dtype.is_binary:
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                if v is None:
+                    validity[i] = False
+                    data[i] = dtype.default_value()
+                else:
+                    data[i] = dtype.cast_value(v)
+        else:
+            np_dtype = dtype.np_dtype
+            data = np.zeros(n, dtype=np_dtype)
+            for i, v in enumerate(values):
+                if v is None:
+                    validity[i] = False
+                else:
+                    data[i] = dtype.cast_value(v)
+        return Vector(dtype, data, None if validity.all() else validity)
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, dtype: ConcreteDataType,
+                   validity: Optional[np.ndarray] = None) -> "Vector":
+        if not (dtype.is_string or dtype.is_binary):
+            arr = np.ascontiguousarray(arr, dtype=dtype.np_dtype)
+        return Vector(dtype, arr, validity)
+
+    @staticmethod
+    def constant(value: Any, n: int, dtype: ConcreteDataType) -> "Vector":
+        if value is None:
+            return Vector.nulls(n, dtype)
+        v = dtype.cast_value(value)
+        if dtype.is_string or dtype.is_binary:
+            data = np.empty(n, dtype=object)
+            data[:] = v
+        else:
+            data = np.full(n, v, dtype=dtype.np_dtype)
+        return Vector(dtype, data)
+
+    @staticmethod
+    def nulls(n: int, dtype: ConcreteDataType) -> "Vector":
+        if dtype.is_string or dtype.is_binary:
+            data = np.empty(n, dtype=object)
+            data[:] = dtype.default_value()
+        else:
+            data = np.zeros(n, dtype=dtype.np_dtype)
+        return Vector(dtype, data, np.zeros(n, dtype=bool))
+
+    @staticmethod
+    def from_arrow(arr: pa.Array | pa.ChunkedArray) -> "Vector":
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        dtype = from_arrow_type(arr.type)
+        n = len(arr)
+        validity = None
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+        if dtype.is_string or dtype.is_binary:
+            data = np.empty(n, dtype=object)
+            pylist = arr.to_pylist()
+            default = dtype.default_value()
+            for i, v in enumerate(pylist):
+                data[i] = default if v is None else v
+        elif dtype.is_timestamp:
+            data = np.asarray(arr.cast(pa.int64()).fill_null(0), dtype=np.int64)
+        elif dtype is dt.DATE:
+            data = np.asarray(arr.cast(pa.int32()).fill_null(0), dtype=np.int32)
+        else:
+            if arr.null_count:
+                arr = arr.fill_null(dtype.default_value())
+            data = np.asarray(arr)
+            if dtype.np_dtype is not None:
+                data = data.astype(dtype.np_dtype, copy=False)
+        return Vector(dtype, data, validity)
+
+    # ---- conversions ----
+    def to_arrow(self) -> pa.Array:
+        mask = None if self.validity is None else ~self.validity
+        if self.dtype.is_string or self.dtype.is_binary:
+            vals = list(self.data)
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            return pa.array(vals, type=self.dtype.pa_type)
+        if self.dtype.is_timestamp:
+            base = pa.array(self.data.astype(np.int64), mask=mask)
+            return base.cast(self.dtype.pa_type)
+        if self.dtype is dt.DATE:
+            base = pa.array(self.data.astype(np.int32), mask=mask)
+            return base.cast(self.dtype.pa_type)
+        return pa.array(self.data, type=self.dtype.pa_type, mask=mask)
+
+    def to_pylist(self) -> list:
+        if self.validity is None:
+            if self.dtype.is_boolean:
+                return [bool(v) for v in self.data]
+            return [v.item() if isinstance(v, np.generic) else v for v in self.data]
+        out = []
+        for v, ok in zip(self.data, self.validity):
+            if not ok:
+                out.append(None)
+            elif isinstance(v, np.generic):
+                out.append(v.item())
+            else:
+                out.append(v)
+        return out
+
+    # ---- access / ops ----
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def get(self, i: int) -> Any:
+        if self.validity is not None and not self.validity[i]:
+            return None
+        v = self.data[i]
+        return v.item() if isinstance(v, np.generic) else v
+
+    def is_null(self, i: int) -> bool:
+        return self.validity is not None and not bool(self.validity[i])
+
+    @property
+    def null_count(self) -> int:
+        return 0 if self.validity is None else int((~self.validity).sum())
+
+    def slice(self, start: int, length: int) -> "Vector":
+        v = None if self.validity is None else self.validity[start:start + length]
+        return Vector(self.dtype, self.data[start:start + length], v)
+
+    def take(self, indices: np.ndarray) -> "Vector":
+        v = None if self.validity is None else self.validity[indices]
+        return Vector(self.dtype, self.data[indices], v)
+
+    def filter(self, mask: np.ndarray) -> "Vector":
+        v = None if self.validity is None else self.validity[mask]
+        return Vector(self.dtype, self.data[mask], v)
+
+    def cast(self, target: ConcreteDataType) -> "Vector":
+        if target == self.dtype:
+            return self
+        if target.is_string:
+            data = np.empty(len(self), dtype=object)
+            for i, v in enumerate(self.to_pylist()):
+                data[i] = "" if v is None else str(v)
+            return Vector(target, data, self.validity)
+        if self.dtype.is_string or self.dtype.is_binary:
+            return Vector.from_pylist(
+                [None if v is None else target.cast_value(v) for v in self.to_pylist()],
+                target)
+        if self.dtype.is_timestamp and target.is_timestamp:
+            sf, tf = self.dtype.time_unit.factor, target.time_unit.factor
+            if tf >= sf:
+                data = self.data * (tf // sf)
+            else:
+                data = self.data // (sf // tf)
+            return Vector(target, data.astype(np.int64), self.validity)
+        return Vector(target, self.data.astype(target.np_dtype), self.validity)
+
+    @staticmethod
+    def concat(vectors: Iterable["Vector"]) -> "Vector":
+        vs = list(vectors)
+        assert vs, "cannot concat zero vectors"
+        dtype = vs[0].dtype
+        data = np.concatenate([v.data for v in vs])
+        if any(v.validity is not None for v in vs):
+            validity = np.concatenate([
+                v.validity if v.validity is not None else np.ones(len(v), dtype=bool)
+                for v in vs])
+        else:
+            validity = None
+        return Vector(dtype, data, validity)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Vector<{self.dtype.name}>[{len(self)}]"

@@ -1,0 +1,80 @@
+"""Host-side dictionary encoding for tag columns.
+
+TPUs (and XLA generally) are hostile to string processing and dynamic hash
+tables, so tag values are dictionary-encoded to dense int32 ids on the host
+before touching the device. This mirrors the reference's observation that
+high-cardinality group-by needs a dictionary/sort strategy rather than a hash
+table (SURVEY.md §7 'hard parts'); the reference's row keys live in
+src/storage/src/memtable/btree.rs — here the key space is a per-region
+insertion-ordered dictionary, which is stable across flushes so SSTs and
+memtables agree on ids.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Dictionary:
+    """Insertion-ordered value <-> dense id mapping."""
+
+    __slots__ = ("_value_to_id", "_values")
+
+    def __init__(self, values: Optional[Iterable[Hashable]] = None):
+        self._value_to_id: Dict[Hashable, int] = {}
+        self._values: List[Hashable] = []
+        if values is not None:
+            for v in values:
+                self.get_or_insert(v)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def get_or_insert(self, value: Hashable) -> int:
+        i = self._value_to_id.get(value)
+        if i is None:
+            i = len(self._values)
+            self._value_to_id[value] = i
+            self._values.append(value)
+        return i
+
+    def get(self, value: Hashable) -> Optional[int]:
+        return self._value_to_id.get(value)
+
+    def value(self, i: int) -> Hashable:
+        return self._values[i]
+
+    def values(self) -> List[Hashable]:
+        return list(self._values)
+
+    def encode(self, values: Sequence[Hashable]) -> np.ndarray:
+        """Encode values to int32 ids, inserting unseen values."""
+        out = np.empty(len(values), dtype=np.int32)
+        get = self._value_to_id.get
+        for i, v in enumerate(values):
+            j = get(v)
+            if j is None:
+                j = self.get_or_insert(v)
+            out[i] = j
+        return out
+
+    def encode_existing(self, values: Sequence[Hashable]) -> np.ndarray:
+        """Encode without inserting; unseen values map to -1."""
+        out = np.empty(len(values), dtype=np.int32)
+        get = self._value_to_id.get
+        for i, v in enumerate(values):
+            out[i] = get(v, -1)
+        return out
+
+    def decode(self, ids: np.ndarray) -> List[Hashable]:
+        vals = self._values
+        return [vals[int(i)] for i in ids]
+
+    def to_list(self) -> List[Hashable]:
+        return list(self._values)
+
+    @staticmethod
+    def from_list(values: List[Hashable]) -> "Dictionary":
+        return Dictionary(values)

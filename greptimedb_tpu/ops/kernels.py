@@ -1,0 +1,258 @@
+"""Core TPU kernels: segment-reduce group-by and sort-based merge/dedup.
+
+These are the hot loops of the database. In the reference they are:
+- DataFusion's hash aggregate (src/query executes via DataFusion) → here a
+  dictionary-encoded **segment reduce** (`jax.ops.segment_sum/min/max`) over
+  dense group ids, which XLA lowers to efficient scatter-adds and which
+  composes with time-bucketing by id arithmetic (gid = tag_id * nbuckets + b).
+- The k-way MergeReader + DedupReader (src/storage/src/read/{merge,dedup}.rs,
+  ~1.2k lines of comparison-driven CPU code) → here a **sort-based merge**:
+  concatenate runs, `lexsort` by (series, ts, seq), and a vectorized keep-mask
+  (last sequence per (series, ts) wins, DELETEs drop the key) — the pragmatic
+  TPU answer from SURVEY.md §7.
+
+Everything is static-shaped: batches are padded to shape buckets (powers of
+two) with a validity mask so XLA compiles once per bucket, not per batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# op_type values in the storage engine (mirrors reference OpType:
+# src/store-api/src/storage/requests.rs — Put/Delete).
+OP_PUT = 0
+OP_DELETE = 1
+
+AGG_OPS = ("sum", "count", "avg", "min", "max", "first", "last",
+           "stddev", "variance")
+
+
+def shape_bucket(n: int, minimum: int = 1024) -> int:
+    """Round n up to a power of two (>= minimum) to bound recompilations."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+def pad_axis0(arr: np.ndarray, target: int, fill=0) -> np.ndarray:
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    pad = np.full((target - n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Grouped aggregation
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "ops", "has_col_masks"))
+def grouped_aggregate(
+    gids: jax.Array,            # int32 [N] group id per row (invalid rows: any)
+    mask: jax.Array,            # bool  [N] row validity (filter & padding)
+    ts: jax.Array,              # int64/int32 [N] timestamps (for first/last)
+    values: Tuple[jax.Array, ...],   # per-agg value column [N]
+    col_masks: Tuple[jax.Array, ...] = (),  # per-agg column validity [N]
+    *,
+    num_groups: int,
+    ops: Tuple[str, ...],
+    has_col_masks: bool = False,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """Fused masked group-by aggregation.
+
+    `mask` is the row-level filter (predicates & padding); `col_masks`, when
+    provided, add per-aggregation column validity (SQL null semantics: a null
+    in one column must not hide the row from other aggregates).
+
+    Returns (per-op result arrays [num_groups], group row-count [num_groups]).
+    Empty groups yield 0 for sum/count and NaN for avg/min/max/first/last;
+    callers null them out via the returned counts.
+    """
+    n = gids.shape[0]
+    # Route masked-out rows to a scratch group so they never pollute results.
+    safe_gids = jnp.where(mask, gids, num_groups)
+    seg = num_groups + 1
+    counts_all = jax.ops.segment_sum(mask.astype(jnp.int32), safe_gids,
+                                     num_segments=seg)
+    counts = counts_all[:num_groups]
+
+    def agg_mask(i):
+        if has_col_masks:
+            return mask & col_masks[i]
+        return mask
+
+    results = []
+    cache: Dict[Tuple[str, int], jax.Array] = {}
+
+    def seg_sum(col, key, m):
+        k = ("sum", key)
+        if k not in cache:
+            cache[k] = jax.ops.segment_sum(
+                jnp.where(m, col, 0).astype(col.dtype), safe_gids,
+                num_segments=seg)[:num_groups]
+        return cache[k]
+
+    def seg_count(m, key):
+        k = ("count", key)
+        if k not in cache:
+            if not has_col_masks:
+                cache[k] = counts
+            else:
+                cache[k] = jax.ops.segment_sum(
+                    m.astype(jnp.int32), safe_gids, num_segments=seg)[:num_groups]
+        return cache[k]
+
+    for i, op in enumerate(ops):
+        col = values[i]
+        m = agg_mask(i)
+        if op == "count":
+            results.append(seg_count(m, i))
+        elif op == "sum":
+            results.append(seg_sum(col, i, m))
+        elif op == "avg":
+            s = seg_sum(col, i, m)
+            c = seg_count(m, i)
+            results.append(jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan))
+        elif op in ("stddev", "variance"):
+            s = seg_sum(col, i, m)
+            sq = jax.ops.segment_sum(
+                jnp.where(m, col * col, 0), safe_gids, num_segments=seg)[:num_groups]
+            c = jnp.maximum(seg_count(m, i), 1)
+            var = jnp.maximum(sq / c - (s / c) ** 2, 0.0)
+            results.append(jnp.sqrt(var) if op == "stddev" else var)
+        elif op == "min":
+            filled = jnp.where(m, col, _max_ident(col.dtype))
+            r = jax.ops.segment_min(filled, safe_gids, num_segments=seg)[:num_groups]
+            results.append(r)
+        elif op == "max":
+            filled = jnp.where(m, col, _min_ident(col.dtype))
+            r = jax.ops.segment_max(filled, safe_gids, num_segments=seg)[:num_groups]
+            results.append(r)
+        elif op in ("first", "last"):
+            # two-pass arg-extreme: find the extreme ts per group, then the
+            # first row index achieving it, then gather the value.
+            if op == "first":
+                ext_ts = jax.ops.segment_min(
+                    jnp.where(m, ts, _max_ident(ts.dtype)), safe_gids,
+                    num_segments=seg)
+            else:
+                ext_ts = jax.ops.segment_max(
+                    jnp.where(m, ts, _min_ident(ts.dtype)), safe_gids,
+                    num_segments=seg)
+            hit = m & (ts == ext_ts[safe_gids])
+            idx = jax.ops.segment_min(
+                jnp.where(hit, jnp.arange(n, dtype=jnp.int32), n), safe_gids,
+                num_segments=seg)[:num_groups]
+            safe_idx = jnp.minimum(idx, n - 1)
+            # dtype-preserving null fill: NaN for floats, 0 for ints (callers
+            # null empty groups via the returned counts)
+            empty = jnp.nan if jnp.issubdtype(col.dtype, jnp.floating) \
+                else jnp.zeros((), col.dtype)
+            results.append(jnp.where(idx < n, col[safe_idx], empty))
+        else:
+            raise ValueError(f"unsupported agg op: {op}")
+    return tuple(results), counts
+
+
+def _max_ident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_ident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)
+
+
+def time_bucket_ids(ts: jax.Array, origin: int, stride: int,
+                    num_buckets: int) -> jax.Array:
+    """Map timestamps onto [0, num_buckets) bucket ids (clamped)."""
+    b = (ts - origin) // stride
+    return jnp.clip(b, 0, num_buckets - 1).astype(jnp.int32)
+
+
+def combine_group_ids(tag_gids: jax.Array, bucket_ids: jax.Array,
+                      num_buckets: int) -> jax.Array:
+    return (tag_gids.astype(jnp.int32) * num_buckets
+            + bucket_ids.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Sort-based merge + dedup
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def sort_merge_dedup(series_ids: jax.Array,  # int32 [N]
+                     ts: jax.Array,          # int64 [N]
+                     seq: jax.Array,         # int64 [N] write sequence
+                     op_types: jax.Array,    # int8  [N] OP_PUT / OP_DELETE
+                     valid: jax.Array,       # bool  [N] padding mask
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Merge-sort rows from any number of concatenated runs and compute the
+    MVCC keep-mask.
+
+    Returns (order, keep): `order` is the permutation sorting rows by
+    (series, ts, seq) with invalid rows last; `keep[i]` marks, in sorted
+    position i, rows that survive dedup — the highest sequence for each
+    (series, ts) key, unless that winner is a DELETE.
+    """
+    n = series_ids.shape[0]
+    big_series = jnp.where(valid, series_ids, jnp.iinfo(jnp.int32).max)
+    order = jnp.lexsort((seq, ts, big_series))
+    s_sorted = big_series[order]
+    t_sorted = ts[order]
+    op_sorted = op_types[order]
+    v_sorted = valid[order]
+    # last row of each (series, ts) run wins (seq ascending within run)
+    nxt_same = jnp.concatenate([
+        (s_sorted[1:] == s_sorted[:-1]) & (t_sorted[1:] == t_sorted[:-1]),
+        jnp.array([False]),
+    ])
+    keep = v_sorted & (~nxt_same) & (op_sorted == OP_PUT)
+    return order, keep
+
+
+def merge_dedup_numpy(series_ids: np.ndarray, ts: np.ndarray, seq: np.ndarray,
+                      op_types: np.ndarray) -> np.ndarray:
+    """Host/NumPy twin of sort_merge_dedup returning kept row indices in
+    (series, ts) order — used by the flush path and as the test oracle."""
+    order = np.lexsort((seq, ts, series_ids))
+    s, t, o = series_ids[order], ts[order], op_types[order]
+    nxt_same = np.concatenate([(s[1:] == s[:-1]) & (t[1:] == t[:-1]), [False]])
+    keep = (~nxt_same) & (o == OP_PUT)
+    return order[keep]
+
+
+# ---------------------------------------------------------------------------
+# Filter program → mask (compiled per query structure)
+# ---------------------------------------------------------------------------
+
+CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "isin", "between"}
+
+
+def apply_cmp(op: str, col: jax.Array, a, b=None) -> jax.Array:
+    if op == "eq":
+        return col == a
+    if op == "ne":
+        return col != a
+    if op == "lt":
+        return col < a
+    if op == "le":
+        return col <= a
+    if op == "gt":
+        return col > a
+    if op == "ge":
+        return col >= a
+    if op == "between":
+        return (col >= a) & (col <= b)
+    if op == "isin":
+        return jnp.isin(col, a)
+    raise ValueError(f"unknown cmp op {op}")

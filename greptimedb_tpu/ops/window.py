@@ -1,0 +1,373 @@
+"""PromQL range-vector evaluation as vmapped window reductions.
+
+Reference behavior: src/promql — `RangeManipulate` materializes per-step
+window views (`RangeArray`, a DictionaryArray trick) and evaluates range
+functions row-by-row per series (aggr_over_time.rs, extrapolate_rate.rs).
+
+TPU design: series are laid out as a dense padded matrix [S, L] sorted by
+time within each row. For an aligned step grid t_j = start + j*step, the
+window (t_j - range, t_j] of every series is located with a vmapped
+`searchsorted`, and:
+
+- sum/count/avg/stddev/rate/increase/delta/changes/resets/last/first/idelta
+  evaluate O(1) per window from per-series prefix sums (cumsum path);
+- min/max/quantile/deriv/predict_linear gather bounded windows (MAXW static)
+  and reduce with masking (gather path).
+
+Counter resets are handled with a per-series cumulative correction array so
+`increase` is a pure difference of adjusted prefix values — no per-window
+scan. Extrapolation follows Prometheus `extrapolatedRate` semantics
+(reference: src/promql/src/functions/extrapolate_rate.rs:53-200).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TS_PAD = np.iinfo(np.int64).max
+
+CUMSUM_OPS = {
+    "sum_over_time", "count_over_time", "avg_over_time", "stddev_over_time",
+    "stdvar_over_time", "last_over_time", "first_over_time", "present_over_time",
+    "rate", "increase", "delta", "idelta", "changes", "resets",
+}
+GATHER_OPS = {"min_over_time", "max_over_time", "quantile_over_time",
+              "deriv", "predict_linear", "mad_over_time", "holt_winters"}
+RANGE_OPS = CUMSUM_OPS | GATHER_OPS
+
+
+class SeriesMatrix:
+    """Dense padded [num_series, max_len] layout of a set of time series."""
+
+    __slots__ = ("ts", "values", "lengths", "num_series", "max_len")
+
+    def __init__(self, ts: np.ndarray, values: np.ndarray, lengths: np.ndarray):
+        self.ts = ts
+        self.values = values
+        self.lengths = lengths
+        self.num_series, self.max_len = ts.shape
+
+    @staticmethod
+    def build(series_ids: np.ndarray, ts: np.ndarray, values: np.ndarray,
+              num_series: int, max_len: Optional[int] = None) -> "SeriesMatrix":
+        """Build from flat arrays sorted by (series_id, ts). Rows whose
+        series_id is outside [0, num_series) are dropped."""
+        sel = (series_ids >= 0) & (series_ids < num_series)
+        series_ids, ts, values = series_ids[sel], ts[sel], values[sel]
+        counts = np.bincount(series_ids, minlength=num_series)
+        longest = int(counts.max(initial=0))
+        if max_len is not None and max_len < longest:
+            raise ValueError(
+                f"max_len={max_len} smaller than longest series ({longest} rows)")
+        L = int(max_len if max_len is not None else max(longest, 1))
+        # bucket L to powers of two to bound compile cache misses
+        L = 1 << (L - 1).bit_length() if L > 1 else 1
+        ts2d = np.full((num_series, L), TS_PAD, dtype=np.int64)
+        val2d = np.zeros((num_series, L), dtype=values.dtype)
+        offsets = np.zeros(num_series + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        col = np.arange(len(series_ids)) - offsets[series_ids]
+        ts2d[series_ids, col] = ts
+        val2d[series_ids, col] = values
+        return SeriesMatrix(ts2d, val2d, counts.astype(np.int32))
+
+    def device_arrays(self, base: Optional[int] = None):
+        """Return (ts, values, lengths, base) ready for device transfer.
+
+        On TPU x64 is typically disabled, so int64 epoch timestamps would
+        silently truncate. When the time span fits, timestamps are rebased to
+        int32 offsets from `base` (padding becomes int32 max, preserving the
+        sentinel ordering); callers must rebase query times by the same base.
+        """
+        valid = self.ts != TS_PAD
+        if base is None:
+            base = int(self.ts[valid].min()) if valid.any() else 0
+        span_ok = True
+        if valid.any():
+            span_ok = (int(self.ts[valid].max()) - base) < 2**31 - 1 and \
+                base <= int(self.ts[valid].min())
+        if span_ok:
+            rel = np.where(valid, self.ts - base, np.iinfo(np.int32).max)
+            return rel.astype(np.int32), self.values, self.lengths, base
+        return self.ts, self.values, self.lengths, 0
+
+
+def window_bounds(ts2d: jax.Array, step_ends: jax.Array, range_ms: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """lo/hi [S, T]: window (end - range, end] as index ranges [lo, hi)."""
+    ss = jax.vmap(lambda row, v: jnp.searchsorted(row, v, side="right"),
+                  in_axes=(0, None))
+    lo = ss(ts2d, step_ends - range_ms)
+    hi = ss(ts2d, step_ends)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _gather(row2d: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather row2d[s, idx[s, t]] → [S, T] (idx clipped by caller)."""
+    return jnp.take_along_axis(row2d, idx, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps"))
+def range_aggregate_cumsum(
+    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+    t0, step, range_ms, *, op: str, nsteps: int, param: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate a cumsum-path range function on the aligned step grid.
+
+    Returns (result [S, T], ok [S, T]) — ok False means "no point for this
+    series at this step" (NaN / absent in PromQL terms).
+    """
+    S, L = ts2d.shape
+    step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
+    lo, hi = window_bounds(ts2d, step_ends, range_ms)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    valid = idx[None, :] < lengths[:, None]
+    fv = val2d.dtype
+    count = (hi - lo).astype(jnp.int32)
+    ok1 = count >= 1
+    hi1 = jnp.maximum(hi - 1, 0)
+
+    def pick_first():
+        return _gather(val2d, jnp.minimum(lo, L - 1))
+
+    def pick_last():
+        return _gather(val2d, hi1)
+
+    if op in ("count_over_time", "present_over_time"):
+        if op == "present_over_time":
+            return jnp.ones_like(count, dtype=fv), ok1
+        return count.astype(fv), ok1
+
+    if op in ("sum_over_time", "avg_over_time", "stddev_over_time",
+              "stdvar_over_time"):
+        vz = jnp.where(valid, val2d, 0).astype(fv)
+        cs = jnp.cumsum(vz, axis=1)
+        csp = jnp.concatenate([jnp.zeros((S, 1), fv), cs], axis=1)
+        wsum = _gather(csp, hi) - _gather(csp, lo)
+        if op == "sum_over_time":
+            return wsum, ok1
+        cnt = jnp.maximum(count, 1).astype(fv)
+        mean = wsum / cnt
+        if op == "avg_over_time":
+            return mean, ok1
+        cs2 = jnp.cumsum(vz * vz, axis=1)
+        cs2p = jnp.concatenate([jnp.zeros((S, 1), fv), cs2], axis=1)
+        wsq = _gather(cs2p, hi) - _gather(cs2p, lo)
+        var = jnp.maximum(wsq / cnt - mean * mean, 0.0)
+        if op == "stdvar_over_time":
+            return var, ok1
+        return jnp.sqrt(var), ok1
+
+    if op == "first_over_time":
+        return pick_first(), ok1
+    if op == "last_over_time":
+        return pick_last(), ok1
+
+    if op == "idelta":
+        ok2 = count >= 2
+        last = pick_last()
+        prev = _gather(val2d, jnp.maximum(hi - 2, 0))
+        return last - prev, ok2
+
+    if op in ("changes", "resets"):
+        prev = jnp.concatenate([val2d[:, :1], val2d[:, :-1]], axis=1)
+        pair_ok = valid & (idx[None, :] >= 1)
+        if op == "changes":
+            ind = pair_ok & (val2d != prev)
+        else:
+            ind = pair_ok & (val2d < prev)
+        ci = jnp.cumsum(ind.astype(jnp.int32), axis=1)
+        cip = jnp.concatenate([jnp.zeros((S, 1), jnp.int32), ci], axis=1)
+        # pairs (i-1, i) with both endpoints inside [lo, hi)
+        cnt = _gather(cip, hi) - _gather(cip, jnp.minimum(lo + 1, L))
+        cnt = jnp.where(count >= 1, cnt, 0)
+        return cnt.astype(fv), ok1
+
+    if op in ("rate", "increase", "delta"):
+        ok2 = count >= 2
+        first_t = _gather(ts2d, jnp.minimum(lo, L - 1)).astype(fv)
+        last_t = _gather(ts2d, hi1).astype(fv)
+        first_v = pick_first()
+        last_v = pick_last()
+        if op == "delta":
+            raw = last_v - first_v
+            first_for_zero = jnp.zeros_like(first_v)  # no zero-capping for gauges
+            is_counter = False
+        else:
+            # counter-reset correction: adjusted[i] = v[i] + sum of resets<=i
+            prev = jnp.concatenate([val2d[:, :1], val2d[:, :-1]], axis=1)
+            pair_ok = valid & (idx[None, :] >= 1)
+            contrib = jnp.where(pair_ok & (val2d < prev), prev, 0).astype(fv)
+            corr = jnp.cumsum(contrib, axis=1)
+            adj = val2d + corr
+            raw = _gather(adj, hi1) - _gather(adj, jnp.minimum(lo, L - 1))
+            first_for_zero = first_v
+            is_counter = True
+        # Prometheus extrapolation (extrapolate_rate.rs:100-200)
+        ms = jnp.asarray(range_ms, fv)
+        range_start = step_ends[None, :].astype(fv) - ms
+        range_end = step_ends[None, :].astype(fv)
+        dur_to_start = first_t - range_start
+        dur_to_end = range_end - last_t
+        sampled = last_t - first_t
+        avg_dur = sampled / jnp.maximum(count - 1, 1).astype(fv)
+        threshold = avg_dur * 1.1
+        if is_counter:
+            # cap extrapolation below zero for counters (only meaningful when
+            # the first sample is non-negative, per extrapolate_rate.rs)
+            dur_to_zero = jnp.where((raw > 0) & (first_for_zero >= 0),
+                                    sampled * (first_for_zero / jnp.where(raw == 0, 1, raw)),
+                                    jnp.inf)
+            dur_to_start = jnp.minimum(dur_to_start, dur_to_zero)
+        ext_start = jnp.where(dur_to_start < threshold, dur_to_start, avg_dur / 2)
+        ext_end = jnp.where(dur_to_end < threshold, dur_to_end, avg_dur / 2)
+        factor = (sampled + ext_start + ext_end) / jnp.where(sampled == 0, 1, sampled)
+        out = raw * factor
+        if op == "rate":
+            out = out / (ms / 1000.0)
+        return out, ok2 & (sampled > 0)
+
+    raise ValueError(f"not a cumsum-path op: {op}")
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "series_block"))
+def range_aggregate_gather(
+    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+    t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
+    param: float = 0.0, param2: float = 0.0, series_block: int = 128,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather-path range functions: each window materializes ≤ maxw samples.
+
+    Windows longer than maxw are truncated to their most recent maxw samples
+    (callers size maxw from data density). Processed in series blocks via
+    lax.map to bound VMEM footprint."""
+    S, L = ts2d.shape
+    step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
+    pad_s = (-S) % series_block
+    pad_sentinel = jnp.iinfo(ts2d.dtype).max
+    ts2d = jnp.pad(ts2d, ((0, pad_s), (0, 0)), constant_values=pad_sentinel)
+    val2d = jnp.pad(val2d, ((0, pad_s), (0, 0)))
+    lengths = jnp.pad(lengths, (0, pad_s))
+    SB = (S + pad_s) // series_block
+
+    def block(args):
+        tsb, valb = args  # [B, L]
+        lo, hi = window_bounds(tsb, step_ends, range_ms)
+        lo = jnp.maximum(lo, hi - maxw)
+        w = jnp.arange(maxw, dtype=jnp.int32)
+        widx = lo[:, :, None] + w[None, None, :]            # [B, T, W]
+        inwin = widx < hi[:, :, None]
+        widx_c = jnp.minimum(widx, L - 1)
+        vals = jnp.take_along_axis(jnp.broadcast_to(valb[:, None, :],
+                                                    (valb.shape[0], nsteps, L)),
+                                   widx_c, axis=2)
+        tvals = jnp.take_along_axis(jnp.broadcast_to(tsb[:, None, :],
+                                                     (tsb.shape[0], nsteps, L)),
+                                    widx_c, axis=2)
+        count = (hi - lo).astype(jnp.int32)
+        ok1 = count >= 1
+        fv = valb.dtype
+        if op == "min_over_time":
+            r = jnp.min(jnp.where(inwin, vals, jnp.inf), axis=2)
+            return r, ok1
+        if op == "max_over_time":
+            r = jnp.max(jnp.where(inwin, vals, -jnp.inf), axis=2)
+            return r, ok1
+        if op == "mad_over_time":
+            med = _masked_quantile(vals, inwin, 0.5)
+            dev = jnp.abs(vals - med[:, :, None])
+            r = _masked_quantile(dev, inwin, 0.5)
+            return r, ok1
+        if op == "quantile_over_time":
+            return _masked_quantile(vals, inwin, param), ok1
+        if op in ("deriv", "predict_linear"):
+            ok2 = count >= 2
+            # least-squares slope with times centered on the window end
+            t_sec = (tvals.astype(fv) - step_ends[None, :, None].astype(fv)) / 1000.0
+            m = inwin.astype(fv)
+            n = jnp.maximum(jnp.sum(m, axis=2), 1)
+            sx = jnp.sum(t_sec * m, axis=2)
+            sy = jnp.sum(vals * m, axis=2)
+            sxx = jnp.sum(t_sec * t_sec * m, axis=2)
+            sxy = jnp.sum(t_sec * vals * m, axis=2)
+            denom = n * sxx - sx * sx
+            slope = jnp.where(denom != 0, (n * sxy - sx * sy) /
+                              jnp.where(denom == 0, 1, denom), jnp.nan)
+            if op == "deriv":
+                return slope, ok2
+            intercept = (sy - slope * sx) / n
+            return intercept + slope * param, ok2
+        if op == "holt_winters":
+            return _holt_winters(vals, inwin, param, param2), count >= 2
+        raise ValueError(f"not a gather-path op: {op}")
+
+    outs, oks = jax.lax.map(
+        block, (ts2d.reshape(SB, series_block, L), val2d.reshape(SB, series_block, L)))
+    out = outs.reshape(-1, nsteps)[:S]
+    ok = oks.reshape(-1, nsteps)[:S]
+    return out, ok
+
+
+def _masked_quantile(vals: jax.Array, mask: jax.Array, q) -> jax.Array:
+    """Quantile along the last axis ignoring masked entries (sort-based,
+    linear interpolation, matching Prometheus quantile semantics)."""
+    big = jnp.where(mask, vals, jnp.inf)
+    svals = jnp.sort(big, axis=-1)
+    n = jnp.sum(mask, axis=-1)
+    fv = vals.dtype
+    pos = q * (n.astype(fv) - 1)
+    lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, vals.shape[-1] - 1)
+    hi_i = jnp.clip(lo_i + 1, 0, vals.shape[-1] - 1)
+    frac = pos - lo_i.astype(fv)
+    lo_v = jnp.take_along_axis(svals, lo_i[..., None], axis=-1)[..., 0]
+    hi_v = jnp.take_along_axis(svals, jnp.minimum(hi_i, jnp.maximum(n - 1, 0))[..., None],
+                               axis=-1)[..., 0]
+    return lo_v + (hi_v - lo_v) * frac
+
+
+def _holt_winters(vals: jax.Array, mask: jax.Array, sf, tf) -> jax.Array:
+    """Holt-Winters double exponential smoothing over each window.
+
+    sf = smoothing factor, tf = trend factor (both in (0,1)); sequential over
+    the ≤ maxw window via lax.scan (reference:
+    src/promql/src/functions/holt_winters.rs)."""
+    x0 = vals[..., 0]
+    x1 = jnp.where(mask[..., 1], vals[..., 1], x0)
+    s0, b0 = x1, x1 - x0
+
+    def step(carry, xm):
+        s, b = carry
+        x, m = xm
+        s_new = sf * x + (1 - sf) * (s + b)
+        b_new = tf * (s_new - s) + (1 - tf) * b
+        s = jnp.where(m, s_new, s)
+        b = jnp.where(m, b_new, b)
+        return (s, b), None
+
+    xs = jnp.moveaxis(vals[..., 2:], -1, 0)
+    ms = jnp.moveaxis(mask[..., 2:], -1, 0)
+    (s_fin, _), _ = jax.lax.scan(step, (s0, b0), (xs, ms))
+    return s_fin
+
+
+@functools.partial(jax.jit, static_argnames=("nsteps",))
+def instant_select(ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+                   t0, step, lookback_ms, *, nsteps: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """InstantManipulate: at each step pick the latest sample within the
+    lookback window [t - lookback, t] (reference:
+    src/promql/src/extension_plan/instant_manipulate.rs:46)."""
+    S, L = ts2d.shape
+    step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
+    ss = jax.vmap(lambda row, v: jnp.searchsorted(row, v, side="right"),
+                  in_axes=(0, None))
+    hi = ss(ts2d, step_ends).astype(jnp.int32)
+    hi1 = jnp.maximum(hi - 1, 0)
+    last_t = _gather(ts2d, hi1)
+    ok = (hi >= 1) & (last_t >= step_ends[None, :] - lookback_ms)
+    return _gather(val2d, hi1), ok

@@ -1,0 +1,28 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests run
+against `--xla_force_host_platform_device_count=8` on CPU, which exercises the
+same SPMD partitioner XLA uses on real meshes.
+
+Note: the image's sitecustomize pre-imports JAX with JAX_PLATFORMS=axon, so
+plain env vars are too late — we reconfigure via jax.config before the first
+backend initialization (which is lazy).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_dir(tmp_path):
+    return str(tmp_path)

@@ -1,0 +1,175 @@
+"""Tests for the type/vector/schema substrate.
+
+Mirrors reference coverage in src/datatypes/src/{data_type,vectors,schema}
+unit tests and src/common/time tests.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.common.time import (
+    Timestamp, TimeUnit, TimestampRange, parse_duration_ms)
+from greptimedb_tpu.datatypes import (
+    BOOLEAN, FLOAT64, INT64, STRING, TIMESTAMP_MILLISECOND, TIMESTAMP_SECOND,
+    ColumnDefaultConstraint, ColumnSchema, RecordBatch, Schema, SemanticType,
+    Vector, parse_type_name,
+)
+
+
+class TestTime:
+    def test_convert(self):
+        ts = Timestamp(1500, TimeUnit.MILLISECOND)
+        assert ts.convert_to(TimeUnit.SECOND).value == 1
+        assert ts.convert_to(TimeUnit.MICROSECOND).value == 1_500_000
+        # floor semantics for negatives
+        assert Timestamp(-1500, TimeUnit.MILLISECOND).convert_to(TimeUnit.SECOND).value == -2
+
+    def test_ordering_across_units(self):
+        assert Timestamp(1, TimeUnit.SECOND) < Timestamp(1001, TimeUnit.MILLISECOND)
+        assert Timestamp(1, TimeUnit.SECOND) >= Timestamp(1000, TimeUnit.MILLISECOND)
+
+    def test_from_str(self):
+        assert Timestamp.from_str("1234").value == 1234
+        t = Timestamp.from_str("1970-01-01 00:00:01")
+        assert t.value == 1000
+        t = Timestamp.from_str("1970-01-01T00:00:01.500Z")
+        assert t.value == 1500
+
+    def test_range(self):
+        r = TimestampRange(10, 20)
+        assert r.contains(10) and r.contains(19)
+        assert not r.contains(20) and not r.contains(9)
+        assert r.intersects(TimestampRange(19, 30))
+        assert not r.intersects(TimestampRange(20, 30))
+        assert TimestampRange(None, 5).intersects(TimestampRange(None, None))
+
+    def test_duration(self):
+        assert parse_duration_ms("5m") == 300_000
+        assert parse_duration_ms("1h30m") == 5_400_000
+        assert parse_duration_ms("100ms") == 100
+        with pytest.raises(ValueError):
+            parse_duration_ms("xyz")
+
+
+class TestTypes:
+    def test_parse_type_name(self):
+        assert parse_type_name("DOUBLE") is FLOAT64
+        assert parse_type_name("bigint") is INT64
+        assert parse_type_name("TIMESTAMP") is TIMESTAMP_MILLISECOND
+        assert parse_type_name("timestamp(0)") is TIMESTAMP_SECOND
+        assert parse_type_name("VARCHAR") is STRING
+        with pytest.raises(ValueError):
+            parse_type_name("frobnicate")
+
+    def test_cast_value(self):
+        assert TIMESTAMP_MILLISECOND.cast_value("1970-01-01 00:00:01") == 1000
+        assert FLOAT64.cast_value("3") == 3.0
+        assert BOOLEAN.cast_value("true") is True
+
+
+class TestVector:
+    def test_pylist_roundtrip_with_nulls(self):
+        v = Vector.from_pylist([1.0, None, 3.0], FLOAT64)
+        assert v.to_pylist() == [1.0, None, 3.0]
+        assert v.null_count == 1
+        assert v.get(1) is None
+
+    def test_arrow_roundtrip(self):
+        v = Vector.from_pylist(["a", None, "c"], STRING)
+        arr = v.to_arrow()
+        assert arr.to_pylist() == ["a", None, "c"]
+        v2 = Vector.from_arrow(arr)
+        assert v2.to_pylist() == ["a", None, "c"]
+
+    def test_timestamp_arrow_roundtrip(self):
+        v = Vector.from_pylist([0, 1000, 2000], TIMESTAMP_MILLISECOND)
+        arr = v.to_arrow()
+        assert pa.types.is_timestamp(arr.type)
+        v2 = Vector.from_arrow(arr)
+        assert v2.dtype is TIMESTAMP_MILLISECOND
+        assert list(v2.data) == [0, 1000, 2000]
+
+    def test_ops(self):
+        v = Vector.from_pylist([1, 2, 3, 4], INT64)
+        assert v.filter(np.array([True, False, True, False])).to_pylist() == [1, 3]
+        assert v.take(np.array([3, 0])).to_pylist() == [4, 1]
+        assert v.slice(1, 2).to_pylist() == [2, 3]
+        c = Vector.concat([v, Vector.from_pylist([5], INT64)])
+        assert c.to_pylist() == [1, 2, 3, 4, 5]
+
+    def test_cast(self):
+        v = Vector.from_pylist([1000, 2000], TIMESTAMP_MILLISECOND)
+        assert v.cast(TIMESTAMP_SECOND).to_pylist() == [1, 2]
+        v = Vector.from_pylist([1, 2], INT64)
+        assert v.cast(STRING).to_pylist() == ["1", "2"]
+
+
+def make_monitor_schema() -> Schema:
+    return Schema([
+        ColumnSchema("host", STRING, nullable=False, semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP,
+                     default=ColumnDefaultConstraint(function="current_timestamp")),
+        ColumnSchema("cpu", FLOAT64),
+        ColumnSchema("memory", FLOAT64),
+    ])
+
+
+class TestSchema:
+    def test_roles(self):
+        s = make_monitor_schema()
+        assert s.timestamp_column.name == "ts"
+        assert s.tag_names() == ["host"]
+        assert s.field_names() == ["cpu", "memory"]
+
+    def test_arrow_roundtrip(self):
+        s = make_monitor_schema()
+        s2 = Schema.from_arrow(s.to_arrow())
+        assert s2.tag_names() == ["host"]
+        assert s2.timestamp_column.name == "ts"
+        assert s2.column_schema("cpu").dtype is FLOAT64
+
+    def test_dict_roundtrip(self):
+        s = make_monitor_schema()
+        s2 = Schema.from_dict(s.to_dict())
+        assert s == s2
+        assert s2.column_schema("ts").default.function == "current_timestamp"
+
+    def test_duplicate_time_index_rejected(self):
+        with pytest.raises(ValueError):
+            Schema([
+                ColumnSchema("a", TIMESTAMP_MILLISECOND,
+                             semantic_type=SemanticType.TIMESTAMP),
+                ColumnSchema("b", TIMESTAMP_MILLISECOND,
+                             semantic_type=SemanticType.TIMESTAMP),
+            ])
+
+    def test_default_vector(self):
+        s = make_monitor_schema()
+        v = s.column_schema("ts").create_default_vector(3)
+        assert len(v) == 3 and v.null_count == 0
+        v = s.column_schema("cpu").create_default_vector(2)
+        assert v.null_count == 2
+
+
+class TestRecordBatch:
+    def test_pydict_and_arrow(self):
+        s = make_monitor_schema()
+        rb = RecordBatch.from_pydict(s, {
+            "host": ["a", "b"], "ts": [0, 1000], "cpu": [0.5, 0.6],
+            "memory": [None, 1024.0]})
+        assert rb.num_rows == 2
+        arrow = rb.to_arrow()
+        rb2 = RecordBatch.from_arrow(arrow)
+        assert rb2.to_pydict() == rb.to_pydict()
+
+    def test_project_filter(self):
+        s = make_monitor_schema()
+        rb = RecordBatch.from_pydict(s, {
+            "host": ["a", "b", "c"], "ts": [0, 1, 2], "cpu": [1.0, 2.0, 3.0],
+            "memory": [1.0, 2.0, 3.0]})
+        p = rb.project(["host", "cpu"])
+        assert p.schema.names() == ["host", "cpu"]
+        f = rb.filter(np.array([True, False, True]))
+        assert f.column("host").to_pylist() == ["a", "c"]

@@ -1,0 +1,360 @@
+"""Kernel tests against NumPy oracles.
+
+Mirrors the reference's memtable/merge/dedup semantics tests
+(src/storage/src/memtable/tests.rs, src/storage/src/read/merge.rs) and the
+PromQL function tests (src/promql/src/functions/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from greptimedb_tpu.ops import Dictionary
+from greptimedb_tpu.ops.kernels import (
+    OP_DELETE, OP_PUT, combine_group_ids, grouped_aggregate,
+    merge_dedup_numpy, pad_axis0, shape_bucket, sort_merge_dedup,
+    time_bucket_ids,
+)
+from greptimedb_tpu.ops.window import (
+    SeriesMatrix, instant_select, range_aggregate_cumsum,
+    range_aggregate_gather,
+)
+
+
+class TestDictionary:
+    def test_roundtrip(self):
+        d = Dictionary()
+        ids = d.encode(["a", "b", "a", "c"])
+        assert ids.tolist() == [0, 1, 0, 2]
+        assert d.decode(np.array([2, 0])) == ["c", "a"]
+        assert d.encode_existing(["b", "zzz"]).tolist() == [1, -1]
+        d2 = Dictionary.from_list(d.to_list())
+        assert d2.encode_existing(["c"]).tolist() == [2]
+
+
+class TestShapeBucket:
+    def test_bucket(self):
+        assert shape_bucket(1) == 1024
+        assert shape_bucket(1025) == 2048
+        assert shape_bucket(4096) == 4096
+
+    def test_pad(self):
+        a = np.arange(3)
+        p = pad_axis0(a, 8, fill=-1)
+        assert p.tolist() == [0, 1, 2, -1, -1, -1, -1, -1]
+
+
+class TestGroupedAggregate:
+    def _data(self, seed=0, n=1000, groups=7):
+        rng = np.random.default_rng(seed)
+        gids = rng.integers(0, groups, n).astype(np.int32)
+        vals = rng.normal(size=n)
+        mask = rng.random(n) > 0.3
+        ts = rng.integers(0, 10_000, n).astype(np.int64)
+        return gids, mask, ts, vals, groups
+
+    def test_sum_count_avg_min_max(self):
+        gids, mask, ts, vals, G = self._data()
+        (s, c, a, mn, mx), counts = grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals),) * 5,
+            num_groups=G, ops=("sum", "count", "avg", "min", "max"))
+        for g in range(G):
+            sel = (gids == g) & mask
+            if sel.any():
+                np.testing.assert_allclose(s[g], vals[sel].sum(), rtol=1e-9)
+                assert int(c[g]) == sel.sum()
+                np.testing.assert_allclose(a[g], vals[sel].mean(), rtol=1e-9)
+                np.testing.assert_allclose(mn[g], vals[sel].min())
+                np.testing.assert_allclose(mx[g], vals[sel].max())
+            assert int(counts[g]) == sel.sum()
+
+    def test_first_last(self):
+        gids = np.array([0, 0, 1, 1, 0], dtype=np.int32)
+        ts = np.array([5, 1, 9, 2, 3], dtype=np.int64)
+        vals = np.array([50.0, 10.0, 90.0, 20.0, 30.0])
+        mask = np.ones(5, dtype=bool)
+        (fst, lst), _ = grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals), jnp.asarray(vals)),
+            num_groups=2, ops=("first", "last"))
+        assert fst[0] == 10.0 and lst[0] == 50.0
+        assert fst[1] == 20.0 and lst[1] == 90.0
+
+    def test_empty_group(self):
+        gids = np.array([0], dtype=np.int32)
+        mask = np.ones(1, dtype=bool)
+        ts = np.zeros(1, dtype=np.int64)
+        vals = np.array([1.0])
+        (a,), counts = grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals),), num_groups=3, ops=("avg",))
+        assert counts[1] == 0 and counts[2] == 0
+        assert np.isnan(a[1])
+
+    def test_stddev(self):
+        gids, mask, ts, vals, G = self._data(seed=3)
+        (sd,), counts = grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals),), num_groups=G, ops=("stddev",))
+        for g in range(G):
+            sel = (gids == g) & mask
+            if sel.sum() > 1:
+                np.testing.assert_allclose(sd[g], vals[sel].std(), rtol=1e-6)
+
+    def test_time_bucket_combine(self):
+        ts = jnp.array([0, 999, 1000, 2500], dtype=jnp.int64)
+        b = time_bucket_ids(ts, 0, 1000, 4)
+        assert b.tolist() == [0, 0, 1, 2]
+        gid = combine_group_ids(jnp.array([1, 0, 1, 0]), b, 4)
+        assert gid.tolist() == [4, 0, 5, 2]
+
+
+class TestMergeDedup:
+    def test_basic_dedup(self):
+        # two runs: memtable overwrites an SST row at (s=0, ts=10)
+        series = np.array([0, 0, 1, 0], dtype=np.int32)
+        ts = np.array([10, 20, 10, 10], dtype=np.int64)
+        seq = np.array([1, 2, 3, 7], dtype=np.int64)
+        op = np.array([OP_PUT] * 4, dtype=np.int8)
+        kept = merge_dedup_numpy(series, ts, seq, op)
+        # rows sorted by (series, ts): winner at (0,10) is seq=7 → index 3
+        assert kept.tolist() == [3, 1, 2]
+
+    def test_delete_hides_row(self):
+        series = np.array([0, 0], dtype=np.int32)
+        ts = np.array([10, 10], dtype=np.int64)
+        seq = np.array([1, 2], dtype=np.int64)
+        op = np.array([OP_PUT, OP_DELETE], dtype=np.int8)
+        kept = merge_dedup_numpy(series, ts, seq, op)
+        assert kept.tolist() == []
+
+    def test_device_matches_numpy(self):
+        rng = np.random.default_rng(42)
+        n = 500
+        series = rng.integers(0, 20, n).astype(np.int32)
+        ts = rng.integers(0, 50, n).astype(np.int64)
+        seq = np.arange(n, dtype=np.int64)
+        op = rng.choice([OP_PUT, OP_PUT, OP_PUT, OP_DELETE], n).astype(np.int8)
+        valid = np.ones(n, dtype=bool)
+        order, keep = sort_merge_dedup(
+            jnp.asarray(series), jnp.asarray(ts), jnp.asarray(seq),
+            jnp.asarray(op), jnp.asarray(valid))
+        device_kept = np.asarray(order)[np.asarray(keep)]
+        oracle = merge_dedup_numpy(series, ts, seq, op)
+        assert device_kept.tolist() == oracle.tolist()
+
+    def test_padding_rows_dropped(self):
+        series = np.array([0, 0, 0], dtype=np.int32)
+        ts = np.array([1, 2, 3], dtype=np.int64)
+        seq = np.array([1, 2, 3], dtype=np.int64)
+        op = np.zeros(3, dtype=np.int8)
+        valid = np.array([True, True, False])
+        order, keep = sort_merge_dedup(
+            jnp.asarray(series), jnp.asarray(ts), jnp.asarray(seq),
+            jnp.asarray(op), jnp.asarray(valid))
+        kept = np.asarray(order)[np.asarray(keep)]
+        assert 2 not in kept.tolist() and len(kept) == 2
+
+
+def make_matrix():
+    # 3 series; series 0: samples every 10s; series 1: sparse; series 2: empty
+    s0_ts = np.arange(0, 300_000, 10_000, dtype=np.int64)
+    s0_v = np.arange(len(s0_ts), dtype=np.float64)  # counter 0,1,2...
+    s1_ts = np.array([50_000, 250_000], dtype=np.int64)
+    s1_v = np.array([5.0, 2.0])
+    series = np.concatenate([np.zeros(len(s0_ts)), np.ones(len(s1_ts))]).astype(np.int32)
+    ts = np.concatenate([s0_ts, s1_ts])
+    vals = np.concatenate([s0_v, s1_v])
+    return SeriesMatrix.build(series, ts, vals, 3)
+
+
+class TestWindow:
+    def test_build(self):
+        m = make_matrix()
+        assert m.num_series == 3
+        assert m.lengths.tolist() == [30, 2, 0]
+
+    def test_avg_sum_count(self):
+        m = make_matrix()
+        # steps at 60s, 120s; range 60s → window (t-60s, t]
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            60_000, 60_000, 60_000, op="avg_over_time", nsteps=2)
+        # series 0 window (0,60s]: samples at 10..60s → values 1..6 → avg 3.5
+        np.testing.assert_allclose(out[0, 0], 3.5)
+        # window (60s,120s]: values 7..12 → avg 9.5
+        np.testing.assert_allclose(out[0, 1], 9.5)
+        assert not bool(ok[2, 0])  # empty series
+        out, _ = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            60_000, 60_000, 60_000, op="count_over_time", nsteps=2)
+        assert out[0, 0] == 6
+
+    def test_min_max_gather(self):
+        m = make_matrix()
+        out, ok = range_aggregate_gather(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            60_000, 60_000, 60_000, op="max_over_time", nsteps=2, maxw=32)
+        np.testing.assert_allclose(out[0, 0], 6.0)
+        np.testing.assert_allclose(out[0, 1], 12.0)
+        out, _ = range_aggregate_gather(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            60_000, 60_000, 60_000, op="min_over_time", nsteps=2, maxw=32)
+        np.testing.assert_allclose(out[0, 0], 1.0)
+
+    def test_rate_steady_counter(self):
+        m = make_matrix()
+        # series 0 increases by 1 every 10s → rate = 0.1/s
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            100_000, 100_000, 100_000, op="rate", nsteps=2)
+        assert bool(ok[0, 0])
+        np.testing.assert_allclose(out[0, 0], 0.1, rtol=1e-6)
+
+    def test_increase_with_reset(self):
+        ts = np.arange(0, 50_000, 10_000, dtype=np.int64)
+        vals = np.array([0.0, 10.0, 20.0, 5.0, 15.0])  # reset at i=3
+        m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            40_000, 40_000, 40_000, op="increase", nsteps=1)
+        # within (0, 40000]: samples v=10,20,5,15 → adjusted 10,20,25,35
+        # raw = 25; extrapolation factor: sampled=30000, durToStart/End=10000/0,
+        # avg_dur=10000, threshold=11000 → ext=10000+0 → factor=40/30
+        np.testing.assert_allclose(out[0, 0], 25 * (40000 / 30000), rtol=1e-6)
+
+    def test_delta_gauge(self):
+        ts = np.arange(0, 50_000, 10_000, dtype=np.int64)
+        vals = np.array([10.0, 8.0, 6.0, 4.0, 2.0])
+        m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            40_000, 40_000, 40_000, op="delta", nsteps=1)
+        np.testing.assert_allclose(out[0, 0], (2.0 - 8.0) * (40000 / 30000), rtol=1e-6)
+
+    def test_changes_resets(self):
+        ts = np.arange(0, 60_000, 10_000, dtype=np.int64)
+        vals = np.array([1.0, 1.0, 2.0, 1.0, 1.0, 3.0])
+        m = SeriesMatrix.build(np.zeros(6, np.int32), ts, vals, 1)
+        out, _ = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            50_000, 50_000, 50_001, op="changes", nsteps=1)
+        assert out[0, 0] == 3  # 1→2, 2→1, 1→3
+        out, _ = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            50_000, 50_000, 50_001, op="resets", nsteps=1)
+        assert out[0, 0] == 1
+
+    def test_quantile(self):
+        ts = np.arange(0, 40_000, 10_000, dtype=np.int64)
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        m = SeriesMatrix.build(np.zeros(4, np.int32), ts, vals, 1)
+        out, _ = range_aggregate_gather(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            30_000, 30_000, 30_001, op="quantile_over_time", nsteps=1,
+            maxw=8, param=0.5)
+        np.testing.assert_allclose(out[0, 0], 2.5)
+
+    def test_deriv(self):
+        ts = np.arange(0, 50_000, 10_000, dtype=np.int64)
+        vals = 2.0 * np.arange(5) + 3.0  # slope 2 per 10s = 0.2/s
+        m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
+        out, ok = range_aggregate_gather(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            40_000, 40_000, 40_001, op="deriv", nsteps=1, maxw=8)
+        np.testing.assert_allclose(out[0, 0], 0.2, rtol=1e-9)
+
+    def test_instant_select_lookback(self):
+        m = make_matrix()
+        vals, ok = instant_select(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            55_000, 100_000, 300_000, nsteps=1)
+        # series 1 latest sample at 50s (value 5.0) within 5m lookback
+        assert bool(ok[1, 0]) and vals[1, 0] == 5.0
+        # short lookback (1s) → no point
+        vals, ok = instant_select(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            55_000, 100_000, 1_000, nsteps=1)
+        assert not bool(ok[1, 0])
+
+    def test_idelta_first_last(self):
+        ts = np.arange(0, 40_000, 10_000, dtype=np.int64)
+        vals = np.array([1.0, 5.0, 2.0, 9.0])
+        m = SeriesMatrix.build(np.zeros(4, np.int32), ts, vals, 1)
+        args = (jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+                30_000, 30_000, 30_001)
+        out, _ = range_aggregate_cumsum(*args, op="idelta", nsteps=1)
+        np.testing.assert_allclose(out[0, 0], 7.0)
+        out, _ = range_aggregate_cumsum(*args, op="last_over_time", nsteps=1)
+        assert out[0, 0] == 9.0
+        out, _ = range_aggregate_cumsum(*args, op="first_over_time", nsteps=1)
+        assert out[0, 0] == 1.0
+
+
+class TestReviewRegressions:
+    """Regression tests for code-review findings."""
+
+    def test_timestamp_eq_hash_cross_unit(self):
+        from greptimedb_tpu.common.time import Timestamp, TimeUnit
+        a = Timestamp(1, TimeUnit.SECOND)
+        b = Timestamp(1000, TimeUnit.MILLISECOND)
+        assert a == b and hash(a) == hash(b)
+        assert len({a, b}) == 1
+
+    def test_timestamp_ns_precision(self):
+        from greptimedb_tpu.common.time import Timestamp, TimeUnit
+        t = Timestamp.from_str("2023-01-02 03:04:05.123456", TimeUnit.NANOSECOND)
+        assert t.value % 1_000_000_000 == 123_456_000
+
+    def test_series_matrix_max_len_too_small(self):
+        with pytest.raises(ValueError, match="max_len"):
+            SeriesMatrix.build(np.zeros(10, np.int32),
+                               np.arange(10, dtype=np.int64),
+                               np.zeros(10), 1, max_len=4)
+
+    def test_device_arrays_int32_rebase(self):
+        base_ts = 1_700_000_000_000
+        ts = base_ts + np.arange(0, 50_000, 10_000, dtype=np.int64)
+        m = SeriesMatrix.build(np.zeros(5, np.int32), ts, np.arange(5.0), 2)
+        rel, vals, lengths, base = m.device_arrays()
+        assert rel.dtype == np.int32 and base == base_ts
+        assert rel[0, 0] == 0 and rel[0, 4] == 40_000
+        # padding sentinel survives as int32 max (still sorts last)
+        assert rel[1, 0] == np.iinfo(np.int32).max
+        # kernels accept the rebased arrays with rebased query times
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(rel), jnp.asarray(vals), jnp.asarray(lengths),
+            40_000, 40_000, 40_001, op="sum_over_time", nsteps=1)
+        np.testing.assert_allclose(out[0, 0], 10.0)
+
+    def test_first_last_preserve_int_dtype(self):
+        gids = jnp.asarray(np.array([0], np.int32))
+        mask = jnp.ones(1, bool)
+        ts = jnp.asarray(np.array([5], np.int64))
+        big = np.array([2**60 + 7], np.int64)
+        (fst,), _ = grouped_aggregate(gids, mask, ts, (jnp.asarray(big),),
+                                      num_groups=2, ops=("first",))
+        assert fst.dtype == jnp.int64
+        assert int(fst[0]) == 2**60 + 7
+
+    def test_holt_winters(self):
+        ts = np.arange(0, 60_000, 10_000, dtype=np.int64)
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+        m = SeriesMatrix.build(np.zeros(6, np.int32), ts, vals, 1)
+        out, ok = range_aggregate_gather(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            50_000, 50_000, 50_001, op="holt_winters", nsteps=1, maxw=8,
+            param=0.5, param2=0.5)
+        assert bool(ok[0, 0])
+        # perfectly linear data → smoothed value equals the last sample
+        np.testing.assert_allclose(out[0, 0], 6.0, rtol=1e-9)
+
+    def test_rate_negative_first_sample_no_zero_cap(self):
+        ts = np.arange(0, 30_000, 10_000, dtype=np.int64)
+        vals = np.array([-5.0, 5.0, 10.0])
+        m = SeriesMatrix.build(np.zeros(3, np.int32), ts, vals, 1)
+        out, ok = range_aggregate_cumsum(
+            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            30_000, 30_000, 30_001, op="increase", nsteps=1)
+        assert bool(ok[0, 0])
+        assert float(out[0, 0]) > 0  # not sign-flipped by a negative cap
