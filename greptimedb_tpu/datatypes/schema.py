@@ -114,6 +114,14 @@ class Schema:
         if len(ts) > 1:
             raise ValueError("multiple TIME INDEX columns")
         self.timestamp_index: Optional[int] = ts[0] if ts else None
+        if self.timestamp_index is not None:
+            tc = self.column_schemas[self.timestamp_index]
+            if tc.nullable:
+                raise ValueError(
+                    f"TIME INDEX column {tc.name!r} must be non-nullable")
+            if not tc.dtype.is_timestamp:
+                raise ValueError(
+                    f"TIME INDEX column {tc.name!r} must be a timestamp type")
 
     # ---- access ----
     def __len__(self) -> int:

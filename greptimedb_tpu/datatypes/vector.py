@@ -18,6 +18,17 @@ from . import data_type as dt
 from .data_type import ConcreteDataType, from_arrow_type
 
 
+def null_column(dtype: ConcreteDataType, n: int):
+    """(data, all-false validity) pair for an absent/null column — the single
+    place that knows the host representation of nulls per dtype."""
+    npdt = dtype.np_dtype if dtype.np_dtype is not None else object
+    if npdt == object:
+        data = np.full(n, None, dtype=object)
+    else:
+        data = np.zeros(n, dtype=npdt)
+    return data, np.zeros(n, dtype=bool)
+
+
 class Vector:
     """A typed nullable column.
 

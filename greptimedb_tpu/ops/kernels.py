@@ -48,12 +48,46 @@ def pad_axis0(arr: np.ndarray, target: int, fill=0) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+def check_i64_safe(*arrays, what: str = "timestamps") -> None:
+    """Guard against silent int64→int32 truncation.
+
+    With jax_enable_x64 off (the default, and the norm on TPU), jnp.asarray
+    silently narrows int64 host arrays to int32 — epoch-ms timestamps wrap
+    negative and dedup/window logic returns wrong answers. Callers must
+    rebase such values (e.g. to region-relative offsets) before the device.
+    """
+    import jax as _jax
+    if _jax.config.jax_enable_x64:
+        return
+    lim = np.iinfo(np.int32)
+    for a in arrays:
+        if isinstance(a, np.ndarray) and a.dtype == np.int64 and a.size:
+            mx, mn = int(a.max()), int(a.min())
+            if mx > lim.max or mn < lim.min:
+                raise ValueError(
+                    f"{what} exceed int32 range ({mn}..{mx}) and x64 is "
+                    f"disabled: rebase to region-relative offsets before "
+                    f"device transfer (see SeriesMatrix.device_arrays)")
+
+
 # ---------------------------------------------------------------------------
 # Grouped aggregation
 # ---------------------------------------------------------------------------
 
+def grouped_aggregate(gids, mask, ts, values, col_masks=(), *, num_groups,
+                      ops, has_col_masks=False):
+    """Host-validating wrapper around the jitted kernel (see below).
+
+    Rejects int64 inputs that would silently truncate when x64 is off."""
+    check_i64_safe(ts, what="grouped_aggregate ts")
+    check_i64_safe(*[v for v in values], what="grouped_aggregate values")
+    return _grouped_aggregate(gids, mask, ts, tuple(values), tuple(col_masks),
+                              num_groups=num_groups, ops=tuple(ops),
+                              has_col_masks=has_col_masks)
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups", "ops", "has_col_masks"))
-def grouped_aggregate(
+def _grouped_aggregate(
     gids: jax.Array,            # int32 [N] group id per row (invalid rows: any)
     mask: jax.Array,            # bool  [N] row validity (filter & padding)
     ts: jax.Array,              # int64/int32 [N] timestamps (for first/last)
@@ -189,13 +223,21 @@ def combine_group_ids(tag_gids: jax.Array, bucket_ids: jax.Array,
 # Sort-based merge + dedup
 # ---------------------------------------------------------------------------
 
+def sort_merge_dedup(series_ids, ts, seq, op_types, valid):
+    """Host-validating wrapper: rejects int64 ts/seq that would silently
+    truncate when x64 is off (rebase timestamps first)."""
+    check_i64_safe(ts, what="sort_merge_dedup ts")
+    check_i64_safe(seq, what="sort_merge_dedup seq")
+    return _sort_merge_dedup(series_ids, ts, seq, op_types, valid)
+
+
 @jax.jit
-def sort_merge_dedup(series_ids: jax.Array,  # int32 [N]
-                     ts: jax.Array,          # int64 [N]
-                     seq: jax.Array,         # int64 [N] write sequence
-                     op_types: jax.Array,    # int8  [N] OP_PUT / OP_DELETE
-                     valid: jax.Array,       # bool  [N] padding mask
-                     ) -> Tuple[jax.Array, jax.Array]:
+def _sort_merge_dedup(series_ids: jax.Array,  # int32 [N]
+                      ts: jax.Array,          # int[N] (rebased if x64 off)
+                      seq: jax.Array,         # int [N] write sequence
+                      op_types: jax.Array,    # int8  [N] OP_PUT / OP_DELETE
+                      valid: jax.Array,       # bool  [N] padding mask
+                      ) -> Tuple[jax.Array, jax.Array]:
     """Merge-sort rows from any number of concatenated runs and compute the
     MVCC keep-mask.
 

@@ -237,22 +237,23 @@ def range_aggregate_cumsum(
 
 @functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "series_block"))
 def range_aggregate_gather(
-    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+    ts2d: jax.Array, val2d: jax.Array,
     t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
     param: float = 0.0, param2: float = 0.0, series_block: int = 128,
 ) -> Tuple[jax.Array, jax.Array]:
     """Gather-path range functions: each window materializes ≤ maxw samples.
 
-    Windows longer than maxw are truncated to their most recent maxw samples
-    (callers size maxw from data density). Processed in series blocks via
-    lax.map to bound VMEM footprint."""
+    Row validity comes from the TS_PAD sentinel (padded slots sort last and
+    fall outside every window), so no lengths array is needed. Windows longer
+    than maxw are truncated to their most recent maxw samples (callers size
+    maxw from data density). Processed in series blocks via lax.map to bound
+    VMEM footprint."""
     S, L = ts2d.shape
     step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
     pad_s = (-S) % series_block
     pad_sentinel = jnp.iinfo(ts2d.dtype).max
     ts2d = jnp.pad(ts2d, ((0, pad_s), (0, 0)), constant_values=pad_sentinel)
     val2d = jnp.pad(val2d, ((0, pad_s), (0, 0)))
-    lengths = jnp.pad(lengths, (0, pad_s))
     SB = (S + pad_s) // series_block
 
     def block(args):
@@ -356,7 +357,7 @@ def _holt_winters(vals: jax.Array, mask: jax.Array, sf, tf) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("nsteps",))
-def instant_select(ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+def instant_select(ts2d: jax.Array, val2d: jax.Array,
                    t0, step, lookback_ms, *, nsteps: int
                    ) -> Tuple[jax.Array, jax.Array]:
     """InstantManipulate: at each step pick the latest sample within the

@@ -1,0 +1,3 @@
+from .engine import StorageEngine, EngineConfig  # noqa: F401
+from .region import Region, RegionDescriptor  # noqa: F401
+from .write_batch import WriteBatch, Mutation  # noqa: F401

@@ -1,0 +1,102 @@
+"""Storage engine: creates/opens regions and shares their infrastructure.
+
+Reference behavior: src/storage/src/engine.rs — `EngineImpl` keeps a region
+map, wires the shared object store / WAL / flush machinery into each region,
+and is the unit a table engine builds on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..datatypes import Schema
+from ..errors import RegionNotFoundError
+from .object_store import FsObjectStore, ObjectStore
+from .region import Region, RegionDescriptor
+from .wal import NoopWal
+
+
+@dataclass
+class EngineConfig:
+    data_home: str
+    flush_size_bytes: int = 64 * 1024 * 1024
+    wal_sync_on_write: bool = False
+    disable_wal: bool = False           # benchmarks / ephemeral regions
+    checkpoint_margin: int = 10
+    row_group_size: int = 65536
+
+
+class StorageEngine:
+    def __init__(self, config: EngineConfig,
+                 store: Optional[ObjectStore] = None):
+        self.config = config
+        self.store = store or FsObjectStore(os.path.join(config.data_home, "data"))
+        self.wal_home = os.path.join(config.data_home, "wal")
+        self._regions: Dict[str, Region] = {}
+        self._lock = threading.Lock()
+
+    def _descriptor(self, name: str, schema: Schema) -> RegionDescriptor:
+        return RegionDescriptor(
+            name=name, schema=schema,
+            region_dir=name,
+            wal_dir=os.path.join(self.wal_home, name))
+
+    def _region_kwargs(self) -> dict:
+        kwargs = dict(
+            flush_size_bytes=self.config.flush_size_bytes,
+            checkpoint_margin=self.config.checkpoint_margin,
+            row_group_size=self.config.row_group_size)
+        if self.config.disable_wal:
+            kwargs["wal"] = NoopWal()
+        return kwargs
+
+    def create_region(self, name: str, schema: Schema) -> Region:
+        with self._lock:
+            if name in self._regions:
+                return self._regions[name]
+            region = Region.create(self._descriptor(name, schema), self.store,
+                                   **self._region_kwargs())
+            self._regions[name] = region
+            return region
+
+    def open_region(self, name: str, schema: Optional[Schema] = None
+                    ) -> Optional[Region]:
+        """Open an existing region (schema recovered from its manifest)."""
+        with self._lock:
+            if name in self._regions:
+                return self._regions[name]
+            desc = self._descriptor(name, schema)
+            region = Region.open(desc, self.store, **self._region_kwargs())
+            if region is not None:
+                self._regions[name] = region
+            return region
+
+    def get_region(self, name: str) -> Region:
+        with self._lock:
+            region = self._regions.get(name)
+        if region is None:
+            raise RegionNotFoundError(f"region not found: {name}")
+        return region
+
+    def has_region(self, name: str) -> bool:
+        with self._lock:
+            return name in self._regions
+
+    def drop_region(self, name: str) -> None:
+        with self._lock:
+            region = self._regions.pop(name, None)
+        if region is not None:
+            region.drop()
+
+    def list_regions(self) -> Dict[str, Region]:
+        with self._lock:
+            return dict(self._regions)
+
+    def close(self) -> None:
+        with self._lock:
+            for region in self._regions.values():
+                region.close()
+            self._regions.clear()

@@ -1,0 +1,107 @@
+"""Object store abstraction.
+
+Reference behavior: src/object-store (opendal re-export with Fs/S3/OSS
+backends plus LRU disk cache). Here: a minimal Operator interface with a
+filesystem backend (atomic writes via rename); S3/GCS backends can slot in
+behind the same interface. TPU hosts read SSTs through this layer; the
+accelerator never touches it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import List, Optional
+
+
+class ObjectStore:
+    """Flat key → bytes store. Keys use '/' separators."""
+
+    def read(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+    def local_path(self, key: str) -> Optional[str]:
+        """If the object is addressable as a local file (for mmap/parquet
+        readers), return its path; else None and callers fall back to read()."""
+        return None
+
+
+class FsObjectStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key))
+        if not p.startswith(self.root):
+            raise ValueError(f"key escapes root: {key}")
+        return p
+
+    def read(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def write(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def delete_dir(self, key: str) -> None:
+        shutil.rmtree(self._path(key), ignore_errors=True)
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def list(self, prefix: str) -> List[str]:
+        base = self._path(prefix) if prefix else self.root
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                if fn.startswith(".tmp-"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(full, self.root).replace(os.sep, "/"))
+        return sorted(out)
+
+    def local_path(self, key: str) -> Optional[str]:
+        p = self._path(key)
+        return p if os.path.exists(p) else None
+
+
+def new_fs_object_store(root: str) -> FsObjectStore:
+    return FsObjectStore(root)

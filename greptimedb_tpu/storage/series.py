@@ -1,0 +1,93 @@
+"""Region-level series dictionary: tag tuples ↔ dense series ids.
+
+The TPU-first analogue of the reference's row keys (BTree keys in
+src/storage/src/memtable/btree.rs): every distinct combination of tag values
+gets a dense int32 `series_id`. Ids are insertion-ordered and append-only, so
+they stay stable across flushes — SSTs persist series ids alongside tag
+values, and the dictionary snapshot is persisted via the manifest so a
+reopened region keeps the same mapping. All group-by/merge/window kernels
+operate on these ids; strings never reach the device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..datatypes import Schema
+from ..ops.dictionary import Dictionary
+
+
+class SeriesDict:
+    def __init__(self, tag_names: Sequence[str]):
+        self.tag_names = list(tag_names)
+        self.tag_dicts: List[Dictionary] = [Dictionary() for _ in self.tag_names]
+        self.series = Dictionary()          # tuple(tag ids) -> series id
+        self._series_rows: List[Tuple[int, ...]] = []  # series id -> tag ids
+
+    @property
+    def num_series(self) -> int:
+        return len(self.series)
+
+    def encode_rows(self, tag_columns: Sequence[Sequence]) -> np.ndarray:
+        """tag_columns: one sequence per tag (aligned rows) → series ids."""
+        if not self.tag_names:
+            return np.zeros(len(tag_columns[0]) if tag_columns else 0, np.int32)
+        n = len(tag_columns[0])
+        ids_per_tag = [d.encode(col) for d, col in zip(self.tag_dicts, tag_columns)]
+        out = np.empty(n, dtype=np.int32)
+        series = self.series
+        rows = self._series_rows
+        for i in range(n):
+            key = tuple(int(ids[i]) for ids in ids_per_tag)
+            sid = series.get(key)
+            if sid is None:
+                sid = series.get_or_insert(key)
+                rows.append(key)
+            out[i] = sid
+        return out
+
+    def encode_zero_tags(self, n: int) -> np.ndarray:
+        """For tables without tags: every row is series 0."""
+        if self.series.get(()) is None:
+            self.series.get_or_insert(())
+            self._series_rows.append(())
+        return np.zeros(n, dtype=np.int32)
+
+    def decode_tag_column(self, series_ids: np.ndarray, tag_index: int) -> List:
+        d = self.tag_dicts[tag_index]
+        rows = self._series_rows
+        return [d.value(rows[int(s)][tag_index]) for s in series_ids]
+
+    def series_tag_matrix(self) -> np.ndarray:
+        """[num_series, num_tags] per-tag value ids — the device-side mapping
+        for group-by over a subset of tags."""
+        if not self._series_rows:
+            return np.zeros((0, len(self.tag_names)), dtype=np.int32)
+        return np.asarray(self._series_rows, dtype=np.int32)
+
+    def tag_value_id(self, tag_index: int, value) -> Optional[int]:
+        return self.tag_dicts[tag_index].get(value)
+
+    # ---- persistence ----
+    def to_dict(self) -> dict:
+        return {
+            "tag_names": self.tag_names,
+            "tag_values": [d.to_list() for d in self.tag_dicts],
+            "series": [list(t) for t in self._series_rows],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "SeriesDict":
+        sd = SeriesDict(d["tag_names"])
+        sd.tag_dicts = [Dictionary.from_list(vals) for vals in d["tag_values"]]
+        for row in d["series"]:
+            key = tuple(row)
+            sd.series.get_or_insert(key)
+            sd._series_rows.append(key)
+        return sd
+
+    @staticmethod
+    def for_schema(schema: Schema) -> "SeriesDict":
+        return SeriesDict(schema.tag_names())

@@ -1,0 +1,260 @@
+"""SST files: Parquet on object storage with stats-based pruning.
+
+Reference behavior: src/storage/src/sst.rs + sst/parquet.rs — two LSM levels,
+`FileMeta` with per-file time ranges, ParquetWriter with row-group stats,
+reader with row-group pruning + time-range row filtering.
+
+File layout: tag columns (dictionary-encoded), the time index, field columns,
+plus internal columns `__series_id` (int32, stable via the region's persisted
+SeriesDict), `__sequence` (int64), `__op_type` (int8). Rows are stored sorted
+by (series_id, ts, seq), so scans feed the device merge kernel directly and
+row groups cover disjoint-ish series/time ranges for pruning.
+"""
+
+from __future__ import annotations
+
+import io
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..common.time import TimestampRange
+from ..datatypes import RecordBatch, Schema, Vector
+from ..datatypes.vector import null_column
+from .object_store import ObjectStore
+
+SERIES_COL = "__series_id"
+SEQ_COL = "__sequence"
+OP_COL = "__op_type"
+MAX_LEVEL = 2
+DEFAULT_ROW_GROUP_SIZE = 65536
+
+
+@dataclass(frozen=True)
+class FileMeta:
+    file_name: str
+    level: int
+    time_range: Tuple[int, int]       # inclusive min/max ts
+    num_rows: int
+    file_size: int
+    max_sequence: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "file_name": self.file_name, "level": self.level,
+            "time_range": list(self.time_range), "num_rows": self.num_rows,
+            "file_size": self.file_size, "max_sequence": self.max_sequence,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "FileMeta":
+        return FileMeta(d["file_name"], d["level"], tuple(d["time_range"]),
+                        d["num_rows"], d["file_size"], d.get("max_sequence", 0))
+
+
+class LevelMetas:
+    """Files per level (0 = fresh flushes, 1 = compacted)."""
+
+    def __init__(self, levels: Optional[List[List[FileMeta]]] = None):
+        self.levels: List[List[FileMeta]] = levels or [[] for _ in range(MAX_LEVEL)]
+
+    def add_files(self, files: Sequence[FileMeta]) -> "LevelMetas":
+        new = [list(l) for l in self.levels]
+        for f in files:
+            new[f.level].append(f)
+        return LevelMetas(new)
+
+    def remove_files(self, names: Sequence[str]) -> "LevelMetas":
+        drop = set(names)
+        return LevelMetas([[f for f in l if f.file_name not in drop]
+                           for l in self.levels])
+
+    def all_files(self) -> List[FileMeta]:
+        return [f for l in self.levels for f in l]
+
+    def files_in_range(self, rng: Optional[TimestampRange]) -> List[FileMeta]:
+        files = self.all_files()
+        if rng is None:
+            return files
+        out = []
+        for f in files:
+            lo, hi = f.time_range
+            if rng.intersects(TimestampRange(lo, hi + 1, rng.unit)):
+                out.append(f)
+        return out
+
+    def to_dict(self) -> dict:
+        return {"levels": [[f.to_dict() for f in l] for l in self.levels]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "LevelMetas":
+        return LevelMetas([[FileMeta.from_dict(f) for f in l]
+                           for l in d["levels"]])
+
+
+@dataclass
+class SstData:
+    """Decoded SST contents (SoA, ready for the device merge kernel)."""
+    series_ids: np.ndarray
+    ts: np.ndarray
+    seq: np.ndarray
+    op_types: np.ndarray
+    fields: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
+    num_rows: int
+
+
+def new_sst_name() -> str:
+    return f"{uuid.uuid4().hex}.parquet"
+
+
+class AccessLayer:
+    """Writes/reads SSTs for one region directory on an object store
+    (reference: src/storage/src/sst.rs AccessLayer/FsAccessLayer)."""
+
+    def __init__(self, store: ObjectStore, sst_dir: str, schema: Schema,
+                 row_group_size: int = DEFAULT_ROW_GROUP_SIZE):
+        self.store = store
+        self.sst_dir = sst_dir.rstrip("/")
+        self.schema = schema
+        self.row_group_size = row_group_size
+
+    def _key(self, file_name: str) -> str:
+        return f"{self.sst_dir}/{file_name}"
+
+    # ---- write ----
+    def write_sst(self, *, level: int, series_ids: np.ndarray, ts: np.ndarray,
+                  seq: np.ndarray, op_types: np.ndarray,
+                  fields: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]],
+                  tag_columns: Dict[str, list]) -> Optional[FileMeta]:
+        """Write one SST from sorted SoA arrays. Returns None for empty input."""
+        n = len(ts)
+        if n == 0:
+            return None
+        schema = self.schema
+        arrays: List[pa.Array] = []
+        names: List[str] = []
+        for c in schema.column_schemas:
+            if c.is_tag:
+                arr = pa.array(tag_columns[c.name], type=c.dtype.pa_type)
+                arrays.append(arr.dictionary_encode())
+                names.append(c.name)
+            elif c.is_time_index:
+                arrays.append(pa.array(ts, type=pa.int64()).cast(c.dtype.pa_type))
+                names.append(c.name)
+            else:
+                data, validity = fields[c.name]
+                vec = Vector(c.dtype, data, validity)
+                arrays.append(vec.to_arrow())
+                names.append(c.name)
+        arrays.append(pa.array(series_ids, type=pa.int32()))
+        names.append(SERIES_COL)
+        arrays.append(pa.array(seq, type=pa.int64()))
+        names.append(SEQ_COL)
+        arrays.append(pa.array(op_types, type=pa.int8()))
+        names.append(OP_COL)
+        table = pa.table(dict(zip(names, arrays)))
+        sink = io.BytesIO()
+        pq.write_table(table, sink, row_group_size=self.row_group_size,
+                       compression="zstd", write_statistics=True)
+        data = sink.getvalue()
+        file_name = new_sst_name()
+        self.store.write(self._key(file_name), data)
+        return FileMeta(
+            file_name=file_name, level=level,
+            time_range=(int(ts.min()), int(ts.max())),
+            num_rows=n, file_size=len(data),
+            max_sequence=int(seq.max()) if n else 0)
+
+    # ---- read ----
+    def read_sst(self, meta: FileMeta, *,
+                 projection: Optional[Sequence[str]] = None,
+                 time_range: Optional[TimestampRange] = None) -> SstData:
+        """Read an SST with column projection and row-group time pruning."""
+        key = self._key(meta.file_name)
+        path = self.store.local_path(key)
+        src = path if path is not None else pa.BufferReader(self.store.read(key))
+        pf = pq.ParquetFile(src)
+        ts_name = self.schema.timestamp_column.name
+        ts_idx = pf.schema_arrow.get_field_index(ts_name)
+        groups = self._prune_row_groups(pf, ts_idx, time_range)
+        field_names = [c.name for c in self.schema.field_columns()
+                       if projection is None or c.name in projection]
+        # schema-compat: an SST written before an ALTER may lack new columns —
+        # absent columns read as nulls (reference: src/storage/src/schema/compat.rs)
+        present = set(pf.schema_arrow.names)
+        missing = [n for n in field_names if n not in present]
+        cols = [n for n in field_names if n in present] + [ts_name, SERIES_COL,
+                                                           SEQ_COL, OP_COL]
+        if not groups:
+            empty_fields = {
+                name: null_column(self.schema.column_schema(name).dtype, 0)
+                for name in field_names}
+            z64 = np.zeros(0, np.int64)
+            return SstData(np.zeros(0, np.int32), z64, z64,
+                           np.zeros(0, np.int8), empty_fields, 0)
+        table = pf.read_row_groups(groups, columns=cols, use_threads=True)
+        ts = np.asarray(table.column(ts_name).cast(pa.int64()))
+        sids = np.asarray(table.column(SERIES_COL))
+        seq = np.asarray(table.column(SEQ_COL))
+        op = np.asarray(table.column(OP_COL))
+        fields = {}
+        for name in field_names:
+            if name in missing:
+                fields[name] = null_column(
+                    self.schema.column_schema(name).dtype, table.num_rows)
+                continue
+            vec = Vector.from_arrow(table.column(name))
+            fields[name] = (vec.data, vec.validity)
+        return SstData(sids.astype(np.int32), ts.astype(np.int64),
+                       seq.astype(np.int64), op.astype(np.int8),
+                       fields, table.num_rows)
+
+    def read_tag_columns(self, meta: FileMeta,
+                         tag_names: Sequence[str]) -> Dict[str, list]:
+        key = self._key(meta.file_name)
+        path = self.store.local_path(key)
+        src = path if path is not None else pa.BufferReader(self.store.read(key))
+        table = pq.read_table(src, columns=list(tag_names) + [SERIES_COL])
+        return {n: table.column(n).to_pylist() for n in tag_names} | {
+            SERIES_COL: np.asarray(table.column(SERIES_COL)).astype(np.int32)}
+
+    def _np_dtype(self, field_name: str):
+        dt = self.schema.column_schema(field_name).dtype
+        return dt.np_dtype if dt.np_dtype is not None else object
+
+    def _prune_row_groups(self, pf: pq.ParquetFile, ts_idx: int,
+                          time_range: Optional[TimestampRange]) -> List[int]:
+        ngroups = pf.metadata.num_row_groups
+        if time_range is None:
+            return list(range(ngroups))
+        unit = self.schema.timestamp_column.dtype.time_unit
+        out = []
+        for g in range(ngroups):
+            col = pf.metadata.row_group(g).column(ts_idx)
+            stats = col.statistics
+            if stats is None or not stats.has_min_max:
+                out.append(g)
+                continue
+            lo = _ts_stat_to_int(stats.min, unit)
+            hi = _ts_stat_to_int(stats.max, unit)
+            if time_range.intersects(TimestampRange(lo, hi + 1, time_range.unit)):
+                out.append(g)
+        return out
+
+    def delete_sst(self, file_name: str) -> None:
+        self.store.delete(self._key(file_name))
+
+
+def _ts_stat_to_int(v, unit) -> int:
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    # pyarrow returns datetime for timestamp logical-typed stats
+    import datetime as _dt
+    from ..common.time import Timestamp
+    if isinstance(v, _dt.datetime):
+        return Timestamp.from_datetime(v, unit).value
+    return int(v)

@@ -1,0 +1,174 @@
+"""Write-ahead log: segmented, checksummed, per-region append log.
+
+Reference behavior: src/log-store/src/raft_engine/log_store.rs +
+src/storage/src/wal.rs — per-region namespaces, append(seq, payload),
+read_from(seq) for replay, obsolete(seq) truncation after flush. Host-side
+only; the accelerator never sees the WAL.
+
+Format: segment files `{first_seq:020d}.wal`, each a sequence of records:
+    [len u32][crc32 u32][seq u64][schema_version u32][payload]
+Records are append-only; fsync policy is configurable (group commit happens
+at the region writer level by batching mutations into one WriteBatch).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+from ..errors import StorageError
+
+_REC_HDR = struct.Struct("<IIQI")  # len, crc, seq, schema_version
+
+
+class Wal:
+    """WAL for one region, stored under `dir`."""
+
+    SEGMENT_BYTES = 64 * 1024 * 1024
+
+    def __init__(self, dir_path: str, *, sync_on_write: bool = False,
+                 segment_bytes: Optional[int] = None):
+        self.dir = dir_path
+        self.sync_on_write = sync_on_write
+        self.segment_bytes = segment_bytes or self.SEGMENT_BYTES
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._fh_path: Optional[str] = None
+        self._fh_size = 0
+
+    # ---- segments ----
+    def _segments(self) -> List[Tuple[int, str]]:
+        segs = []
+        for fn in os.listdir(self.dir):
+            if fn.endswith(".wal"):
+                try:
+                    segs.append((int(fn[:-4]), os.path.join(self.dir, fn)))
+                except ValueError:
+                    continue
+        segs.sort()
+        return segs
+
+    def _open_segment(self, first_seq: int) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        path = os.path.join(self.dir, f"{first_seq:020d}.wal")
+        self._fh = open(path, "ab")
+        self._fh_path = path
+        self._fh_size = self._fh.tell()
+
+    # ---- api ----
+    def append(self, seq: int, payload: bytes, schema_version: int = 0) -> None:
+        with self._lock:
+            if self._fh is None or self._fh_size >= self.segment_bytes:
+                self._open_segment(seq)
+            crc = zlib.crc32(payload)
+            rec = _REC_HDR.pack(len(payload), crc, seq, schema_version) + payload
+            self._fh.write(rec)
+            self._fh.flush()
+            if self.sync_on_write:
+                os.fsync(self._fh.fileno())
+            self._fh_size += len(rec)
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def read_from(self, start_seq: int) -> Iterator[Tuple[int, int, bytes]]:
+        """Yield (seq, schema_version, payload) for all records with
+        seq >= start_seq.
+
+        A torn/corrupt record in the FINAL segment is a crash mid-append and
+        terminates the scan cleanly; the same in an EARLIER segment means
+        acknowledged writes were lost (bit rot) — replay aborts with
+        StorageError rather than silently skipping to newer segments."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+            segs = self._segments()
+        for i, (first, path) in enumerate(segs):
+            # skip whole segments below start_seq (next segment's first seq
+            # bounds this one's contents)
+            if i + 1 < len(segs) and segs[i + 1][0] <= start_seq:
+                continue
+            records, clean = self._read_segment(path, start_seq)
+            yield from records
+            if not clean:
+                if i + 1 < len(segs):
+                    raise StorageError(
+                        f"corrupt WAL record mid-log in {path}; refusing to "
+                        f"replay past the gap")
+                return  # torn tail of the active segment: normal crash
+
+    def _read_segment(self, path: str, start_seq: int
+                      ) -> Tuple[List[Tuple[int, int, bytes]], bool]:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return [], True
+        out: List[Tuple[int, int, bytes]] = []
+        pos = 0
+        n = len(data)
+        while pos + _REC_HDR.size <= n:
+            ln, crc, seq, sv = _REC_HDR.unpack_from(data, pos)
+            body_start = pos + _REC_HDR.size
+            if body_start + ln > n:
+                return out, False  # torn record
+            payload = data[body_start:body_start + ln]
+            if zlib.crc32(payload) != crc:
+                return out, False  # corrupt record
+            pos = body_start + ln
+            if seq >= start_seq:
+                out.append((seq, sv, payload))
+        return out, pos == n
+
+    def obsolete(self, seq: int) -> None:
+        """Delete segments whose entire contents are <= seq."""
+        with self._lock:
+            segs = self._segments()
+            # a segment can be deleted if the NEXT segment starts at <= seq+1,
+            # meaning every record in it has seq <= that bound.
+            for i, (first, path) in enumerate(segs):
+                if i + 1 < len(segs) and segs[i + 1][0] <= seq + 1:
+                    if self._fh_path == path and self._fh is not None:
+                        continue  # never delete the active segment
+                    try:
+                        os.unlink(path)
+                    except OSError as e:  # pragma: no cover
+                        raise StorageError(f"wal gc failed: {e}", cause=e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+
+
+class NoopWal(Wal):
+    """WAL-less mode for tests/benchmarks (reference: src/log-store/src/noop.rs)."""
+
+    def __init__(self):  # noqa: super-init-not-called
+        self._lock = threading.Lock()
+
+    def append(self, seq, payload, schema_version=0):
+        pass
+
+    def sync(self):
+        pass
+
+    def read_from(self, start_seq):
+        return iter(())
+
+    def obsolete(self, seq):
+        pass
+
+    def close(self):
+        pass

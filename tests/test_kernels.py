@@ -193,12 +193,12 @@ class TestWindow:
     def test_min_max_gather(self):
         m = make_matrix()
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             60_000, 60_000, 60_000, op="max_over_time", nsteps=2, maxw=32)
         np.testing.assert_allclose(out[0, 0], 6.0)
         np.testing.assert_allclose(out[0, 1], 12.0)
         out, _ = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             60_000, 60_000, 60_000, op="min_over_time", nsteps=2, maxw=32)
         np.testing.assert_allclose(out[0, 0], 1.0)
 
@@ -250,7 +250,7 @@ class TestWindow:
         vals = np.array([1.0, 2.0, 3.0, 4.0])
         m = SeriesMatrix.build(np.zeros(4, np.int32), ts, vals, 1)
         out, _ = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             30_000, 30_000, 30_001, op="quantile_over_time", nsteps=1,
             maxw=8, param=0.5)
         np.testing.assert_allclose(out[0, 0], 2.5)
@@ -260,20 +260,20 @@ class TestWindow:
         vals = 2.0 * np.arange(5) + 3.0  # slope 2 per 10s = 0.2/s
         m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             40_000, 40_000, 40_001, op="deriv", nsteps=1, maxw=8)
         np.testing.assert_allclose(out[0, 0], 0.2, rtol=1e-9)
 
     def test_instant_select_lookback(self):
         m = make_matrix()
         vals, ok = instant_select(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             55_000, 100_000, 300_000, nsteps=1)
         # series 1 latest sample at 50s (value 5.0) within 5m lookback
         assert bool(ok[1, 0]) and vals[1, 0] == 5.0
         # short lookback (1s) → no point
         vals, ok = instant_select(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             55_000, 100_000, 1_000, nsteps=1)
         assert not bool(ok[1, 0])
 
@@ -342,7 +342,7 @@ class TestReviewRegressions:
         vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
         m = SeriesMatrix.build(np.zeros(6, np.int32), ts, vals, 1)
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            jnp.asarray(m.ts), jnp.asarray(m.values),
             50_000, 50_000, 50_001, op="holt_winters", nsteps=1, maxw=8,
             param=0.5, param2=0.5)
         assert bool(ok[0, 0])

@@ -1,0 +1,376 @@
+"""Storage engine tests: WAL, memtable, SST, manifest, region lifecycle.
+
+Mirrors reference suites: src/storage/src/wal.rs tests, memtable/tests.rs,
+region/tests/{basic,flush,alter,projection}.rs, manifest/region.rs tests.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.common.time import TimestampRange
+from greptimedb_tpu.datatypes import (
+    FLOAT64, INT64, STRING, TIMESTAMP_MILLISECOND, ColumnSchema, Schema,
+    SemanticType,
+)
+from greptimedb_tpu.storage import EngineConfig, StorageEngine, WriteBatch
+from greptimedb_tpu.storage.object_store import FsObjectStore
+from greptimedb_tpu.storage.manifest import RegionManifest
+from greptimedb_tpu.storage.wal import Wal
+
+
+def monitor_schema() -> Schema:
+    return Schema([
+        ColumnSchema("host", STRING, nullable=False, semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", FLOAT64),
+        ColumnSchema("memory", FLOAT64),
+    ])
+
+
+def make_engine(tmp_path, **kwargs) -> StorageEngine:
+    return StorageEngine(EngineConfig(data_home=str(tmp_path), **kwargs))
+
+
+def put_rows(region, hosts, ts, cpu, memory=None):
+    wb = WriteBatch(region.version_control.current.schema)
+    wb.put({"host": hosts, "ts": ts, "cpu": cpu,
+            "memory": memory if memory is not None else [0.0] * len(hosts)})
+    return region.write(wb)
+
+
+class TestWal:
+    def test_roundtrip(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"))
+        for i in range(1, 6):
+            wal.append(i, f"payload-{i}".encode(), schema_version=2)
+        got = list(wal.read_from(3))
+        assert [(s, v, p.decode()) for s, v, p in got] == [
+            (3, 2, "payload-3"), (4, 2, "payload-4"), (5, 2, "payload-5")]
+        wal.close()
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"))
+        wal.append(1, b"good")
+        wal.close()
+        # corrupt: append garbage half-record
+        segs = [f for f in os.listdir(tmp_path / "wal") if f.endswith(".wal")]
+        with open(tmp_path / "wal" / segs[0], "ab") as f:
+            f.write(b"\xff\x13\x07")
+        wal2 = Wal(str(tmp_path / "wal"))
+        got = list(wal2.read_from(0))
+        assert len(got) == 1 and got[0][2] == b"good"
+
+    def test_obsolete_deletes_old_segments(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"), segment_bytes=64)
+        for i in range(1, 11):
+            wal.append(i, b"x" * 100)  # forces one segment per record
+        assert len([f for f in os.listdir(tmp_path / "wal")]) == 10
+        wal.obsolete(8)
+        remaining = sorted(os.listdir(tmp_path / "wal"))
+        assert len(remaining) < 10
+        got = [s for s, _, _ in wal.read_from(9)]
+        assert got == [9, 10]
+        wal.close()
+
+
+class TestManifest:
+    def test_log_and_recover(self, tmp_path):
+        store = FsObjectStore(str(tmp_path))
+        m = RegionManifest(store, "r1/manifest")
+        m.save([{"type": "change", "schema": {"v": 1}}])
+        m.save([{"type": "edit", "added": ["f1"]}])
+        m2 = RegionManifest(store, "r1/manifest")
+        state, actions = m2.load()
+        assert state is None
+        assert [a["type"] for a in actions] == ["change", "edit"]
+        # writer resumes past recovered version
+        v = m2.save([{"type": "edit", "added": ["f2"]}])
+        assert v == 2
+
+    def test_checkpoint_and_gc(self, tmp_path):
+        store = FsObjectStore(str(tmp_path))
+        m = RegionManifest(store, "r1/manifest", checkpoint_margin=3)
+        for i in range(4):
+            m.save([{"type": "edit", "i": i}])
+        assert m.should_checkpoint()
+        m.save_checkpoint({"snapshot": True})
+        m.gc()
+        state, actions = RegionManifest(store, "r1/manifest").load()
+        assert state == {"snapshot": True}
+        assert actions == []
+        # new actions after checkpoint are replayed
+        m.save([{"type": "edit", "i": 99}])
+        state, actions = RegionManifest(store, "r1/manifest").load()
+        assert state == {"snapshot": True} and actions[0]["i"] == 99
+
+
+class TestRegionBasic:
+    def test_write_and_scan(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a", "b", "a"], [1000, 1000, 2000], [0.1, 0.2, 0.3])
+        snap = r.snapshot()
+        data = snap.read_merged()
+        assert data.num_rows == 3
+        # sorted by (series, ts): a@1000, a@2000, b@1000
+        hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+        assert hosts == ["a", "a", "b"]
+        assert data.ts.tolist() == [1000, 2000, 1000]
+        np.testing.assert_allclose(data.fields["cpu"][0], [0.1, 0.3, 0.2])
+        eng.close()
+
+    def test_overwrite_same_key(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        put_rows(r, ["a"], [1000], [0.9])
+        data = r.snapshot().read_merged()
+        assert data.num_rows == 1
+        np.testing.assert_allclose(data.fields["cpu"][0], [0.9])
+        eng.close()
+
+    def test_delete(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a", "b"], [1000, 1000], [0.1, 0.2])
+        wb = WriteBatch(r.version_control.current.schema)
+        wb.delete({"host": ["a"], "ts": [1000]})
+        r.write(wb)
+        data = r.snapshot().read_merged()
+        assert data.num_rows == 1
+        assert data.series_dict.decode_tag_column(data.series_ids, 0) == ["b"]
+        eng.close()
+
+    def test_snapshot_isolation(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        snap = r.snapshot()         # visible seq = 1
+        put_rows(r, ["a"], [2000], [0.2])
+        assert snap.read_merged().num_rows == 1
+        assert r.snapshot().read_merged().num_rows == 2
+        eng.close()
+
+    def test_time_range_scan(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"] * 5, [0, 1000, 2000, 3000, 4000],
+                 [0.0, 0.1, 0.2, 0.3, 0.4])
+        data = r.snapshot().read_merged(time_range=TimestampRange(1000, 3000))
+        assert data.ts.tolist() == [1000, 2000]
+        eng.close()
+
+
+class TestFlushRecovery:
+    def test_flush_creates_sst_and_scan_merges(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a", "b"], [1000, 1000], [0.1, 0.2])
+        files = r.flush()
+        assert len(files) == 1 and files[0].num_rows == 2
+        assert files[0].time_range == (1000, 1000)
+        # post-flush writes overwrite flushed rows through the merge
+        put_rows(r, ["a"], [1000], [0.7])
+        data = r.snapshot().read_merged()
+        assert data.num_rows == 2
+        hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+        cpu = dict(zip(hosts, data.fields["cpu"][0]))
+        np.testing.assert_allclose(cpu["a"], 0.7)
+        eng.close()
+
+    def test_crash_recovery_wal_replay(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        r.flush()
+        put_rows(r, ["b"], [2000], [0.2])  # only in WAL + memtable
+        # simulate crash: no close/flush; reopen from disk
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert r2 is not None
+        data = r2.snapshot().read_merged()
+        assert data.num_rows == 2
+        hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+        assert sorted(hosts) == ["a", "b"]
+        # sequences continue after recovery
+        put_rows(r2, ["c"], [3000], [0.3])
+        assert r2.snapshot().read_merged().num_rows == 3
+        eng2.close()
+
+    def test_series_ids_stable_across_restart(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a", "b", "c"], [1, 1, 1], [0.1, 0.2, 0.3])
+        r.flush()
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        # same ids as before restart
+        assert r2.series_dict.series.get((0,)) == 0
+        assert [r2.series_dict.tag_dicts[0].value(i) for i in range(3)] == \
+            ["a", "b", "c"]
+        put_rows(r2, ["b", "d"], [2, 2], [0.5, 0.6])
+        data = r2.snapshot().read_merged()
+        hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+        assert sorted(hosts) == ["a", "b", "b", "c", "d"]
+        eng2.close()
+
+    def test_open_missing_region_returns_none(self, tmp_path):
+        eng = make_engine(tmp_path)
+        assert eng.open_region("nope/r9") is None
+
+    def test_flush_wal_truncation(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        for i in range(5):
+            put_rows(r, ["a"], [i * 1000], [float(i)])
+        r.flush()
+        # reopen: nothing to replay, all rows from SST
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert r2.snapshot().read_merged().num_rows == 5
+        assert r2.version_control.committed_sequence == 5
+        eng2.close()
+
+    def test_checkpoint_recovery(self, tmp_path):
+        eng = make_engine(tmp_path, checkpoint_margin=2)
+        r = eng.create_region("t/r0", monitor_schema())
+        for i in range(6):
+            put_rows(r, ["a"], [i * 1000], [float(i)])
+            r.flush()
+        eng2 = make_engine(tmp_path, checkpoint_margin=2)
+        r2 = eng2.open_region("t/r0")
+        assert r2.snapshot().read_merged().num_rows == 6
+        eng2.close()
+
+
+class TestAlter:
+    def test_add_column(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        r.flush()
+        old = r.version_control.current.schema
+        new_schema = Schema(list(old.column_schemas) +
+                            [ColumnSchema("disk", FLOAT64)], version=old.version)
+        r.alter(new_schema)
+        wb = WriteBatch(r.version_control.current.schema)
+        wb.put({"host": ["b"], "ts": [2000], "cpu": [0.2], "memory": [1.0],
+                "disk": [99.0]})
+        r.write(wb)
+        data = r.snapshot().read_merged()
+        assert data.num_rows == 2
+        disk, valid = data.fields["disk"]
+        # old SST row reads disk as null; new row has 99.0
+        hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+        by_host = {h: (d, v) for h, d, v in zip(hosts, disk, valid)}
+        assert by_host["a"][1] == False  # noqa: E712
+        assert by_host["b"][0] == 99.0 and bool(by_host["b"][1])
+        eng.close()
+
+    def test_alter_survives_restart(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        old = r.version_control.current.schema
+        r.alter(Schema(list(old.column_schemas) +
+                       [ColumnSchema("disk", FLOAT64)]))
+        wb = WriteBatch(r.version_control.current.schema)
+        wb.put({"host": ["a"], "ts": [1000], "cpu": [0.1], "memory": [0.5],
+                "disk": [42.0]})
+        r.write(wb)
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert r2.version_control.current.schema.contains("disk")
+        data = r2.snapshot().read_merged()
+        assert data.fields["disk"][0].tolist() == [42.0]
+        eng2.close()
+
+
+class TestProjectionAndDrop:
+    def test_projection(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1], [2048.0])
+        r.flush()
+        data = r.snapshot().read_merged(projection=["cpu"])
+        assert set(data.fields.keys()) == {"cpu"}
+        eng.close()
+
+    def test_drop(self, tmp_path):
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        eng.drop_region("t/r0")
+        eng2 = make_engine(tmp_path)
+        assert eng2.open_region("t/r0") is None
+
+
+class TestReviewRegressions:
+    def test_create_over_existing_region_rejected(self, tmp_path):
+        from greptimedb_tpu.errors import StorageError
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        put_rows(r, ["a"], [1000], [0.1])
+        r.flush()
+        eng.close()
+        eng2 = make_engine(tmp_path)
+        with pytest.raises(StorageError, match="already exists"):
+            eng2.create_region("t/r0", monitor_schema())
+        # open still works and sees the data
+        r2 = eng2.open_region("t/r0")
+        assert r2.snapshot().read_merged().num_rows == 1
+        eng2.close()
+
+    def test_wal_midlog_corruption_aborts_replay(self, tmp_path):
+        from greptimedb_tpu.errors import StorageError
+        wal = Wal(str(tmp_path / "wal"), segment_bytes=64)
+        for i in range(1, 4):
+            wal.append(i, b"y" * 100)  # one segment per record
+        wal.close()
+        segs = sorted(os.listdir(tmp_path / "wal"))
+        # corrupt the FIRST segment's payload byte
+        p = tmp_path / "wal" / segs[0]
+        raw = bytearray(p.read_bytes())
+        raw[-1] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        wal2 = Wal(str(tmp_path / "wal"))
+        with pytest.raises(StorageError, match="mid-log"):
+            list(wal2.read_from(0))
+
+    def test_nullable_time_index_rejected(self):
+        from greptimedb_tpu.datatypes import Schema, ColumnSchema, SemanticType
+        from greptimedb_tpu.datatypes import TIMESTAMP_MILLISECOND
+        with pytest.raises(ValueError, match="non-nullable"):
+            Schema([ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=True,
+                                 semantic_type=SemanticType.TIMESTAMP)])
+
+    def test_put_recordbatch_schema_mismatch_rejected(self, tmp_path):
+        from greptimedb_tpu.datatypes import (
+            RecordBatch, Schema, ColumnSchema, FLOAT64)
+        from greptimedb_tpu.errors import InvalidArgumentsError
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        bad_schema = Schema([ColumnSchema("x", FLOAT64)])
+        bad = RecordBatch.from_pydict(bad_schema, {"x": [1.0]})
+        wb = WriteBatch(r.version_control.current.schema)
+        with pytest.raises(InvalidArgumentsError, match="columns"):
+            wb.put(bad)
+        eng.close()
+
+    def test_i64_guard_without_x64(self):
+        import jax
+        from greptimedb_tpu.ops.kernels import sort_merge_dedup
+        if jax.config.jax_enable_x64:
+            # simulate the TPU default inside this test only
+            jax.config.update("jax_enable_x64", False)
+            try:
+                ts = np.array([1_700_000_000_000, 1_700_000_000_000 + 2**32],
+                              dtype=np.int64)
+                with pytest.raises(ValueError, match="rebase"):
+                    sort_merge_dedup(np.zeros(2, np.int32), ts,
+                                     np.arange(2, dtype=np.int64),
+                                     np.zeros(2, np.int8), np.ones(2, bool))
+            finally:
+                jax.config.update("jax_enable_x64", True)
