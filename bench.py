@@ -1,0 +1,96 @@
+"""Flagship benchmark: TSBS-style scan+aggregate throughput on TPU.
+
+Models the north-star config (BASELINE.json): TSBS cpu-only
+`single-groupby`-shape query — time-range filter, group by host tag and
+1-minute time buckets, aggregate 5 metric columns — over synthetic devops
+rows resident in HBM (the memtable layout of greptimedb_tpu).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`vs_baseline` is the speedup vs a same-machine CPU columnar baseline
+(pandas groupby over the identical arrays — the stand-in denominator for
+"CPU DataFusion" since the reference publishes no numbers, BASELINE.md).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def gen_data(n_rows: int, hosts: int, seed: int = 42):
+    rng = np.random.default_rng(seed)
+    gids = rng.integers(0, hosts, n_rows).astype(np.int32)
+    # one hour of data, ms resolution, int32-safe offsets
+    ts = rng.integers(0, 3_600_000, n_rows).astype(np.int32)
+    metrics = [rng.random(n_rows, dtype=np.float32) * 100 for _ in range(5)]
+    return gids, ts, metrics
+
+
+def bench_tpu(gids, ts, metrics, hosts, buckets, iters=5):
+    import jax
+    import jax.numpy as jnp
+    from greptimedb_tpu.ops.kernels import (
+        combine_group_ids, grouped_aggregate, time_bucket_ids)
+
+    num_groups = hosts * buckets
+    ops = ("avg",) * 5
+
+    @jax.jit
+    def step(gids, ts, m0, m1, m2, m3, m4):
+        mask = (ts >= 0) & (ts < 3_600_000)
+        b = time_bucket_ids(ts, 0, 60_000, buckets)
+        full = combine_group_ids(gids, b, buckets)
+        return grouped_aggregate(full, mask, ts, (m0, m1, m2, m3, m4),
+                                 num_groups=num_groups, ops=ops)
+
+    d_gids = jax.device_put(gids)
+    d_ts = jax.device_put(ts)
+    d_metrics = [jax.device_put(m) for m in metrics]
+    jax.block_until_ready(step(d_gids, d_ts, *d_metrics))  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(d_gids, d_ts, *d_metrics)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return len(gids) / dt, out
+
+
+def bench_cpu(gids, ts, metrics, hosts, buckets):
+    """CPU columnar baseline: pandas groupby over identical data."""
+    import pandas as pd
+    df = pd.DataFrame({"host": gids, "bucket": (ts // 60_000)})
+    for i, m in enumerate(metrics):
+        df[f"m{i}"] = m
+    t0 = time.perf_counter()
+    df[(ts >= 0) & (ts < 3_600_000)].groupby(["host", "bucket"]).agg(
+        {f"m{i}": "mean" for i in range(5)})
+    dt = time.perf_counter() - t0
+    return len(gids) / dt
+
+
+def main():
+    n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
+    hosts, buckets = 8, 60
+    gids, ts, metrics = gen_data(n_rows, hosts)
+
+    tpu_rps, out = bench_tpu(gids, ts, metrics, hosts, buckets)
+
+    # sanity: TPU result must agree with a numpy oracle on one group
+    avg0 = np.asarray(out[0][0]).reshape(hosts, buckets)
+    sel = (gids == 0) & (ts // 60_000 == 0)
+    if sel.any():
+        assert abs(float(avg0[0, 0]) - float(metrics[0][sel].mean())) < 1e-2
+
+    cpu_rps = bench_cpu(gids, ts, metrics, hosts, buckets)
+
+    print(json.dumps({
+        "metric": "tsbs_single_groupby_scan_agg_throughput",
+        "value": round(tpu_rps / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

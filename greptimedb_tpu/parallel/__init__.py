@@ -1,0 +1,37 @@
+"""Distributed execution over JAX device meshes.
+
+The reference scales by placing table regions on datanodes and fanning scans
+out over gRPC (SURVEY.md §2.7/§2.8; reference: src/partition, src/frontend/
+src/table.rs:109-156). Here the same two axes exist as a
+`jax.sharding.Mesh`:
+
+- ``region`` axis — the DCN/host axis: table regions (horizontal partitions)
+  live on different hosts; cross-region partial aggregates reduce over it.
+- ``block`` axis — the ICI/chip axis: rows within a region are blocked over
+  the chips of one host.
+
+All collectives are XLA collectives (psum/pmin/pmax/ppermute/all_gather)
+emitted by `shard_map` — there is no NCCL/MPI translation layer.
+"""
+
+from .mesh import (
+    make_mesh,
+    mesh_axes,
+    pad_rows_to_multiple,
+    ROW_AXES,
+)
+from .aggregate import distributed_grouped_aggregate
+from .window import (
+    series_sharded_range_aggregate,
+    time_blocked_window_sum,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_axes",
+    "pad_rows_to_multiple",
+    "ROW_AXES",
+    "distributed_grouped_aggregate",
+    "series_sharded_range_aggregate",
+    "time_blocked_window_sum",
+]

@@ -1,0 +1,64 @@
+"""Device-mesh construction and row-sharding helpers.
+
+A query's row stream is sharded over the full mesh (both axes flattened):
+each device holds an equal, padded slice of the scan. Group-by results are
+tiny (num_groups entries) and are kept replicated after an all-reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Mesh axis names. "region" is the cross-host (DCN) axis regions shard over;
+# "block" is the within-host (ICI) axis row blocks shard over.
+REGION_AXIS = "region"
+BLOCK_AXIS = "block"
+ROW_AXES = (REGION_AXIS, BLOCK_AXIS)
+
+
+def _split_factor(n: int) -> Tuple[int, int]:
+    """Factor n into (region, block) with region <= block, preferring a
+    near-square split so both collectives axes get exercised."""
+    best = (1, n)
+    for r in range(1, int(np.sqrt(n)) + 1):
+        if n % r == 0:
+            best = (r, n // r)
+    return best
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              region: Optional[int] = None,
+              block: Optional[int] = None) -> Mesh:
+    """Build a 2D ("region", "block") mesh over the given devices.
+
+    With neither axis size given, factors the device count near-square.
+    On a single device this yields a (1, 1) mesh: the same code path runs
+    unsharded (shard_map with full specs) so tests and production share code.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if region is None and block is None:
+        region, block = _split_factor(n)
+    elif region is None:
+        region = n // block
+    elif block is None:
+        block = n // region
+    if region * block != n:
+        raise ValueError(f"mesh {region}x{block} != {n} devices")
+    arr = np.asarray(devs).reshape(region, block)
+    return Mesh(arr, ROW_AXES)
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def pad_rows_to_multiple(n: int, multiple: int) -> int:
+    """Rows per device must be equal across the mesh; round n up."""
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple

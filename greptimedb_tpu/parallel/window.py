@@ -1,0 +1,180 @@
+"""Sequence/series-parallel window evaluation over the mesh.
+
+The reference scales the time axis with pruned time-range SSTs and streaming
+`RangeArray` windows (SURVEY.md §5 "long-context analog"). On a mesh the two
+long-context strategies are:
+
+- **series sharding** (Ulysses analog): each device owns a slice of the
+  series axis and evaluates windows for its series entirely locally —
+  PromQL's per-series independence means zero collectives until the final
+  cross-series aggregation.
+- **time blocking** (ring/blockwise analog): the time axis is split into
+  contiguous blocks across devices; a window straddling a block boundary
+  needs the tail of the previous block, which arrives as a halo via
+  `ppermute` along the block axis — one neighbor hop, never a broadcast.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.window import (
+    CUMSUM_OPS,
+    GATHER_OPS,
+    range_aggregate_cumsum,
+    range_aggregate_gather,
+    TS_PAD,
+)
+from .mesh import BLOCK_AXIS, REGION_AXIS, ROW_AXES
+
+
+def _range_dispatch(ts2d, val2d, lengths, t0, step, range_ms, *, op, nsteps,
+                    maxw, param, param2):
+    if op in CUMSUM_OPS:
+        return range_aggregate_cumsum(ts2d, val2d, lengths, t0, step,
+                                      range_ms, op=op, nsteps=nsteps,
+                                      param=param)
+    if op in GATHER_OPS:
+        return range_aggregate_gather(ts2d, val2d, t0, step, range_ms, op=op,
+                                      nsteps=nsteps, maxw=maxw, param=param,
+                                      param2=param2)
+    raise ValueError(f"unknown range op: {op}")
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "mesh"))
+def _series_sharded(ts2d, val2d, lengths, t0, step, range_ms, param, param2,
+                    *, op, nsteps, maxw, mesh):
+    inner = functools.partial(_range_dispatch, op=op, nsteps=nsteps, maxw=maxw)
+    fn = lambda t, v, l, a, b, c, p, p2: inner(t, v, l, a, b, c, param=p,
+                                               param2=p2)
+    srow = P(ROW_AXES, None)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(srow, srow, P(ROW_AXES), P(), P(), P(), P(), P()),
+        out_specs=(srow, srow), check_vma=False)(
+        ts2d, val2d, lengths, t0, step, range_ms, param, param2)
+
+
+def series_sharded_range_aggregate(
+    ts2d: np.ndarray, val2d: np.ndarray, lengths: np.ndarray,
+    t0, step, range_ms, *, op: str, nsteps: int, mesh: Mesh,
+    maxw: int = 128, param: float = 0.0, param2: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate a range function with the series axis sharded over the mesh.
+
+    Pads the series axis to a mesh multiple (padded series produce ok=False
+    rows that are sliced off). Returns (result [S, nsteps], ok [S, nsteps]).
+    """
+    S = ts2d.shape[0]
+    pad = (-S) % mesh.size if mesh.size > 1 else 0
+    if S == 0:
+        raise ValueError("series_sharded_range_aggregate: empty series axis")
+    if ts2d.dtype == np.int64 and not jax.config.jax_enable_x64:
+        # jnp silently narrows int64→int32 when x64 is off; rebase instead
+        # of truncating (callers with epoch-ms timestamps should pass the
+        # SeriesMatrix.device_arrays form — this is the safety net)
+        finite = ts2d[ts2d != TS_PAD]
+        lo = int(finite.min()) if finite.size else 0
+        hi = int(finite.max()) if finite.size else 0
+        if hi - lo >= 2**31 - 1:
+            raise ValueError("timestamp span exceeds int32; rebase first")
+        ts2d = np.where(ts2d == TS_PAD, np.iinfo(np.int32).max,
+                        ts2d - lo).astype(np.int32)
+        t0, step, range_ms = int(t0) - lo, int(step), int(range_ms)
+    if pad:
+        ts2d = np.pad(ts2d, ((0, pad), (0, 0)), constant_values=TS_PAD)
+        val2d = np.pad(val2d, ((0, pad), (0, 0)))
+        lengths = np.pad(lengths, (0, pad))
+    shard2d = NamedSharding(mesh, P(ROW_AXES, None))
+    shard1d = NamedSharding(mesh, P(ROW_AXES))
+    out, ok = _series_sharded(
+        jax.device_put(ts2d, shard2d), jax.device_put(val2d, shard2d),
+        jax.device_put(lengths, shard1d),
+        jnp.asarray(t0, ts2d.dtype), jnp.asarray(step, ts2d.dtype),
+        jnp.asarray(range_ms, ts2d.dtype),
+        jnp.asarray(param, val2d.dtype), jnp.asarray(param2, val2d.dtype),
+        op=op, nsteps=nsteps, maxw=maxw, mesh=mesh)
+    return out[:S], ok[:S]
+
+
+def _blocked_window(vals, window: int, op: str):
+    """Per-shard: trailing-window reduce over a dense step grid with a halo
+    of (window-1) columns fetched from the left neighbor over ICI."""
+    S, T = vals.shape
+    halo = window - 1
+    if halo > 0:
+        nblocks = jax.lax.axis_size(BLOCK_AXIS)
+        tail = vals[:, T - halo:]
+        perm = [(i, i + 1) for i in range(nblocks - 1)]
+        left = jax.lax.ppermute(tail, BLOCK_AXIS, perm)  # block 0 gets zeros
+        if op in ("min", "max"):
+            # zero is not the identity for min/max: block 0's halo (which
+            # ppermute leaves zero-filled) must be ±inf instead
+            ident0 = jnp.array(jnp.inf if op == "min" else -jnp.inf,
+                               vals.dtype)
+            is_first = jax.lax.axis_index(BLOCK_AXIS) == 0
+            left = jnp.where(is_first, ident0, left)
+        ext = jnp.concatenate([left, vals], axis=1)      # [S, halo + T]
+    else:
+        ext = vals
+    if op == "sum" or op == "avg":
+        cs = jnp.cumsum(ext.astype(jnp.float32), axis=1)
+        csp = jnp.concatenate([jnp.zeros((S, 1), jnp.float32), cs], axis=1)
+        out = csp[:, window:] - csp[:, :-window] if halo else csp[:, 1:] - csp[:, :-1]
+        if op == "avg":
+            out = out / window
+        return out.astype(vals.dtype)
+    if op in ("min", "max"):
+        # log-step doubling (associative trailing reduce)
+        acc = ext
+        shift = 1
+        red = jnp.minimum if op == "min" else jnp.maximum
+        ident = jnp.array(jnp.inf if op == "min" else -jnp.inf, ext.dtype)
+        while shift < window:
+            take = min(shift, window - shift)
+            rolled = jnp.concatenate(
+                [jnp.full((S, take), ident), acc[:, :-take]], axis=1)
+            acc = red(acc, rolled)
+            shift += take
+        return acc[:, halo:]
+    raise ValueError(f"unsupported blocked window op: {op}")
+
+
+@functools.partial(jax.jit, static_argnames=("window", "op", "mesh"))
+def _time_blocked(vals, *, window, op, mesh):
+    fn = functools.partial(_blocked_window, window=window, op=op)
+    spec = P(REGION_AXIS, BLOCK_AXIS)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                         check_vma=False)(vals)
+
+
+def time_blocked_window_sum(vals: np.ndarray, *, window: int, op: str = "sum",
+                            mesh: Mesh) -> jax.Array:
+    """Trailing-window reduce over a dense [series, steps] grid with the time
+    axis sharded over the block axis (the downsampling inner loop).
+
+    result[s, t] = op(vals[s, t-window+1 .. t]); leading steps treat
+    out-of-range samples as 0 (sum/avg) or identity (min/max). The series
+    axis shards over the region axis. Requires steps % block_axis == 0 and
+    window-1 <= steps per block (one-hop halo).
+    """
+    region_n, block_n = (mesh.shape[REGION_AXIS], mesh.shape[BLOCK_AXIS])
+    S, T = vals.shape
+    pad_s = (-S) % region_n
+    if pad_s:
+        vals = np.pad(vals, ((0, pad_s), (0, 0)))
+    if T % block_n:
+        raise ValueError(f"steps {T} not divisible by block axis {block_n}")
+    if window - 1 > T // block_n:
+        raise ValueError(f"window {window} exceeds one block + halo "
+                         f"({T // block_n} steps/block)")
+    sharding = NamedSharding(mesh, P(REGION_AXIS, BLOCK_AXIS))
+    out = _time_blocked(jax.device_put(vals, sharding), window=window, op=op,
+                        mesh=mesh)
+    return out[:S]
