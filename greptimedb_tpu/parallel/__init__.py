@@ -16,7 +16,6 @@ emitted by `shard_map` — there is no NCCL/MPI translation layer.
 
 from .mesh import (
     make_mesh,
-    mesh_axes,
     pad_rows_to_multiple,
     ROW_AXES,
 )
@@ -28,7 +27,6 @@ from .window import (
 
 __all__ = [
     "make_mesh",
-    "mesh_axes",
     "pad_rows_to_multiple",
     "ROW_AXES",
     "distributed_grouped_aggregate",

@@ -119,11 +119,12 @@ def _partial_aggregate(gids, mask, ts, row_idx, values, col_masks, *,
                 safe_gids, num_segments=seg)[:num_groups]
             have = (win_local == win) & (win < _BIG_IDX) & (local_pos < n_local)
             safe_pos = jnp.minimum(local_pos, n_local - 1)
-            contrib = jnp.where(have, col[safe_pos], 0).astype(jnp.float32)
+            # exactly one shard contributes, so a native-dtype psum is an
+            # exact gather (no float32 round-trip for int/f64 columns)
+            contrib = jnp.where(have, col[safe_pos], jnp.zeros((), col.dtype))
             val = jax.lax.psum(contrib, axes)
             empty = jnp.nan if jnp.issubdtype(col.dtype, jnp.floating) else 0
-            results.append(jnp.where(win < _BIG_IDX, val.astype(col.dtype),
-                                     empty))
+            results.append(jnp.where(win < _BIG_IDX, val, empty))
         else:
             raise ValueError(f"unsupported agg op: {op}")
     return tuple(results), counts
@@ -158,6 +159,7 @@ def distributed_grouped_aggregate(
     collectives. Results/counts come back replicated.
     """
     check_i64_safe(ts, what="distributed_grouped_aggregate ts")
+    check_i64_safe(*values, what="distributed_grouped_aggregate values")
     for op in ops:
         if op not in AGG_OPS:
             raise ValueError(f"unsupported agg op: {op}")

@@ -53,10 +53,6 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(arr, ROW_AXES)
 
 
-def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(mesh.axis_names)
-
-
 def pad_rows_to_multiple(n: int, multiple: int) -> int:
     """Rows per device must be equal across the mesh; round n up."""
     if multiple <= 1:

@@ -35,7 +35,7 @@ from .mesh import BLOCK_AXIS, REGION_AXIS, ROW_AXES
 
 
 def _range_dispatch(ts2d, val2d, lengths, t0, step, range_ms, *, op, nsteps,
-                    maxw, param, param2):
+                    maxw, param, param2, series_block):
     if op in CUMSUM_OPS:
         return range_aggregate_cumsum(ts2d, val2d, lengths, t0, step,
                                       range_ms, op=op, nsteps=nsteps,
@@ -43,14 +43,18 @@ def _range_dispatch(ts2d, val2d, lengths, t0, step, range_ms, *, op, nsteps,
     if op in GATHER_OPS:
         return range_aggregate_gather(ts2d, val2d, t0, step, range_ms, op=op,
                                       nsteps=nsteps, maxw=maxw, param=param,
-                                      param2=param2)
+                                      param2=param2, series_block=series_block)
     raise ValueError(f"unknown range op: {op}")
 
 
 @functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "mesh"))
 def _series_sharded(ts2d, val2d, lengths, t0, step, range_ms, param, param2,
                     *, op, nsteps, maxw, mesh):
-    inner = functools.partial(_range_dispatch, op=op, nsteps=nsteps, maxw=maxw)
+    # size the gather path's series blocking to the per-shard slice so small
+    # shards don't pad up to the global default block of 128
+    per_shard = max(1, ts2d.shape[0] // mesh.size)
+    inner = functools.partial(_range_dispatch, op=op, nsteps=nsteps, maxw=maxw,
+                              series_block=min(128, per_shard))
     fn = lambda t, v, l, a, b, c, p, p2: inner(t, v, l, a, b, c, param=p,
                                                param2=p2)
     srow = P(ROW_AXES, None)
@@ -88,7 +92,9 @@ def series_sharded_range_aggregate(
                         ts2d - lo).astype(np.int32)
         t0, step, range_ms = int(t0) - lo, int(step), int(range_ms)
     if pad:
-        ts2d = np.pad(ts2d, ((0, pad), (0, 0)), constant_values=TS_PAD)
+        # sentinel must fit the (possibly rebased-to-int32) ts dtype
+        sentinel = np.iinfo(ts2d.dtype).max
+        ts2d = np.pad(ts2d, ((0, pad), (0, 0)), constant_values=sentinel)
         val2d = np.pad(val2d, ((0, pad), (0, 0)))
         lengths = np.pad(lengths, (0, pad))
     shard2d = NamedSharding(mesh, P(ROW_AXES, None))
@@ -124,8 +130,9 @@ def _blocked_window(vals, window: int, op: str):
     else:
         ext = vals
     if op == "sum" or op == "avg":
-        cs = jnp.cumsum(ext.astype(jnp.float32), axis=1)
-        csp = jnp.concatenate([jnp.zeros((S, 1), jnp.float32), cs], axis=1)
+        acc_dtype = jnp.promote_types(vals.dtype, jnp.float32)
+        cs = jnp.cumsum(ext.astype(acc_dtype), axis=1)
+        csp = jnp.concatenate([jnp.zeros((S, 1), acc_dtype), cs], axis=1)
         out = csp[:, window:] - csp[:, :-window] if halo else csp[:, 1:] - csp[:, :-1]
         if op == "avg":
             out = out / window

@@ -1,0 +1,960 @@
+"""Recursive-descent SQL parser producing greptimedb_tpu.sql.ast nodes.
+
+Grammar follows the reference's sqlparser-rs dialect plus the GreptimeDB
+extensions (src/sql/src/parsers/): TIME INDEX column option and constraint,
+PARTITION BY RANGE COLUMNS with MAXVALUE bounds, ENGINE=/WITH() table
+options, TQL EVAL/EXPLAIN/ANALYZE, COPY TO/FROM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from .ast import *  # noqa: F401,F403
+from .ast import (
+    AddColumn, AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef,
+    Copy, CreateDatabase, CreateTable, Delete, DescribeTable, DropColumn,
+    DropDatabase, DropTable, Explain, Expr, FunctionCall, InList, Insert,
+    Interval, IsNull, Join, Literal, ObjectName, PartitionEntry, Partitions,
+    Placeholder, Query, RenameTable, SelectItem, SetVariable, ShowCreateTable,
+    ShowDatabases, ShowTables, ShowVariable, Star, Statement, Subquery,
+    TableRef, Tql, TruncateTable, UnaryOp, Use,
+)
+from .tokenizer import EOF, IDENT, NUMBER, OP, QIDENT, STRING, Token, tokenize
+
+
+class ParserError(ValueError):
+    pass
+
+
+# keywords that terminate a SELECT item list's expression context
+_CLAUSE_KEYWORDS = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON", "AS", "ASC",
+    "DESC", "AND", "OR", "NOT", "THEN", "ELSE", "END", "WHEN",
+}
+
+_TYPE_KEYWORDS = {
+    "BOOLEAN", "BOOL", "TINYINT", "SMALLINT", "INT", "INTEGER", "BIGINT",
+    "FLOAT", "DOUBLE", "REAL", "STRING", "TEXT", "VARCHAR", "CHAR", "BINARY",
+    "VARBINARY", "BLOB", "BYTEA", "DATE", "DATETIME", "TIMESTAMP", "INT8",
+    "INT16", "INT32", "INT64", "UINT8", "UINT16", "UINT32", "UINT64",
+    "FLOAT32", "FLOAT64", "TIMESTAMP_S", "TIMESTAMP_MS", "TIMESTAMP_US",
+    "TIMESTAMP_NS",
+}
+
+
+def parse_sql(sql: str) -> Statement:
+    """Parse a single SQL statement."""
+    stmts = parse_statements(sql)
+    if len(stmts) != 1:
+        raise ParserError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+def parse_statements(sql: str) -> List[Statement]:
+    return Parser(sql).parse_statements()
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+        self._placeholders = 0
+
+    # ---- token helpers ----
+    def peek(self, ahead: int = 0) -> Token:
+        j = min(self.i + ahead, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != EOF:
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == IDENT and t.upper() in words
+
+    def match_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.match_kw(word):
+            t = self.peek()
+            raise ParserError(
+                f"expected {word}, found {t.value!r} at offset {t.pos}")
+
+    def match_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == OP and t.value == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.match_op(op):
+            t = self.peek()
+            raise ParserError(
+                f"expected {op!r}, found {t.value!r} at offset {t.pos}")
+
+    def parse_identifier(self) -> str:
+        t = self.peek()
+        if t.kind in (IDENT, QIDENT):
+            self.next()
+            return t.value
+        raise ParserError(f"expected identifier, found {t.value!r} at {t.pos}")
+
+    def parse_object_name(self) -> ObjectName:
+        parts = [self.parse_identifier()]
+        while self.match_op("."):
+            parts.append(self.parse_identifier())
+        if len(parts) > 3:
+            raise ParserError(f"too many name parts: {'.'.join(parts)}")
+        return ObjectName(parts)
+
+    # ---- statements ----
+    def parse_statements(self) -> List[Statement]:
+        stmts: List[Statement] = []
+        while True:
+            while self.match_op(";"):
+                pass
+            if self.peek().kind == EOF:
+                return stmts
+            stmts.append(self.parse_statement())
+            if not (self.match_op(";") or self.peek().kind == EOF):
+                t = self.peek()
+                raise ParserError(
+                    f"unexpected {t.value!r} at offset {t.pos}")
+
+    def parse_statement(self) -> Statement:
+        t = self.peek()
+        kw = t.upper() if t.kind == IDENT else ""
+        if kw == "SELECT" or (t.kind == OP and t.value == "("):
+            return self.parse_query()
+        if kw == "WITH":
+            raise ParserError("WITH (CTE) queries are not supported yet")
+        if kw == "CREATE":
+            return self.parse_create()
+        if kw == "DROP":
+            return self.parse_drop()
+        if kw == "INSERT":
+            return self.parse_insert()
+        if kw == "DELETE":
+            return self.parse_delete()
+        if kw == "ALTER":
+            return self.parse_alter()
+        if kw == "SHOW":
+            return self.parse_show()
+        if kw in ("DESCRIBE", "DESC"):
+            self.next()
+            self.match_kw("TABLE")
+            return DescribeTable(table=self.parse_object_name())
+        if kw == "USE":
+            self.next()
+            return Use(database=self.parse_identifier())
+        if kw == "TQL":
+            return self.parse_tql()
+        if kw == "COPY":
+            return self.parse_copy()
+        if kw == "EXPLAIN":
+            return self.parse_explain()
+        if kw == "SET":
+            return self.parse_set()
+        if kw == "TRUNCATE":
+            self.next()
+            self.match_kw("TABLE")
+            return TruncateTable(name=self.parse_object_name())
+        raise ParserError(f"unsupported statement start: {t.value!r} at {t.pos}")
+
+    # ---- SELECT ----
+    def parse_query(self) -> Query:
+        if self.match_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            return self._query_tail(q)
+        self.expect_kw("SELECT")
+        distinct = self.match_kw("DISTINCT")
+        self.match_kw("ALL")
+        projections = [self.parse_select_item()]
+        while self.match_op(","):
+            projections.append(self.parse_select_item())
+        q = Query(projections=projections, distinct=distinct)
+        if self.match_kw("FROM"):
+            q.from_ = self.parse_table_ref()
+            while True:
+                join = self.parse_join_opt()
+                if join is None:
+                    break
+                q.joins.append(join)
+        if self.match_kw("WHERE"):
+            q.where = self.parse_expr()
+        if self.match_kw("GROUP"):
+            self.expect_kw("BY")
+            q.group_by.append(self.parse_expr())
+            while self.match_op(","):
+                q.group_by.append(self.parse_expr())
+        if self.match_kw("HAVING"):
+            q.having = self.parse_expr()
+        return self._query_tail(q)
+
+    def _query_tail(self, q: Query) -> Query:
+        if self.match_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.match_kw("DESC"):
+                    asc = False
+                else:
+                    self.match_kw("ASC")
+                self.match_kw("NULLS") and (self.match_kw("FIRST") or
+                                            self.match_kw("LAST"))
+                q.order_by.append((e, asc))
+                if not self.match_op(","):
+                    break
+        if self.match_kw("LIMIT"):
+            q.limit = self._parse_int("LIMIT")
+        if self.match_kw("OFFSET"):
+            q.offset = self._parse_int("OFFSET")
+        return q
+
+    def _parse_int(self, what: str) -> int:
+        t = self.next()
+        if t.kind != NUMBER:
+            raise ParserError(f"expected integer after {what}, got {t.value!r}")
+        return self._to_int(t)
+
+    @staticmethod
+    def _to_int(t: Token) -> int:
+        try:
+            if t.value.lower().startswith("0x"):
+                return int(t.value, 16)
+            return int(t.value, 10)
+        except ValueError as e:
+            raise ParserError(f"invalid integer {t.value!r} at {t.pos}") from e
+
+    def parse_select_item(self) -> SelectItem:
+        t = self.peek()
+        if t.kind == OP and t.value == "*":
+            self.next()
+            return SelectItem(Star())
+        expr = self.parse_expr()
+        alias = None
+        if self.match_kw("AS"):
+            alias = self.parse_identifier()
+        else:
+            nt = self.peek()
+            if nt.kind == QIDENT or (nt.kind == IDENT and
+                                     nt.upper() not in _CLAUSE_KEYWORDS):
+                alias = self.parse_identifier()
+        return SelectItem(expr, alias)
+
+    def parse_table_ref(self) -> TableRef:
+        if self.match_op("("):
+            sub = self.parse_query()
+            self.expect_op(")")
+            alias = None
+            self.match_kw("AS")
+            nt = self.peek()
+            if nt.kind in (IDENT, QIDENT) and nt.upper() not in _CLAUSE_KEYWORDS:
+                alias = self.parse_identifier()
+            return TableRef(subquery=sub, alias=alias)
+        name = self.parse_object_name()
+        alias = None
+        if self.match_kw("AS"):
+            alias = self.parse_identifier()
+        else:
+            nt = self.peek()
+            if nt.kind == QIDENT or (nt.kind == IDENT and
+                                     nt.upper() not in _CLAUSE_KEYWORDS and
+                                     nt.upper() not in ("SET",)):
+                alias = self.parse_identifier()
+        return TableRef(name=name, alias=alias)
+
+    def parse_join_opt(self) -> Optional[Join]:
+        kind = None
+        if self.match_kw("CROSS"):
+            kind = "cross"
+        elif self.match_kw("INNER"):
+            kind = "inner"
+        elif self.match_kw("LEFT"):
+            self.match_kw("OUTER")
+            kind = "left"
+        elif self.match_kw("RIGHT"):
+            self.match_kw("OUTER")
+            kind = "right"
+        elif self.match_kw("FULL"):
+            self.match_kw("OUTER")
+            kind = "full"
+        elif self.at_kw("JOIN"):
+            kind = "inner"
+        elif self.match_op(","):
+            kind = "cross"
+            return Join(kind, self.parse_table_ref())
+        if kind is None:
+            return None
+        self.expect_kw("JOIN")
+        table = self.parse_table_ref()
+        on = None
+        if self.match_kw("ON"):
+            on = self.parse_expr()
+        return Join(kind, table, on)
+
+    # ---- expressions (precedence climbing) ----
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        left = self.parse_and()
+        while self.match_kw("OR"):
+            left = BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expr:
+        left = self.parse_not()
+        while self.match_kw("AND"):
+            left = BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Expr:
+        if self.match_kw("NOT"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        left = self.parse_additive()
+        while True:
+            t = self.peek()
+            if t.kind == OP and t.value in ("=", "!=", "<>", "<", "<=", ">",
+                                            ">=", "<=>"):
+                self.next()
+                op = {"<>": "!=", "<=>": "="}.get(t.value, t.value)
+                left = BinaryOp(op, left, self.parse_additive())
+                continue
+            if t.kind == IDENT:
+                kw = t.upper()
+                negated = False
+                save = self.i
+                if kw == "NOT":
+                    self.next()
+                    nxt = self.peek()
+                    if nxt.kind == IDENT and nxt.upper() in (
+                            "LIKE", "ILIKE", "IN", "BETWEEN", "REGEXP"):
+                        negated = True
+                        kw = nxt.upper()
+                        t = nxt
+                    else:
+                        self.i = save
+                        break
+                if kw in ("LIKE", "ILIKE"):
+                    self.next()
+                    node = BinaryOp(kw.lower(), left, self.parse_additive())
+                    left = UnaryOp("not", node) if negated else node
+                    continue
+                if kw == "REGEXP":
+                    self.next()
+                    node = BinaryOp("regexp", left, self.parse_additive())
+                    left = UnaryOp("not", node) if negated else node
+                    continue
+                if kw == "IN":
+                    self.next()
+                    self.expect_op("(")
+                    if self.at_kw("SELECT"):
+                        sub = self.parse_query()
+                        self.expect_op(")")
+                        left = InList(left, [Subquery(sub)], negated)
+                        continue
+                    items = [self.parse_expr()]
+                    while self.match_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = InList(left, items, negated)
+                    continue
+                if kw == "BETWEEN":
+                    self.next()
+                    low = self.parse_additive()
+                    self.expect_kw("AND")
+                    high = self.parse_additive()
+                    left = Between(left, low, high, negated)
+                    continue
+                if kw == "IS":
+                    self.next()
+                    neg = self.match_kw("NOT")
+                    self.expect_kw("NULL")
+                    left = IsNull(left, neg)
+                    continue
+            break
+        return left
+
+    def parse_additive(self) -> Expr:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == OP and t.value in ("+", "-", "||"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_multiplicative())
+            else:
+                return left
+
+    def parse_multiplicative(self) -> Expr:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == OP and t.value in ("*", "/", "%"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> Expr:
+        if self.match_op("-"):
+            return UnaryOp("-", self.parse_unary())
+        if self.match_op("+"):
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> Expr:
+        e = self.parse_primary()
+        while self.match_op("::"):
+            type_name = self._parse_type_name()
+            e = Cast(e, type_name)
+        return e
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == NUMBER:
+            self.next()
+            txt = t.value
+            if txt.lower().startswith("0x"):
+                return Literal(int(txt, 16), "number")
+            val = float(txt) if ("." in txt or "e" in txt.lower()) else int(txt)
+            return Literal(val, "number")
+        if t.kind == STRING:
+            self.next()
+            return Literal(t.value, "string")
+        if t.kind == OP and t.value == "(":
+            self.next()
+            if self.at_kw("SELECT"):
+                sub = self.parse_query()
+                self.expect_op(")")
+                return Subquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == OP and t.value == "*":
+            self.next()
+            return Star()
+        if t.kind == OP and t.value == "?":
+            self.next()
+            self._placeholders += 1
+            return Placeholder(self._placeholders)
+        if t.kind == QIDENT:
+            return self._parse_compound_identifier()
+        if t.kind == IDENT:
+            kw = t.upper()
+            if kw in ("TRUE", "FALSE"):
+                self.next()
+                return Literal(kw == "TRUE", "bool")
+            if kw == "NULL":
+                self.next()
+                return Literal(None, "null")
+            if kw == "INTERVAL":
+                self.next()
+                lit = self.next()
+                if lit.kind != STRING:
+                    raise ParserError("expected string after INTERVAL")
+                unit_tok = self.peek()
+                text = lit.value
+                if unit_tok.kind == IDENT and unit_tok.upper() in (
+                        "SECOND", "SECONDS", "MINUTE", "MINUTES", "HOUR",
+                        "HOURS", "DAY", "DAYS", "MILLISECOND", "MILLISECONDS"):
+                    self.next()
+                    text = f"{text} {unit_tok.value}"
+                return Interval(text)
+            if kw == "CASE":
+                return self._parse_case()
+            if kw == "CAST":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("AS")
+                tn = self._parse_type_name()
+                self.expect_op(")")
+                return Cast(e, tn)
+            if kw in ("DATE", "TIMESTAMP") and self.peek(1).kind == STRING:
+                self.next()
+                lit = self.next()
+                return Cast(Literal(lit.value, "string"), kw.lower())
+            if kw == "EXISTS" and self.peek(1).kind == OP and \
+                    self.peek(1).value == "(":
+                self.next()
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                return FunctionCall("exists", [Subquery(sub)])
+            if kw in _CLAUSE_KEYWORDS:
+                raise ParserError(
+                    f"unexpected keyword {t.value!r} at offset {t.pos} "
+                    f"(quote it to use as an identifier)")
+            return self._parse_compound_identifier()
+        raise ParserError(f"unexpected token {t.value!r} at offset {t.pos}")
+
+    def _parse_case(self) -> Expr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        whens: List[Tuple[Expr, Expr]] = []
+        while self.match_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            whens.append((cond, self.parse_expr()))
+        else_ = None
+        if self.match_kw("ELSE"):
+            else_ = self.parse_expr()
+        self.expect_kw("END")
+        return Case(operand, whens, else_)
+
+    def _parse_compound_identifier(self) -> Expr:
+        name = self.parse_identifier()
+        # function call?
+        if self.peek().kind == OP and self.peek().value == "(":
+            self.next()
+            distinct = self.match_kw("DISTINCT")
+            args: List[Expr] = []
+            if not (self.peek().kind == OP and self.peek().value == ")"):
+                args.append(self.parse_expr())
+                while self.match_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return FunctionCall(name.lower(), args, distinct)
+        parts = [name]
+        while self.peek().kind == OP and self.peek().value == ".":
+            # a.b or a.*
+            if self.peek(1).kind in (IDENT, QIDENT):
+                self.next()
+                parts.append(self.parse_identifier())
+            elif self.peek(1).kind == OP and self.peek(1).value == "*":
+                self.next()
+                self.next()
+                return Star(table=".".join(parts))
+            else:
+                break
+        if len(parts) == 1:
+            return Column(parts[0])
+        return Column(parts[-1], table=".".join(parts[:-1]))
+
+    def _parse_type_name(self) -> str:
+        base = self.parse_identifier()
+        out = base
+        # TIMESTAMP(3), VARCHAR(255)
+        if self.peek().kind == OP and self.peek().value == "(":
+            self.next()
+            inner = []
+            while not (self.peek().kind == OP and self.peek().value == ")"):
+                t = self.next()
+                if t.kind == EOF:
+                    raise ParserError(
+                        f"unterminated type parameter list for {base!r}")
+                inner.append(t.value)
+            self.expect_op(")")
+            if base.upper() == "TIMESTAMP":
+                out = f"{base}({','.join(inner)})"
+            # length params on varchar/char are ignored
+        if self.at_kw("UNSIGNED"):
+            self.next()
+            out = f"{out} unsigned"
+        return out
+
+    # ---- CREATE ----
+    def parse_create(self) -> Statement:
+        self.expect_kw("CREATE")
+        external = self.match_kw("EXTERNAL")
+        if self.match_kw("DATABASE") or self.match_kw("SCHEMA"):
+            ine = self._parse_if_not_exists()
+            return CreateDatabase(self.parse_identifier(), ine)
+        self.expect_kw("TABLE")
+        ine = self._parse_if_not_exists()
+        name = self.parse_object_name()
+        stmt = CreateTable(name=name, if_not_exists=ine, external=external)
+        if self.match_op("("):
+            self._parse_create_body(stmt)
+        while True:
+            if self.match_kw("ENGINE"):
+                self.expect_op("=")
+                stmt.engine = self.parse_identifier()
+            elif self.match_kw("PARTITION"):
+                self._parse_partitions(stmt)
+            elif self.match_kw("WITH"):
+                self.expect_op("(")
+                stmt.options.update(self._parse_kv_list())
+                self.expect_op(")")
+            else:
+                break
+        # enforce TIME INDEX presence like the reference does for non-external
+        if not stmt.external and stmt.columns and stmt.time_index is None:
+            raise ParserError("missing TIME INDEX constraint in CREATE TABLE")
+        return stmt
+
+    def _parse_if_not_exists(self) -> bool:
+        if self.match_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _parse_create_body(self, stmt: CreateTable) -> None:
+        while True:
+            if self.match_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                self.expect_op("(")
+                while True:
+                    stmt.primary_keys.append(self.parse_identifier())
+                    if not self.match_op(","):
+                        break
+                self.expect_op(")")
+            elif self.at_kw("TIME") and self.peek(1).kind == IDENT and \
+                    self.peek(1).upper() == "INDEX":
+                # TIME INDEX(col) — lookahead so a column named `time` works
+                self.next()
+                self.next()
+                self.expect_op("(")
+                stmt.time_index = self.parse_identifier()
+                self.expect_op(")")
+            elif self.at_kw("TIMESTAMP_INDEX") and self.peek(1).kind == OP \
+                    and self.peek(1).value == "(":
+                self.next()
+                self.expect_op("(")
+                stmt.time_index = self.parse_identifier()
+                self.expect_op(")")
+            else:
+                col = self._parse_column_def()
+                stmt.columns.append(col)
+                if col.is_time_index:
+                    if stmt.time_index is not None and stmt.time_index != col.name:
+                        raise ParserError("multiple TIME INDEX columns")
+                    stmt.time_index = col.name
+                if col.is_primary_key and col.name not in stmt.primary_keys:
+                    stmt.primary_keys.append(col.name)
+            if self.match_op(","):
+                continue
+            self.expect_op(")")
+            break
+        if stmt.time_index and stmt.time_index not in [c.name for c in stmt.columns]:
+            raise ParserError(f"TIME INDEX column {stmt.time_index!r} not defined")
+        for pk in stmt.primary_keys:
+            if pk not in [c.name for c in stmt.columns]:
+                raise ParserError(f"PRIMARY KEY column {pk!r} not defined")
+
+    def _parse_column_def(self) -> ColumnDef:
+        name = self.parse_identifier()
+        type_name = self._parse_type_name()
+        col = ColumnDef(name=name, type_name=type_name)
+        while True:
+            if self.match_kw("NOT"):
+                self.expect_kw("NULL")
+                col.nullable = False
+            elif self.match_kw("NULL"):
+                col.nullable = True
+            elif self.match_kw("DEFAULT"):
+                col.default = self.parse_expr()
+            elif self.match_kw("TIME"):
+                self.expect_kw("INDEX")
+                col.is_time_index = True
+                col.nullable = False
+            elif self.match_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                col.is_primary_key = True
+            elif self.match_kw("COMMENT"):
+                t = self.next()
+                col.comment = t.value
+            else:
+                return col
+
+    def _parse_partitions(self, stmt: CreateTable) -> None:
+        # PARTITION BY RANGE COLUMNS (a, b) (PARTITION p0 VALUES LESS THAN (...), ...)
+        self.expect_kw("BY")
+        self.expect_kw("RANGE")
+        self.expect_kw("COLUMNS")
+        self.expect_op("(")
+        cols = [self.parse_identifier()]
+        while self.match_op(","):
+            cols.append(self.parse_identifier())
+        self.expect_op(")")
+        self.expect_op("(")
+        entries: List[PartitionEntry] = []
+        while True:
+            self.expect_kw("PARTITION")
+            pname = self.parse_identifier()
+            self.expect_kw("VALUES")
+            self.expect_kw("LESS")
+            self.expect_kw("THAN")
+            self.expect_op("(")
+            values: List[Any] = []
+            while True:
+                if self.match_kw("MAXVALUE"):
+                    values.append("MAXVALUE")
+                else:
+                    values.append(self._parse_literal_value())
+                if not self.match_op(","):
+                    break
+            self.expect_op(")")
+            entries.append(PartitionEntry(pname, values))
+            if not self.match_op(","):
+                break
+        self.expect_op(")")
+        stmt.partitions = Partitions(cols, entries)
+
+    def _parse_literal_value(self) -> Any:
+        neg = self.match_op("-")
+        t = self.next()
+        if t.kind == NUMBER:
+            if "." in t.value or "e" in t.value.lower():
+                try:
+                    v = float(t.value)
+                except ValueError as e:
+                    raise ParserError(
+                        f"invalid number {t.value!r} at {t.pos}") from e
+            else:
+                v = self._to_int(t)
+            return -v if neg else v
+        if t.kind == STRING:
+            return t.value
+        if t.kind == IDENT and t.upper() in ("TRUE", "FALSE"):
+            return t.upper() == "TRUE"
+        if t.kind == IDENT and t.upper() == "NULL":
+            return None
+        raise ParserError(f"expected literal, found {t.value!r} at {t.pos}")
+
+    def _parse_kv_list(self) -> dict:
+        opts = {}
+        if self.peek().kind == OP and self.peek().value == ")":
+            return opts
+        while True:
+            key_parts = [self.parse_identifier()]
+            while self.match_op("."):
+                key_parts.append(self.parse_identifier())
+            self.expect_op("=")
+            opts[".".join(key_parts).lower()] = self._parse_literal_value()
+            if not self.match_op(","):
+                return opts
+
+    # ---- DROP / ALTER ----
+    def parse_drop(self) -> Statement:
+        self.expect_kw("DROP")
+        if self.match_kw("DATABASE") or self.match_kw("SCHEMA"):
+            ie = self._parse_if_exists()
+            return DropDatabase(self.parse_identifier(), ie)
+        self.expect_kw("TABLE")
+        ie = self._parse_if_exists()
+        return DropTable(self.parse_object_name(), ie)
+
+    def _parse_if_exists(self) -> bool:
+        if self.match_kw("IF"):
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def parse_alter(self) -> Statement:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        table = self.parse_object_name()
+        if self.match_kw("ADD"):
+            self.match_kw("COLUMN")
+            col = self._parse_column_def()
+            location = None
+            if self.match_kw("FIRST"):
+                location = "FIRST"
+            elif self.match_kw("AFTER"):
+                location = f"AFTER {self.parse_identifier()}"
+            return AlterTable(table, AddColumn(col, location))
+        if self.match_kw("DROP"):
+            self.match_kw("COLUMN")
+            return AlterTable(table, DropColumn(self.parse_identifier()))
+        if self.match_kw("RENAME"):
+            self.match_kw("TO")
+            return AlterTable(table, RenameTable(self.parse_identifier()))
+        t = self.peek()
+        raise ParserError(f"unsupported ALTER operation {t.value!r}")
+
+    # ---- INSERT / DELETE ----
+    def parse_insert(self) -> Insert:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.parse_object_name()
+        columns: List[str] = []
+        if self.match_op("("):
+            columns.append(self.parse_identifier())
+            while self.match_op(","):
+                columns.append(self.parse_identifier())
+            self.expect_op(")")
+        if self.at_kw("SELECT"):
+            return Insert(table, columns, select=self.parse_query())
+        self.expect_kw("VALUES")
+        rows: List[List[Expr]] = []
+        while True:
+            self.expect_op("(")
+            row: List[Expr] = []
+            if not (self.peek().kind == OP and self.peek().value == ")"):
+                row.append(self.parse_expr())
+                while self.match_op(","):
+                    row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.match_op(","):
+                break
+        return Insert(table, columns, rows)
+
+    def parse_delete(self) -> Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.parse_object_name()
+        where = None
+        if self.match_kw("WHERE"):
+            where = self.parse_expr()
+        return Delete(table, where)
+
+    # ---- SHOW ----
+    def parse_show(self) -> Statement:
+        self.expect_kw("SHOW")
+        full = self.match_kw("FULL")
+        if self.match_kw("DATABASES") or self.match_kw("SCHEMAS"):
+            like, where = self._parse_show_filter()
+            return ShowDatabases(like, where)
+        if self.match_kw("TABLES"):
+            database = None
+            if self.match_kw("FROM") or self.match_kw("IN"):
+                database = self.parse_identifier()
+            like, where = self._parse_show_filter()
+            return ShowTables(database, like, where, full)
+        if self.match_kw("CREATE"):
+            self.expect_kw("TABLE")
+            return ShowCreateTable(self.parse_object_name())
+        # SHOW VARIABLES / SHOW <ident> — MySQL-compat surface
+        rest = []
+        while self.peek().kind != EOF and not (
+                self.peek().kind == OP and self.peek().value == ";"):
+            rest.append(self.next().value)
+        return ShowVariable(" ".join(rest))
+
+    def _parse_show_filter(self):
+        like = where = None
+        if self.match_kw("LIKE"):
+            t = self.next()
+            like = t.value
+        elif self.match_kw("WHERE"):
+            where = self.parse_expr()
+        return like, where
+
+    # ---- TQL ----
+    def parse_tql(self) -> Tql:
+        self.expect_kw("TQL")
+        if self.match_kw("EVAL") or self.match_kw("EVALUATE"):
+            kind = "eval"
+        elif self.match_kw("EXPLAIN"):
+            kind = "analyze" if self.match_kw("ANALYZE") else "explain"
+        elif self.match_kw("ANALYZE"):
+            kind = "analyze"
+        else:
+            raise ParserError("expected EVAL/EXPLAIN/ANALYZE after TQL")
+        start, end, step, lookback = "0", "0", "5m", None
+        if self.match_op("("):
+            params = []
+            depth = 1
+            cur: List[str] = []
+            while depth > 0:
+                t = self.next()
+                if t.kind == EOF:
+                    raise ParserError("unterminated TQL parameter list")
+                if t.kind == OP and t.value == "(":
+                    depth += 1
+                elif t.kind == OP and t.value == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                elif t.kind == OP and t.value == "," and depth == 1:
+                    params.append("".join(cur))
+                    cur = []
+                    continue
+                if t.kind == STRING:
+                    cur.append(t.value)
+                else:
+                    cur.append(t.value)
+            params.append("".join(cur))
+            if len(params) < 3:
+                raise ParserError(
+                    f"TQL expects (start, end, step), got {len(params)} "
+                    f"parameter(s)")
+            start, end, step = params[0], params[1], params[2]
+            if len(params) >= 4:
+                lookback = params[3]
+        # the rest of the statement (up to ;) is the raw PromQL text — sliced
+        # from the source string so PromQL syntax never has to be valid SQL
+        start_pos = self.peek().pos
+        while self.peek().kind != EOF and not (
+                self.peek().kind == OP and self.peek().value == ";"):
+            self.next()
+        end_pos = self.peek().pos if self.peek().kind != EOF else len(self.sql)
+        query = self.sql[start_pos:end_pos].strip()
+        return Tql(kind, start, end, step, lookback, query)
+
+    # ---- COPY ----
+    def parse_copy(self) -> Copy:
+        self.expect_kw("COPY")
+        table = self.parse_object_name()
+        if self.match_kw("TO"):
+            direction = "to"
+        elif self.match_kw("FROM"):
+            direction = "from"
+        else:
+            raise ParserError("expected TO or FROM in COPY")
+        t = self.next()
+        if t.kind != STRING:
+            raise ParserError("expected file path string in COPY")
+        options = {}
+        if self.match_kw("WITH"):
+            self.expect_op("(")
+            options = self._parse_kv_list()
+            self.expect_op(")")
+        return Copy(table, direction, t.value, options)
+
+    # ---- EXPLAIN / SET ----
+    def parse_explain(self) -> Explain:
+        self.expect_kw("EXPLAIN")
+        analyze = self.match_kw("ANALYZE")
+        verbose = self.match_kw("VERBOSE")
+        return Explain(self.parse_statement(), analyze, verbose)
+
+    def parse_set(self) -> SetVariable:
+        self.expect_kw("SET")
+        self.match_kw("SESSION") or self.match_kw("GLOBAL") or \
+            self.match_kw("LOCAL")
+        parts = [self.parse_identifier()]
+        while self.match_op("."):
+            parts.append(self.parse_identifier())
+        if self.match_op("="):
+            value = self._parse_set_value()
+        elif self.match_kw("TO"):
+            value = self._parse_set_value()
+        else:
+            value = None
+        return SetVariable(".".join(parts), value)
+
+    def _parse_set_value(self):
+        neg = self.match_op("-")
+        t = self.next()
+        if t.kind == NUMBER:
+            if "." in t.value or "e" in t.value.lower():
+                v = float(t.value)
+            else:
+                v = self._to_int(t)
+            return -v if neg else v
+        if neg:
+            raise ParserError(f"expected number after '-' at {t.pos}")
+        return t.value

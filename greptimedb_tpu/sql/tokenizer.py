@@ -1,0 +1,133 @@
+"""SQL lexer: whitespace/comment-skipping tokenizer with position tracking."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+IDENT = "ident"
+QIDENT = "qident"     # "quoted" or `backticked` identifier
+STRING = "string"
+NUMBER = "number"
+OP = "op"
+EOF = "eof"
+
+# multi-char operators first so maximal munch works; [ ] { } : pass through
+# for TQL-embedded PromQL text (reparsed by the PromQL engine, not SQL)
+_OPERATORS = ["<=>", "<>", "<=", ">=", "!=", "::", "||", "<", ">", "=", "+",
+              "-", "*", "/", "%", "(", ")", ",", ";", ".", "?", "~", "!",
+              "[", "]", "{", "}", ":"]
+
+
+@dataclass
+class Token:
+    kind: str
+    value: str
+    pos: int
+
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+class TokenizeError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> List[Token]:
+    toks: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "/" and sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise TokenizeError(f"unterminated block comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":
+            start = i
+            val, i = _read_quoted(sql, i, "'")
+            toks.append(Token(STRING, val, start))
+            continue
+        if c == '"':
+            start = i
+            val, i = _read_quoted(sql, i, '"')
+            toks.append(Token(QIDENT, val, start))
+            continue
+        if c == "`":
+            start = i
+            val, i = _read_quoted(sql, i, "`")
+            toks.append(Token(QIDENT, val, start))
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    # "1.." (range) shouldn't happen in SQL; treat greedily
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j + 1 < n and (
+                        sql[j + 1].isdigit() or sql[j + 1] in "+-"):
+                    seen_exp = True
+                    j += 2 if sql[j + 1] in "+-" else 1
+                elif ch in "xX" and sql[i] == "0" and j == i + 1:
+                    j += 1
+                    while j < n and sql[j] in "0123456789abcdefABCDEF":
+                        j += 1
+                    break
+                else:
+                    break
+            toks.append(Token(NUMBER, sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_" or c == "@" or c == "$":
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] in "_$@"):
+                j += 1
+            toks.append(Token(IDENT, sql[i:j], i))
+            i = j
+            continue
+        for op in _OPERATORS:
+            if sql.startswith(op, i):
+                toks.append(Token(OP, op, i))
+                i += len(op)
+                break
+        else:
+            raise TokenizeError(f"unexpected character {c!r} at offset {i}")
+    toks.append(Token(EOF, "", n))
+    return toks
+
+
+def _read_quoted(sql: str, start: int, q: str):
+    i = start + 1
+    out = []
+    n = len(sql)
+    while i < n:
+        c = sql[i]
+        if c == q:
+            if i + 1 < n and sql[i + 1] == q:  # doubled-quote escape
+                out.append(q)
+                i += 2
+                continue
+            return "".join(out), i + 1
+        if c == "\\" and q == "'" and i + 1 < n:
+            # MySQL-style backslash escapes in strings
+            esc = sql[i + 1]
+            out.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0",
+                        "\\": "\\", "'": "'", '"': '"'}.get(esc, esc))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    raise TokenizeError(f"unterminated {q}-quoted literal at {start}")

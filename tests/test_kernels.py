@@ -358,3 +358,126 @@ class TestReviewRegressions:
             30_000, 30_000, 30_001, op="increase", nsteps=1)
         assert bool(ok[0, 0])
         assert float(out[0, 0]) > 0  # not sign-flipped by a negative cap
+
+
+# ---------------------------------------------------------------------------
+# sorted_grouped_aggregate (the scatter-free LSM fast path)
+# ---------------------------------------------------------------------------
+
+class TestSortedGroupedAggregate:
+    def _mk(self, n=50_000, groups=97, skew=False, seed=3):
+        rng = np.random.default_rng(seed)
+        if skew:
+            raw = rng.zipf(1.5, n) % groups
+        else:
+            raw = rng.integers(0, groups, n)
+        gids = np.sort(raw).astype(np.int32)
+        mask = rng.random(n) > 0.15
+        ts = np.arange(n, dtype=np.int32)  # sorted within groups by position
+        vals = (rng.normal(size=n) * 50).astype(np.float32)
+        return gids, mask, ts, vals
+
+    @pytest.mark.parametrize("ops", [
+        ("sum", "count", "avg", "min", "max"),
+        ("stddev", "variance", "first", "last"),
+    ])
+    @pytest.mark.parametrize("skew", [False, True])
+    def test_matches_scatter_kernel(self, ops, skew):
+        from greptimedb_tpu.ops.kernels import (
+            grouped_aggregate, sorted_grouped_aggregate)
+        groups = 97
+        gids, mask, ts, vals = self._mk(groups=groups, skew=skew)
+        values = tuple(vals for _ in ops)
+        got, counts = sorted_grouped_aggregate(
+            gids, mask, ts, values, num_groups=groups, ops=ops)
+        want, want_counts = grouped_aggregate(
+            gids, mask, ts, values, num_groups=groups, ops=ops)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(want_counts))
+        for op, g, w in zip(ops, got, want):
+            # both kernels accumulate in f32; differing association orders
+            # legitimately diverge ~1e-3 on cancellation-heavy skewed sums
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64), np.asarray(w, np.float64),
+                rtol=2e-3, atol=2e-3, err_msg=f"{op} skew={skew}")
+
+    def test_small_and_empty_groups(self):
+        from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+        # groups 0,2 used; 1,3 empty; single-row group
+        gids = np.array([0, 0, 0, 2], np.int32)
+        mask = np.array([True, True, False, True])
+        ts = np.arange(4, dtype=np.int32)
+        vals = np.array([1.0, 5.0, 100.0, -3.0], np.float32)
+        (s, mn, mx, fst), counts = sorted_grouped_aggregate(
+            gids, mask, ts, (vals,) * 4, num_groups=4,
+            ops=("sum", "min", "max", "first"))
+        np.testing.assert_array_equal(np.asarray(counts), [2, 0, 1, 0])
+        np.testing.assert_allclose(np.asarray(s), [6.0, 0.0, -3.0, 0.0])
+        assert np.asarray(mn)[0] == 1.0 and np.asarray(mx)[0] == 5.0
+        assert np.asarray(mn)[2] == -3.0
+        assert np.asarray(fst)[0] == 1.0 and np.asarray(fst)[2] == -3.0
+        assert np.isnan(np.asarray(fst)[1])
+
+    def test_col_masks_null_semantics(self):
+        from greptimedb_tpu.ops.kernels import (
+            grouped_aggregate, sorted_grouped_aggregate)
+        rng = np.random.default_rng(5)
+        n, groups = 4096, 7
+        gids = np.sort(rng.integers(0, groups, n)).astype(np.int32)
+        mask = np.ones(n, bool)
+        cm = rng.random(n) > 0.5
+        ts = np.arange(n, dtype=np.int32)
+        vals = rng.normal(size=n).astype(np.float32)
+        got, _ = sorted_grouped_aggregate(
+            gids, mask, ts, (vals, vals), (cm, np.ones(n, bool)),
+            num_groups=groups, ops=("avg", "count"), has_col_masks=True)
+        want, _ = grouped_aggregate(
+            gids, mask, ts, (vals, vals), (cm, np.ones(n, bool)),
+            num_groups=groups, ops=("avg", "count"), has_col_masks=True)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                                   rtol=1e-4)
+        np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+    def test_first_last_unsorted_ts_within_segment(self):
+        # several series collapse into one GROUP BY key → ts NOT sorted
+        # within the segment; first/last must still pick by extreme ts
+        from greptimedb_tpu.ops.kernels import (
+            grouped_aggregate, sorted_grouped_aggregate)
+        rng = np.random.default_rng(11)
+        n, groups = 5000, 5
+        gids = np.sort(rng.integers(0, groups, n)).astype(np.int32)
+        ts = rng.permutation(n).astype(np.int32)  # unique → no ties
+        mask = rng.random(n) > 0.2
+        vals = rng.normal(size=n).astype(np.float32)
+        got, _ = sorted_grouped_aggregate(
+            gids, mask, ts, (vals, vals), num_groups=groups,
+            ops=("first", "last"))
+        want, _ = grouped_aggregate(
+            gids, mask, ts, (vals, vals), num_groups=groups,
+            ops=("first", "last"))
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]))
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]))
+
+    def test_block_boundary_segments(self):
+        # segments straddling exactly the 1024-block boundaries
+        from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+        B = 1024
+        sizes = [B - 1, 1, B, 2 * B - 2, 3, 2 * B + 5]
+        gids = np.concatenate([np.full(s, i, np.int32)
+                               for i, s in enumerate(sizes)])
+        n = len(gids)
+        vals = np.random.default_rng(0).normal(size=n).astype(np.float32)
+        mask = np.ones(n, bool)
+        ts = np.arange(n, dtype=np.int32)
+        (s, mn, mx, lst), counts = sorted_grouped_aggregate(
+            gids, mask, ts, (vals,) * 4, num_groups=len(sizes),
+            ops=("sum", "min", "max", "last"))
+        off = 0
+        for i, sz in enumerate(sizes):
+            seg = vals[off:off + sz]
+            np.testing.assert_allclose(np.asarray(s)[i], seg.sum(), rtol=1e-4,
+                                       atol=1e-4)
+            assert np.asarray(mn)[i] == seg.min()
+            assert np.asarray(mx)[i] == seg.max()
+            assert np.asarray(lst)[i] == seg[-1]
+            off += sz

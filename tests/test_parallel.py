@@ -129,6 +129,48 @@ def test_time_blocked_window(op):
         np.testing.assert_allclose(out[:, t], want, rtol=1e-4, atol=1e-5)
 
 
+def test_distributed_first_last_int_exact():
+    # large int64 values must survive first/last without a float32 round-trip
+    n, groups = 257, 3
+    gids = RNG.integers(0, groups, n).astype(np.int32)
+    mask = np.ones(n, bool)
+    ts = np.arange(n).astype(np.int32)
+    vals = (RNG.integers(0, 2**30, n).astype(np.int64) * 4 + 1)
+    mesh = make_mesh()
+    (last,), _ = distributed_grouped_aggregate(
+        gids, mask, ts, (vals,), num_groups=groups, ops=("last",), mesh=mesh)
+    for g in range(groups):
+        rows = np.nonzero(gids == g)[0]
+        assert int(np.asarray(last)[g]) == int(vals[rows[-1]])
+
+
+def test_series_sharded_rebase_path_with_padding():
+    # x64 off + epoch-ms int64 ts + series padding: the rebase-to-int32 path
+    # must pad with an int32-safe sentinel (regression: OverflowError)
+    import jax as _jax
+    S, per = 13, 16
+    sids = np.repeat(np.arange(S), per).astype(np.int32)
+    base = 1_700_000_000_000  # epoch ms, far outside int32
+    ts = (np.tile(np.arange(per) * 10_000, S) + base).astype(np.int64)
+    vals = RNG.random(S * per).astype(np.float32)
+    m = SeriesMatrix.build(sids, ts, vals, S)
+    mesh = make_mesh()
+    _jax.config.update("jax_enable_x64", False)
+    try:
+        out, ok = series_sharded_range_aggregate(
+            m.ts, m.values, m.lengths, base + 60_000, 30_000, 60_000,
+            op="sum_over_time", nsteps=4, mesh=mesh)
+    finally:
+        _jax.config.update("jax_enable_x64", True)
+    end0 = base + 60_000
+    for s in range(3):
+        sel = (ts[sids == s] > end0 - 60_000) & (ts[sids == s] <= end0)
+        if sel.any():
+            assert bool(np.asarray(ok)[s, 0])
+            np.testing.assert_allclose(np.asarray(out)[s, 0],
+                                       vals[sids == s][sel].sum(), rtol=1e-4)
+
+
 def test_time_blocked_window_validation():
     mesh = make_mesh()
     with pytest.raises(ValueError):

@@ -1,0 +1,253 @@
+"""SQL parser tests mirroring the reference's create/tql/query parser suites
+(src/sql/src/parsers/{create_parser,tql_parser}.rs test mods)."""
+
+import pytest
+
+from greptimedb_tpu.sql import (
+    AlterTable, Between, BinaryOp, Column, Copy, CreateDatabase, CreateTable,
+    Delete, DescribeTable, DropTable, Explain, FunctionCall, InList, Insert,
+    Literal, ParserError, Query, SetVariable, ShowCreateTable, ShowDatabases,
+    ShowTables, Star, Tql, UnaryOp, Use, parse_sql, parse_statements,
+)
+
+
+def test_create_table_full():
+    stmt = parse_sql("""
+        CREATE TABLE IF NOT EXISTS monitor (
+            host STRING,
+            ts TIMESTAMP TIME INDEX,
+            cpu DOUBLE DEFAULT 0,
+            memory DOUBLE NULL,
+            PRIMARY KEY(host)
+        ) ENGINE=mito WITH(regions=1, ttl='7d')""")
+    assert isinstance(stmt, CreateTable)
+    assert stmt.name.table == "monitor"
+    assert stmt.if_not_exists
+    assert stmt.time_index == "ts"
+    assert stmt.primary_keys == ["host"]
+    assert [c.name for c in stmt.columns] == ["host", "ts", "cpu", "memory"]
+    ts_col = stmt.columns[1]
+    assert ts_col.type_name.lower() == "timestamp"
+    assert not ts_col.nullable
+    assert stmt.options == {"regions": 1, "ttl": "7d"}
+    assert stmt.engine == "mito"
+
+
+def test_create_table_time_index_constraint():
+    stmt = parse_sql("""
+        CREATE TABLE t (ts TIMESTAMP(9), v DOUBLE, TIME INDEX (ts))""")
+    assert stmt.time_index == "ts"
+    assert stmt.columns[0].type_name == "TIMESTAMP(9)"
+
+
+def test_create_table_requires_time_index():
+    with pytest.raises(ParserError, match="TIME INDEX"):
+        parse_sql("CREATE TABLE t (a INT, b DOUBLE)")
+
+
+def test_create_table_partitions():
+    stmt = parse_sql("""
+        CREATE TABLE t (
+          a STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(a)
+        ) PARTITION BY RANGE COLUMNS (a) (
+          PARTITION r0 VALUES LESS THAN ('g'),
+          PARTITION r1 VALUES LESS THAN (MAXVALUE)
+        ) ENGINE=mito""")
+    p = stmt.partitions
+    assert p.columns == ["a"]
+    assert [e.name for e in p.entries] == ["r0", "r1"]
+    assert p.entries[0].values == ["g"]
+    assert p.entries[1].values == ["MAXVALUE"]
+
+
+def test_create_database():
+    stmt = parse_sql("CREATE DATABASE IF NOT EXISTS mydb")
+    assert isinstance(stmt, CreateDatabase) and stmt.name == "mydb"
+    assert stmt.if_not_exists
+
+
+def test_insert_values():
+    stmt = parse_sql("""
+        INSERT INTO monitor(host, ts, cpu) VALUES
+          ('h1', 1000, 0.5), ('h2', 2000, NULL)""")
+    assert isinstance(stmt, Insert)
+    assert stmt.columns == ["host", "ts", "cpu"]
+    assert len(stmt.rows) == 2
+    assert stmt.rows[0][0].value == "h1"
+    assert stmt.rows[1][2].value is None
+
+
+def test_insert_negative_number():
+    stmt = parse_sql("INSERT INTO t VALUES (-5, -1.5)")
+    assert isinstance(stmt.rows[0][0], UnaryOp)
+
+
+def test_select_full():
+    q = parse_sql("""
+        SELECT host, avg(cpu) AS c, count(*) FROM monitor
+        WHERE ts >= 1000 AND ts < 2000 AND host != 'h3'
+        GROUP BY host HAVING avg(cpu) > 0.1
+        ORDER BY c DESC LIMIT 10 OFFSET 2""")
+    assert isinstance(q, Query)
+    assert q.from_.name.table == "monitor"
+    assert q.projections[1].alias == "c"
+    assert isinstance(q.projections[2].expr, FunctionCall)
+    assert isinstance(q.where, BinaryOp) and q.where.op == "and"
+    assert len(q.group_by) == 1
+    assert q.having is not None
+    assert q.order_by[0][1] is False
+    assert q.limit == 10 and q.offset == 2
+
+
+def test_select_star_and_qualified():
+    q = parse_sql("SELECT *, m.cpu FROM db.m")
+    assert isinstance(q.projections[0].expr, Star)
+    col = q.projections[1].expr
+    assert isinstance(col, Column) and col.table == "m" and col.name == "cpu"
+    assert q.from_.name.parts == ["db", "m"]
+
+
+def test_select_no_from():
+    q = parse_sql("SELECT 1 + 2 * 3, version()")
+    assert q.from_ is None
+    e = q.projections[0].expr
+    assert isinstance(e, BinaryOp) and e.op == "+"
+
+
+def test_select_between_in_like_isnull():
+    q = parse_sql("""
+        SELECT * FROM t WHERE a BETWEEN 1 AND 5 AND b IN ('x','y')
+          AND c NOT LIKE 'h%' AND d IS NOT NULL""")
+    w = q.where
+    # drill: ((between AND in) AND notlike) AND isnotnull
+    assert isinstance(w, BinaryOp)
+    found = []
+
+    def walk(e):
+        found.append(type(e).__name__)
+        for attr in ("left", "right", "operand", "expr"):
+            if hasattr(e, attr) and getattr(e, attr) is not None:
+                walk(getattr(e, attr))
+    walk(w)
+    assert "Between" in found and "InList" in found and "IsNull" in found
+
+
+def test_select_functions_and_case():
+    q = parse_sql("""
+        SELECT CASE WHEN cpu > 0.5 THEN 'hot' ELSE 'cold' END,
+               date_bin(INTERVAL '1 minute', ts) FROM m""")
+    assert q.projections[0].expr.whens
+    fc = q.projections[1].expr
+    assert fc.name == "date_bin"
+
+
+def test_cast_forms():
+    q = parse_sql("SELECT CAST(a AS BIGINT), b::double FROM t")
+    assert q.projections[0].expr.type_name.lower() == "bigint"
+    assert q.projections[1].expr.type_name.lower() == "double"
+
+
+def test_joins():
+    q = parse_sql("SELECT * FROM a LEFT JOIN b ON a.x = b.x, c")
+    assert q.joins[0].kind == "left"
+    assert q.joins[1].kind == "cross"
+
+
+def test_subquery():
+    q = parse_sql("SELECT * FROM (SELECT a FROM t) s WHERE a > 1")
+    assert q.from_.subquery is not None
+    assert q.from_.alias == "s"
+
+
+def test_delete():
+    stmt = parse_sql("DELETE FROM monitor WHERE host = 'h1' AND ts = 1000")
+    assert isinstance(stmt, Delete)
+    assert stmt.table.table == "monitor"
+
+
+def test_alter_add_drop_rename():
+    a = parse_sql("ALTER TABLE t ADD COLUMN load DOUBLE NULL")
+    assert isinstance(a, AlterTable) and a.operation.column.name == "load"
+    d = parse_sql("ALTER TABLE t DROP COLUMN load")
+    assert d.operation.name == "load"
+    r = parse_sql("ALTER TABLE t RENAME TO t2")
+    assert r.operation.new_name == "t2"
+
+
+def test_show_and_describe():
+    assert isinstance(parse_sql("SHOW DATABASES"), ShowDatabases)
+    st = parse_sql("SHOW TABLES FROM public LIKE 'mon%'")
+    assert isinstance(st, ShowTables) and st.database == "public"
+    assert st.like == "mon%"
+    assert isinstance(parse_sql("SHOW CREATE TABLE m"), ShowCreateTable)
+    assert isinstance(parse_sql("DESC TABLE m"), DescribeTable)
+    assert isinstance(parse_sql("DESCRIBE m"), DescribeTable)
+
+
+def test_use_set_explain():
+    assert parse_sql("USE mydb").database == "mydb"
+    s = parse_sql("SET time_zone = 'UTC'")
+    assert isinstance(s, SetVariable)
+    e = parse_sql("EXPLAIN SELECT 1")
+    assert isinstance(e, Explain) and isinstance(e.statement, Query)
+
+
+def test_tql_eval():
+    t = parse_sql("TQL EVAL (0, 100, '5s') rate(cpu[1m] )")
+    assert isinstance(t, Tql) and t.kind == "eval"
+    assert t.start == "0" and t.end == "100" and t.step == "5s"
+    assert "rate" in t.query and "[1m]" in t.query.replace(" ", "")
+
+
+def test_tql_explain():
+    t = parse_sql("TQL EXPLAIN (0, 10, '1s') up")
+    assert t.kind == "explain" and t.query == "up"
+
+
+def test_copy():
+    c = parse_sql("COPY m TO '/tmp/out.parquet' WITH (format='parquet')")
+    assert isinstance(c, Copy) and c.direction == "to"
+    assert c.options == {"format": "parquet"}
+    c2 = parse_sql("COPY m FROM '/tmp/in.parquet'")
+    assert c2.direction == "from"
+
+
+def test_multiple_statements():
+    stmts = parse_statements("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+
+
+def test_string_escapes_and_comments():
+    q = parse_sql("""
+        -- line comment
+        SELECT 'it''s', "quoted col" /* block */ FROM t""")
+    assert q.projections[0].expr.value == "it's"
+    assert q.projections[1].expr.name == "quoted col"
+
+
+def test_error_reporting():
+    with pytest.raises(ParserError):
+        parse_sql("SELECT FROM")
+    with pytest.raises(ParserError):
+        parse_sql("FROBNICATE x")
+
+
+def test_review_regressions():
+    # unterminated type params must raise, not hang
+    with pytest.raises(ParserError, match="unterminated"):
+        parse_sql("SELECT CAST(a AS TIMESTAMP(3")
+    # TQL needs all three range params
+    with pytest.raises(ParserError, match="TQL"):
+        parse_sql("TQL EVAL (0, 100) up")
+    # a column named `time` coexists with the TIME INDEX constraint
+    st = parse_sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, time BIGINT)")
+    assert [c.name for c in st.columns] == ["ts", "time"]
+    st2 = parse_sql("CREATE TABLE t (ts TIMESTAMP, TIMESTAMP_INDEX(ts))")
+    assert st2.time_index == "ts"
+    # leading-zero ints parse as base 10; bad ints raise ParserError
+    assert parse_sql("SELECT 1 LIMIT 010").limit == 10
+    # SET with a negative number
+    assert parse_sql("SET x = -5").value == -5
+    # standalone VALUES is cleanly unsupported
+    with pytest.raises(ParserError):
+        parse_sql("VALUES (1, 2)")
