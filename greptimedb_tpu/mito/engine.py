@@ -1,0 +1,439 @@
+"""MitoEngine + MitoTable.
+
+Layout on the object store (mirrors the reference's `table_dir`/
+`region_name` scheme, src/table/src/engine.rs):
+
+    mito/engine.json                       — next_table_id + table registry
+    mito/{catalog}/{schema}/{table_id}/manifest.json — TableInfo
+    region data under region name "{table_id}_{region_number:010d}"
+
+DDL ordering follows the reference's manifest-first create
+(src/mito/src/engine/procedure/create.rs): persist the table manifest, then
+create regions, then register — recovery re-opens from the manifest.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .. import MITO_ENGINE
+from ..common.time import TimestampRange
+from ..datatypes.record_batch import RecordBatch
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..errors import (
+    ColumnExistsError,
+    ColumnNotFoundError,
+    InvalidArgumentsError,
+    TableAlreadyExistsError,
+    TableNotFoundError,
+)
+from ..partition import rule_from_partitions, split_rows
+from ..partition.rule import (
+    MAXVALUE,
+    PartitionRule,
+    RangeColumnsPartitionRule,
+    RangePartitionRule,
+)
+from ..storage.engine import StorageEngine
+from ..storage.region import Region
+from ..storage.write_batch import WriteBatch
+from ..table.metadata import TableIdent, TableInfo, TableMeta
+from ..table.requests import (
+    AlterKind,
+    AlterTableRequest,
+    CreateTableRequest,
+    DropTableRequest,
+    OpenTableRequest,
+)
+from ..table.table import Table, TableEngine
+
+MIN_USER_TABLE_ID = 1024
+
+
+def region_name(table_id: int, region_number: int) -> str:
+    return f"{table_id}_{region_number:010d}"
+
+
+def _serialize_rule(rule: Optional[PartitionRule]) -> Optional[dict]:
+    if rule is None:
+        return None
+
+    def enc(v):
+        return {"maxvalue": True} if v is MAXVALUE else v
+
+    if isinstance(rule, RangePartitionRule):
+        return {"kind": "range", "column": rule.column,
+                "bounds": [enc(b) for b in rule.bounds],
+                "regions": rule.regions}
+    if isinstance(rule, RangeColumnsPartitionRule):
+        return {"kind": "range_columns", "columns": rule.columns,
+                "bounds": [[enc(v) for v in b] for b in rule.bounds],
+                "regions": rule.regions}
+    raise InvalidArgumentsError(f"unserializable rule {type(rule)}")
+
+
+def _deserialize_rule(d: Optional[dict]) -> Optional[PartitionRule]:
+    if d is None:
+        return None
+
+    def dec(v):
+        return MAXVALUE if isinstance(v, dict) and v.get("maxvalue") else v
+
+    if d["kind"] == "range":
+        return RangePartitionRule(d["column"], [dec(b) for b in d["bounds"]],
+                                  list(d["regions"]))
+    return RangeColumnsPartitionRule(
+        list(d["columns"]), [tuple(dec(v) for v in b) for b in d["bounds"]],
+        list(d["regions"]))
+
+
+class MitoTable(Table):
+    def __init__(self, info: TableInfo, regions: Dict[int, Region],
+                 rule: Optional[PartitionRule] = None):
+        super().__init__(info)
+        self.regions = regions
+        self.partition_rule = rule
+
+    # ---- writes ----
+    def insert(self, columns: Dict[str, Sequence]) -> int:
+        if not columns:
+            return 0
+        num_rows = len(next(iter(columns.values())))
+        for name, vals in columns.items():
+            if len(vals) != num_rows:
+                raise InvalidArgumentsError(
+                    f"ragged insert column {name!r}")
+        splits = split_rows(self.partition_rule, columns, num_rows) \
+            if len(self.regions) > 1 else {min(self.regions): None}
+        written = 0
+        for rnum, idx in splits.items():
+            region = self.regions[rnum]
+            if idx is None:
+                part = columns
+            else:
+                part = {k: [v[i] for i in idx] for k, v in columns.items()}
+            wb = WriteBatch(region.schema)
+            wb.put(part)
+            region.write(wb)
+            written += num_rows if idx is None else len(idx)
+        return written
+
+    def delete(self, key_columns: Dict[str, Sequence]) -> int:
+        if not key_columns:
+            return 0
+        num_rows = len(next(iter(key_columns.values())))
+        splits = split_rows(self.partition_rule, key_columns, num_rows) \
+            if len(self.regions) > 1 else {min(self.regions): None}
+        deleted = 0
+        for rnum, idx in splits.items():
+            region = self.regions[rnum]
+            part = key_columns if idx is None else \
+                {k: [v[i] for i in idx] for k, v in key_columns.items()}
+            wb = WriteBatch(region.schema)
+            wb.delete(part)
+            region.write(wb)
+            deleted += num_rows if idx is None else len(idx)
+        return deleted
+
+    # ---- reads ----
+    def scan_raw(self, projection: Optional[Sequence[str]] = None,
+                 time_range: Optional[TimestampRange] = None):
+        return [r.snapshot().scan(projection=projection,
+                                  time_range=time_range)
+                for r in self.regions.values()]
+
+    def scan_batches(self, projection: Optional[Sequence[str]] = None,
+                     time_range: Optional[TimestampRange] = None,
+                     limit: Optional[int] = None) -> List[RecordBatch]:
+        out: List[RecordBatch] = []
+        remaining = limit
+        schema = self.schema if projection is None \
+            else self.schema.project(self._scan_columns(projection))
+        for region in self.regions.values():
+            data = region.snapshot().read_merged(
+                projection=projection, time_range=time_range)
+            rb = self._scan_data_to_batch(data, schema)
+            if remaining is not None:
+                rb = rb.slice(0, min(remaining, rb.num_rows))
+                remaining -= rb.num_rows
+            out.append(rb)
+            if remaining is not None and remaining <= 0:
+                break
+        return out
+
+    def _scan_columns(self, projection: Sequence[str]) -> List[str]:
+        return [c.name for c in self.schema.column_schemas
+                if c.name in projection]
+
+    def _scan_data_to_batch(self, data, schema: Schema) -> RecordBatch:
+        cols = {}
+        sd = data.series_dict
+        for c in schema.column_schemas:
+            if c.is_tag:
+                tag_idx = self.schema.tag_names().index(c.name)
+                cols[c.name] = sd.decode_tag_column(data.series_ids, tag_idx)
+            elif c.is_time_index:
+                cols[c.name] = data.ts
+            else:
+                if c.name in data.fields:
+                    vals, valid = data.fields[c.name]
+                    if valid is not None:
+                        vals = [None if not ok else v
+                                for v, ok in zip(vals.tolist(), valid.tolist())]
+                    cols[c.name] = vals
+                else:
+                    cols[c.name] = [None] * data.num_rows
+        return RecordBatch.from_pydict(schema, cols)
+
+    def flush(self) -> None:
+        for region in self.regions.values():
+            region.flush()
+
+    def close(self) -> None:
+        for region in self.regions.values():
+            region.close()
+
+
+class MitoEngine(TableEngine):
+    name = MITO_ENGINE
+
+    def __init__(self, storage: StorageEngine):
+        self.storage = storage
+        self.store = storage.store
+        self._tables: Dict[tuple, MitoTable] = {}
+        self._lock = threading.Lock()
+        self._registry = self._load_registry()
+
+    # ---- engine registry (next id + table dirs) ----
+    def _registry_key(self) -> str:
+        return "mito/engine.json"
+
+    def _load_registry(self) -> dict:
+        if self.store.exists(self._registry_key()):
+            return json.loads(self.store.read(self._registry_key()))
+        return {"next_table_id": MIN_USER_TABLE_ID, "tables": {}}
+
+    def _save_registry(self) -> None:
+        self.store.write(self._registry_key(),
+                         json.dumps(self._registry).encode())
+
+    def _manifest_key(self, catalog: str, schema: str, table_id: int) -> str:
+        return f"mito/{catalog}/{schema}/{table_id}/manifest.json"
+
+    # ---- DDL ----
+    def create_table(self, request: CreateTableRequest) -> MitoTable:
+        key = (request.catalog_name, request.schema_name, request.table_name)
+        full = ".".join(key)
+        with self._lock:
+            existing = self._tables.get(key)
+            if existing is None and full in self._registry["tables"]:
+                existing = self._open_locked(OpenTableRequest(
+                    request.table_name, request.catalog_name,
+                    request.schema_name))
+            if existing is not None:
+                if request.create_if_not_exists:
+                    return existing
+                raise TableAlreadyExistsError(f"table {full} already exists")
+            if request.table_id is not None:
+                table_id = request.table_id
+                self._registry["next_table_id"] = max(
+                    self._registry["next_table_id"], table_id + 1)
+            else:
+                table_id = self._registry["next_table_id"]
+                self._registry["next_table_id"] = table_id + 1
+
+            rule = None
+            region_numbers = list(request.region_numbers)
+            if request.partitions is not None:
+                rule = rule_from_partitions(request.partitions)
+                region_numbers = rule.region_numbers()
+            schema = request.schema
+            meta = TableMeta(
+                schema=schema,
+                primary_key_indices=list(request.primary_key_indices),
+                engine=self.name,
+                region_numbers=region_numbers,
+                next_column_id=len(schema),
+                options=dict(request.table_options),
+                partition_rule=_serialize_rule(rule),
+            )
+            info = TableInfo(ident=TableIdent(table_id),
+                             name=request.table_name, meta=meta,
+                             catalog_name=request.catalog_name,
+                             schema_name=request.schema_name,
+                             desc=request.desc)
+            # manifest first (create recovers from it), then regions
+            self.store.write(
+                self._manifest_key(*key[:2], table_id),
+                json.dumps(info.to_dict()).encode())
+            regions = {rn: self.storage.create_region(
+                region_name(table_id, rn), schema)
+                for rn in region_numbers}
+            table = MitoTable(info, regions, rule)
+            self._tables[key] = table
+            self._registry["tables"][full] = table_id
+            self._save_registry()
+            return table
+
+    def open_table(self, request: OpenTableRequest) -> Optional[MitoTable]:
+        with self._lock:
+            return self._open_locked(request)
+
+    def _open_locked(self, request: OpenTableRequest) -> Optional[MitoTable]:
+        key = (request.catalog_name, request.schema_name, request.table_name)
+        if key in self._tables:
+            return self._tables[key]
+        full = ".".join(key)
+        table_id = self._registry["tables"].get(full)
+        if table_id is None:
+            return None
+        raw = self.store.read(self._manifest_key(*key[:2], table_id))
+        info = TableInfo.from_dict(json.loads(raw))
+        rule = _deserialize_rule(info.meta.partition_rule)
+        regions = {}
+        for rn in info.meta.region_numbers:
+            region = self.storage.open_region(region_name(table_id, rn),
+                                              info.meta.schema)
+            if region is None:
+                region = self.storage.create_region(
+                    region_name(table_id, rn), info.meta.schema)
+            regions[rn] = region
+        table = MitoTable(info, regions, rule)
+        self._tables[key] = table
+        return table
+
+    def alter_table(self, request: AlterTableRequest) -> MitoTable:
+        key = (request.catalog_name, request.schema_name, request.table_name)
+        with self._lock:
+            table = self._tables.get(key) or self._open_locked(
+                OpenTableRequest(request.table_name, request.catalog_name,
+                                 request.schema_name))
+            if table is None:
+                raise TableNotFoundError(f"table {'.'.join(key)} not found")
+            info = table.info
+            schema = info.meta.schema
+            if request.kind == AlterKind.RENAME_TABLE:
+                new_key = key[:2] + (request.new_table_name,)
+                full, new_full = ".".join(key), ".".join(new_key)
+                if new_full in self._registry["tables"]:
+                    raise TableAlreadyExistsError(
+                        f"table {new_full} already exists")
+                info.name = request.new_table_name
+                self._registry["tables"][new_full] = \
+                    self._registry["tables"].pop(full)
+                del self._tables[key]
+                self._tables[new_key] = table
+            elif request.kind == AlterKind.ADD_COLUMNS:
+                cols = list(schema.column_schemas)
+                names = {c.name for c in cols}
+                for add in request.add_columns:
+                    cs = add.column_schema
+                    if cs.name in names:
+                        raise ColumnExistsError(
+                            f"column {cs.name!r} already exists")
+                    if not cs.nullable and cs.default is None:
+                        raise InvalidArgumentsError(
+                            f"new column {cs.name!r} must be nullable or "
+                            f"have a default")
+                    if add.location is None or add.location == "":
+                        cols.append(cs)
+                    elif add.location == "FIRST":
+                        cols.insert(0, cs)
+                    else:  # AFTER <col>
+                        after = add.location.split(" ", 1)[1]
+                        idx = next((i for i, c in enumerate(cols)
+                                    if c.name == after), None)
+                        if idx is None:
+                            raise ColumnNotFoundError(
+                                f"column {after!r} not found")
+                        cols.insert(idx + 1, cs)
+                    names.add(cs.name)
+                new_schema = Schema(cols, version=schema.version + 1)
+                for region in table.regions.values():
+                    region.alter(new_schema)
+                info.meta.schema = new_schema
+                info.meta.next_column_id = len(cols)
+                info.meta.primary_key_indices = [
+                    i for i, c in enumerate(cols)
+                    if c.semantic_type == SemanticType.TAG]
+                info.ident.version += 1
+            elif request.kind == AlterKind.DROP_COLUMNS:
+                cols = list(schema.column_schemas)
+                for name in request.drop_columns:
+                    idx = next((i for i, c in enumerate(cols)
+                                if c.name == name), None)
+                    if idx is None:
+                        raise ColumnNotFoundError(f"column {name!r} not found")
+                    c = cols[idx]
+                    if c.is_time_index or c.is_tag:
+                        raise InvalidArgumentsError(
+                            f"cannot drop key column {name!r}")
+                    cols.pop(idx)
+                new_schema = Schema(cols, version=schema.version + 1)
+                for region in table.regions.values():
+                    region.alter(new_schema)
+                info.meta.schema = new_schema
+                info.meta.primary_key_indices = [
+                    i for i, c in enumerate(cols)
+                    if c.semantic_type == SemanticType.TAG]
+                info.ident.version += 1
+            self.store.write(
+                self._manifest_key(info.catalog_name, info.schema_name,
+                                   info.ident.table_id),
+                json.dumps(info.to_dict()).encode())
+            self._save_registry()
+            return table
+
+    def drop_table(self, request: DropTableRequest) -> bool:
+        key = (request.catalog_name, request.schema_name, request.table_name)
+        with self._lock:
+            table = self._tables.get(key) or self._open_locked(
+                OpenTableRequest(request.table_name, request.catalog_name,
+                                 request.schema_name))
+            if table is None:
+                return False
+            for rn in table.info.meta.region_numbers:
+                self.storage.drop_region(
+                    region_name(table.info.ident.table_id, rn))
+            self.store.delete(self._manifest_key(
+                *key[:2], table.info.ident.table_id))
+            self._registry["tables"].pop(".".join(key), None)
+            self._tables.pop(key, None)
+            self._save_registry()
+            return True
+
+    def truncate_table(self, catalog: str, schema: str, name: str) -> bool:
+        """Drop + recreate regions, keeping table identity and schema."""
+        key = (catalog, schema, name)
+        with self._lock:
+            table = self._tables.get(key) or self._open_locked(
+                OpenTableRequest(name, catalog, schema))
+            if table is None:
+                return False
+            info = table.info
+            for rn in list(table.regions):
+                rname = region_name(info.ident.table_id, rn)
+                self.storage.drop_region(rname)
+                table.regions[rn] = self.storage.create_region(
+                    rname, info.meta.schema)
+            return True
+
+    def table_exists(self, catalog: str, schema: str, name: str) -> bool:
+        with self._lock:
+            return ".".join((catalog, schema, name)) in self._registry["tables"]
+
+    def get_table(self, catalog: str, schema: str, name: str
+                  ) -> Optional[MitoTable]:
+        return self.open_table(OpenTableRequest(name, catalog, schema))
+
+    def table_ids(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._registry["tables"])
+
+    def close(self) -> None:
+        with self._lock:
+            for table in self._tables.values():
+                table.close()
+            self._tables.clear()

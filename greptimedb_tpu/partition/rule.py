@@ -1,0 +1,181 @@
+"""Range partition rules mapping rows → region numbers.
+
+Semantics follow MySQL RANGE COLUMNS as the reference does
+(src/partition/src/columns.rs:49): regions are ordered by their exclusive
+upper bounds; a row belongs to the first region whose bound tuple is
+strictly greater than the row's partition-column tuple. MAXVALUE sorts
+above everything.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+class _MaxValue:
+    """Sorts above every concrete value (singleton MAXVALUE sentinel)."""
+
+    def __repr__(self):
+        return "MAXVALUE"
+
+
+MAXVALUE = _MaxValue()
+
+
+def _lt(a, b) -> bool:
+    """value < bound, where bound may be MAXVALUE."""
+    if b is MAXVALUE:
+        return True
+    if a is MAXVALUE:
+        return False
+    return a < b
+
+
+def _tuple_lt(row: Sequence, bound: Sequence) -> bool:
+    for a, b in zip(row, bound):
+        if _lt(a, b):
+            return True
+        if b is not MAXVALUE and a == b:
+            continue
+        return False
+    return False
+
+
+class PartitionRule:
+    """Maps a row (tuple of partition-column values) to a region number."""
+
+    def partition_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    def find_region(self, values: Sequence) -> int:
+        raise NotImplementedError
+
+    def region_numbers(self) -> List[int]:
+        raise NotImplementedError
+
+    def find_regions_by_filters(self, filters) -> List[int]:
+        """Prune regions by simple predicates (reference:
+        src/partition/src/manager.rs:192). Default: no pruning."""
+        return self.region_numbers()
+
+
+@dataclass
+class RangePartitionRule(PartitionRule):
+    """Single-column range rule: bounds are exclusive upper bounds, sorted
+    ascending, last may be MAXVALUE (reference: src/partition/src/range.rs:64)."""
+
+    column: str
+    bounds: List[Any]                  # len == number of regions
+    regions: List[int]                 # region number per bound
+
+    def partition_columns(self) -> List[str]:
+        return [self.column]
+
+    def region_numbers(self) -> List[int]:
+        return list(self.regions)
+
+    def find_region(self, values: Sequence) -> int:
+        v = values[0] if isinstance(values, (list, tuple)) else values
+        for bound, region in zip(self.bounds, self.regions):
+            if _lt(v, bound):
+                return region
+        raise ValueError(
+            f"value {v!r} above all partition bounds of {self.column!r} "
+            f"(missing MAXVALUE partition)")
+
+    def find_regions_by_filters(self, filters) -> List[int]:
+        from ..sql.ast import BinaryOp, Column, Literal
+        lo: Optional[Any] = None       # conservative AND-only pruning
+        hi: Optional[Any] = None
+        hi_strict = False              # v < hi (True) vs v <= hi (False)
+
+        def visit(e):
+            nonlocal lo, hi, hi_strict
+            if isinstance(e, BinaryOp):
+                if e.op == "and":
+                    visit(e.left)
+                    visit(e.right)
+                    return
+                col, lit, op = None, None, e.op
+                if isinstance(e.left, Column) and isinstance(e.right, Literal):
+                    col, lit = e.left, e.right
+                elif isinstance(e.right, Column) and isinstance(e.left, Literal):
+                    col, lit = e.right, e.left
+                    op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+                if col is None or col.name != self.column or lit.value is None:
+                    return
+                v = lit.value
+                if op in ("<", "<="):
+                    if hi is None or v < hi:
+                        hi, hi_strict = v, op == "<"
+                    elif v == hi and op == "<":
+                        hi_strict = True
+                elif op in (">", ">="):
+                    lo = v if lo is None else max(lo, v)
+                elif op == "=":
+                    lo = v
+                    if hi is None or v < hi:
+                        hi, hi_strict = v, False
+
+        for f in filters or ():
+            visit(f)
+        out = []
+        prev_bound: Optional[Any] = None
+        for bound, region in zip(self.bounds, self.regions):
+            # region covers [prev_bound, bound)
+            keep = True
+            if lo is not None and not _lt(lo, bound):
+                keep = False               # all region values <= lo
+            if hi is not None and prev_bound is not None:
+                if _lt(hi, prev_bound) or (hi == prev_bound and hi_strict):
+                    keep = False           # all region values > hi
+            if keep:
+                out.append(region)
+            prev_bound = bound
+        return out or list(self.regions)
+
+
+@dataclass
+class RangeColumnsPartitionRule(PartitionRule):
+    """Multi-column range rule with tuple bounds
+    (reference: src/partition/src/columns.rs:49)."""
+
+    columns: List[str]
+    bounds: List[Tuple]                # tuple upper bound per region
+    regions: List[int]
+
+    def partition_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def region_numbers(self) -> List[int]:
+        return list(self.regions)
+
+    def find_region(self, values: Sequence) -> int:
+        for bound, region in zip(self.bounds, self.regions):
+            if _tuple_lt(values, bound):
+                return region
+        raise ValueError(
+            f"value {tuple(values)!r} above all partition bounds "
+            f"(missing MAXVALUE partition)")
+
+    def find_regions_by_filters(self, filters) -> List[int]:
+        if len(self.columns) == 1:
+            return RangePartitionRule(
+                self.columns[0], [b[0] for b in self.bounds],
+                list(self.regions)).find_regions_by_filters(filters)
+        return self.region_numbers()
+
+
+def rule_from_partitions(partitions, region_numbers=None) -> PartitionRule:
+    """Build a rule from a parsed `sql.ast.Partitions` clause."""
+    regions = list(region_numbers) if region_numbers is not None \
+        else list(range(len(partitions.entries)))
+    bounds = []
+    for e in partitions.entries:
+        bounds.append(tuple(MAXVALUE if v == "MAXVALUE" else v
+                            for v in e.values))
+    if len(partitions.columns) == 1:
+        return RangePartitionRule(partitions.columns[0],
+                                  [b[0] for b in bounds], regions)
+    return RangeColumnsPartitionRule(list(partitions.columns), bounds, regions)

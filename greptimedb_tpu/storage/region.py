@@ -433,6 +433,11 @@ class Region:
             self.access_layer.schema = new_schema
             self._maybe_checkpoint()
 
+    @property
+    def schema(self) -> Schema:
+        """Current (possibly altered) region schema."""
+        return self.version_control.current.schema
+
     # ---- read ----
     def snapshot(self) -> RegionSnapshot:
         vc = self.version_control
@@ -440,10 +445,20 @@ class Region:
 
     # ---- misc ----
     def drop(self) -> None:
+        """Tombstone the manifest, then physically delete region data + WAL.
+
+        The remove action lands first so a crash mid-delete leaves a region
+        that `open()` reports as gone; leftover files are garbage, never
+        resurrected state. Physical removal lets the name be re-created
+        (TRUNCATE = drop + create)."""
         with self._writer_lock:
             self.manifest.save([{"type": "remove"}])
             self.closed = True
             self.wal.close()
+        for key in self.store.list(self.descriptor.region_dir):
+            self.store.delete(key)
+        import shutil
+        shutil.rmtree(self.descriptor.wal_dir, ignore_errors=True)
 
     def close(self) -> None:
         with self._writer_lock:

@@ -1,0 +1,85 @@
+"""Table engine request types.
+
+Reference behavior: src/table/src/requests.rs — Create/Open/Alter/Drop/
+Insert/Delete request structs handed to a `TableEngine`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..datatypes.schema import ColumnSchema, Schema
+from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
+
+
+@dataclass
+class CreateTableRequest:
+    table_name: str
+    schema: Schema
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME
+    desc: Optional[str] = None
+    primary_key_indices: List[int] = field(default_factory=list)
+    create_if_not_exists: bool = False
+    region_numbers: List[int] = field(default_factory=lambda: [0])
+    table_options: Dict[str, Any] = field(default_factory=dict)
+    partitions: Optional[object] = None      # sql.ast.Partitions
+    table_id: Optional[int] = None           # pre-allocated (distributed)
+
+
+@dataclass
+class OpenTableRequest:
+    table_name: str
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME
+    table_id: Optional[int] = None
+    region_numbers: Optional[List[int]] = None
+
+
+class AlterKind(enum.Enum):
+    ADD_COLUMNS = "add_columns"
+    DROP_COLUMNS = "drop_columns"
+    RENAME_TABLE = "rename_table"
+
+
+@dataclass
+class AddColumnRequest:
+    column_schema: ColumnSchema
+    is_key: bool = False
+    location: Optional[str] = None           # FIRST / AFTER <col>
+
+
+@dataclass
+class AlterTableRequest:
+    table_name: str
+    kind: AlterKind
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME
+    add_columns: List[AddColumnRequest] = field(default_factory=list)
+    drop_columns: List[str] = field(default_factory=list)
+    new_table_name: Optional[str] = None
+
+
+@dataclass
+class DropTableRequest:
+    table_name: str
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME
+
+
+@dataclass
+class InsertRequest:
+    table_name: str
+    columns: Dict[str, Sequence]
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME
+
+
+@dataclass
+class DeleteRequest:
+    table_name: str
+    key_columns: Dict[str, Sequence]
+    catalog_name: str = DEFAULT_CATALOG_NAME
+    schema_name: str = DEFAULT_SCHEMA_NAME

@@ -1,0 +1,215 @@
+"""Table layer + mito engine + catalog tests (mirrors src/mito engine tests
+and src/catalog local manager tests)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu import DEFAULT_CATALOG_NAME as CAT, DEFAULT_SCHEMA_NAME as SCH
+from greptimedb_tpu.catalog import LocalCatalogManager, MemoryCatalogManager
+from greptimedb_tpu.datatypes import data_type as dt
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.errors import (
+    ColumnNotFoundError, InvalidArgumentsError, TableAlreadyExistsError)
+from greptimedb_tpu.mito import MitoEngine
+from greptimedb_tpu.partition.rule import (
+    MAXVALUE, RangePartitionRule, rule_from_partitions)
+from greptimedb_tpu.sql import parse_sql
+from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
+from greptimedb_tpu.table import (
+    AddColumnRequest, AlterKind, AlterTableRequest, CreateTableRequest,
+    DropTableRequest, NumbersTable, OpenTableRequest)
+
+
+def monitor_schema():
+    return Schema([
+        ColumnSchema("host", dt.STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", dt.FLOAT64),
+        ColumnSchema("memory", dt.FLOAT64),
+    ])
+
+
+def mk_engine(tmp):
+    storage = StorageEngine(EngineConfig(data_home=str(tmp)))
+    return MitoEngine(storage)
+
+
+class TestMitoEngine:
+    def test_create_insert_scan(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        t = eng.create_table(CreateTableRequest(
+            "monitor", monitor_schema(), primary_key_indices=[0]))
+        assert t.info.ident.table_id >= 1024
+        n = t.insert({"host": ["a", "b", "a"], "ts": [1000, 1000, 2000],
+                      "cpu": [0.1, 0.2, 0.3], "memory": [1.0, 2.0, 3.0]})
+        assert n == 3
+        batches = t.scan_batches()
+        rows = sorted(r for b in batches for r in b.rows())
+        assert rows == [("a", 1000, 0.1, 1.0), ("a", 2000, 0.3, 3.0),
+                        ("b", 1000, 0.2, 2.0)]
+        raw = t.scan_raw()
+        assert len(raw) == 1 and raw[0].num_rows == 3
+
+    def test_create_if_not_exists_and_duplicate(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        req = CreateTableRequest("t", monitor_schema())
+        t1 = eng.create_table(req)
+        with pytest.raises(TableAlreadyExistsError):
+            eng.create_table(req)
+        req2 = CreateTableRequest("t", monitor_schema(),
+                                  create_if_not_exists=True)
+        assert eng.create_table(req2) is t1
+
+    def test_reopen_after_restart(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        t = eng.create_table(CreateTableRequest(
+            "monitor", monitor_schema(), primary_key_indices=[0]))
+        t.insert({"host": ["a"], "ts": [1], "cpu": [0.5], "memory": [1.0]})
+        t.flush()
+        t.insert({"host": ["a"], "ts": [2], "cpu": [0.6], "memory": [2.0]})
+        eng.close()
+        # fresh engine over the same data home: WAL replay + manifest recovery
+        eng2 = mk_engine(tmp_path)
+        t2 = eng2.open_table(OpenTableRequest("monitor"))
+        assert t2 is not None
+        rows = sorted(r for b in t2.scan_batches() for r in b.rows())
+        assert [(r[1], r[2]) for r in rows] == [(1, 0.5), (2, 0.6)]
+
+    def test_alter_add_drop_rename(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        eng.create_table(CreateTableRequest("m", monitor_schema(),
+                                            primary_key_indices=[0]))
+        t = eng.alter_table(AlterTableRequest(
+            "m", AlterKind.ADD_COLUMNS,
+            add_columns=[AddColumnRequest(ColumnSchema("load", dt.FLOAT64))]))
+        assert "load" in t.schema.names()
+        t.insert({"host": ["x"], "ts": [5], "cpu": [1.0], "memory": [2.0],
+                  "load": [0.9]})
+        rows = [r for b in t.scan_batches() for r in b.rows()]
+        assert rows[0][-1] == 0.9
+        t = eng.alter_table(AlterTableRequest(
+            "m", AlterKind.DROP_COLUMNS, drop_columns=["memory"]))
+        assert "memory" not in t.schema.names()
+        with pytest.raises(InvalidArgumentsError):
+            eng.alter_table(AlterTableRequest(
+                "m", AlterKind.DROP_COLUMNS, drop_columns=["host"]))
+        with pytest.raises(ColumnNotFoundError):
+            eng.alter_table(AlterTableRequest(
+                "m", AlterKind.DROP_COLUMNS, drop_columns=["nope"]))
+        eng.alter_table(AlterTableRequest(
+            "m", AlterKind.RENAME_TABLE, new_table_name="m2"))
+        assert eng.table_exists(CAT, SCH, "m2")
+        assert not eng.table_exists(CAT, SCH, "m")
+
+    def test_drop_and_truncate(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        t = eng.create_table(CreateTableRequest("d", monitor_schema()))
+        t.insert({"host": ["a"], "ts": [1], "cpu": [1.0], "memory": [1.0]})
+        assert eng.truncate_table(CAT, SCH, "d")
+        t = eng.get_table(CAT, SCH, "d")
+        assert sum(b.num_rows for b in t.scan_batches()) == 0
+        assert eng.drop_table(DropTableRequest("d"))
+        assert not eng.table_exists(CAT, SCH, "d")
+        # re-creating the same name works
+        eng.create_table(CreateTableRequest("d", monitor_schema()))
+
+    def test_partitioned_table(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        stmt = parse_sql("""
+            CREATE TABLE p (host STRING, ts TIMESTAMP TIME INDEX,
+                            cpu DOUBLE, PRIMARY KEY(host))
+            PARTITION BY RANGE COLUMNS (host) (
+              PARTITION r0 VALUES LESS THAN ('m'),
+              PARTITION r1 VALUES LESS THAN (MAXVALUE))""")
+        t = eng.create_table(CreateTableRequest(
+            "p", monitor_schema().project(["host", "ts", "cpu"]),
+            primary_key_indices=[0], partitions=stmt.partitions))
+        assert len(t.regions) == 2
+        t.insert({"host": ["alpha", "zulu", "beta"], "ts": [1, 2, 3],
+                  "cpu": [0.1, 0.2, 0.3]})
+        r0 = t.regions[0].snapshot().read_merged()
+        r1 = t.regions[1].snapshot().read_merged()
+        assert r0.num_rows == 2 and r1.num_rows == 1
+        rows = sorted(r for b in t.scan_batches() for r in b.rows())
+        assert [r[0] for r in rows] == ["alpha", "beta", "zulu"]
+
+    def test_delete(self, tmp_path):
+        eng = mk_engine(tmp_path)
+        t = eng.create_table(CreateTableRequest(
+            "del", monitor_schema(), primary_key_indices=[0]))
+        t.insert({"host": ["a", "b"], "ts": [1, 1],
+                  "cpu": [0.1, 0.2], "memory": [1, 2]})
+        t.delete({"host": ["a"], "ts": [1]})
+        rows = [r for b in t.scan_batches() for r in b.rows()]
+        assert len(rows) == 1 and rows[0][0] == "b"
+
+
+class TestPartitionRule:
+    def test_range_rule_and_pruning(self):
+        rule = RangePartitionRule("v", [10, 100, MAXVALUE], [0, 1, 2])
+        assert rule.find_region((5,)) == 0
+        assert rule.find_region((10,)) == 1
+        assert rule.find_region((1000,)) == 2
+        from greptimedb_tpu.sql import parse_sql
+        q = parse_sql("SELECT * FROM t WHERE v >= 100 AND v < 200")
+        assert rule.find_regions_by_filters([q.where]) == [2]
+        q2 = parse_sql("SELECT * FROM t WHERE v < 10")
+        assert rule.find_regions_by_filters([q2.where]) == [0]
+        q3 = parse_sql("SELECT * FROM t WHERE v = 50")
+        assert rule.find_regions_by_filters([q3.where]) == [1]
+
+    def test_rule_from_partitions_multi_column(self):
+        stmt = parse_sql("""
+            CREATE TABLE t (a STRING, b INT, ts TIMESTAMP TIME INDEX,
+                            PRIMARY KEY(a, b))
+            PARTITION BY RANGE COLUMNS (a, b) (
+              PARTITION p0 VALUES LESS THAN ('g', 10),
+              PARTITION p1 VALUES LESS THAN (MAXVALUE, MAXVALUE))""")
+        rule = rule_from_partitions(stmt.partitions)
+        assert rule.find_region(("a", 5)) == 0
+        assert rule.find_region(("g", 5)) == 0   # lexicographic: (g,5)<(g,10)
+        assert rule.find_region(("g", 15)) == 1
+        assert rule.find_region(("z", 0)) == 1
+
+
+class TestCatalog:
+    def test_memory_catalog(self):
+        cm = MemoryCatalogManager()
+        assert cm.catalog_names() == [CAT]
+        cm.register_schema(CAT, "mydb")
+        nt = NumbersTable()
+        cm.register_table(CAT, "mydb", "numbers", nt)
+        assert cm.table(CAT, "mydb", "numbers") is nt
+        assert cm.table_names(CAT, "mydb") == ["numbers"]
+        cm.deregister_table(CAT, "mydb", "numbers")
+        cm.deregister_schema(CAT, "mydb")
+        assert "mydb" not in cm.schema_names(CAT)
+
+    def test_local_catalog_persistence(self, tmp_path):
+        storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        eng = MitoEngine(storage)
+        cm = LocalCatalogManager(storage.store, {"mito": eng})
+        cm.start()
+        cm.register_schema(CAT, "db2")
+        t = eng.create_table(CreateTableRequest(
+            "m", monitor_schema(), schema_name="db2",
+            primary_key_indices=[0]))
+        cm.register_table(CAT, "db2", "m", t)
+        t.insert({"host": ["h"], "ts": [7], "cpu": [0.7], "memory": [7.0]})
+        # restart world
+        storage2 = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        eng2 = MitoEngine(storage2)
+        cm2 = LocalCatalogManager(storage2.store, {"mito": eng2})
+        cm2.start()
+        assert "db2" in cm2.schema_names(CAT)
+        t2 = cm2.table(CAT, "db2", "m")
+        assert t2 is not None
+        rows = [r for b in t2.scan_batches() for r in b.rows()]
+        assert rows == [("h", 7, 0.7, 7.0)]
+
+    def test_numbers_table(self):
+        nt = NumbersTable()
+        b = nt.scan_batches(limit=10)[0]
+        assert b.to_pydict()["number"] == list(range(10))
