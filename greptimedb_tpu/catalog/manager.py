@@ -111,7 +111,8 @@ class MemoryCatalogManager(CatalogManager):
                 raise DatabaseNotFoundError(
                     f"schema {catalog}.{schema} not found")
             if schemas[schema]:
-                raise DatabaseNotFoundError(
+                from ..errors import InvalidArgumentsError
+                raise InvalidArgumentsError(
                     f"schema {catalog}.{schema} is not empty")
             del schemas[schema]
 
@@ -159,6 +160,9 @@ class LocalCatalogManager(MemoryCatalogManager):
         self.store = store
         self.engines = engines
         self._started = False
+        # registrations whose engine was unavailable at start(); preserved
+        # verbatim in the system doc so a config fix can recover them
+        self._orphans: List[dict] = []
 
     # ---- persistence ----
     def _load_doc(self) -> dict:
@@ -178,7 +182,8 @@ class LocalCatalogManager(MemoryCatalogManager):
                       for n, t in self._catalogs[c][s].items()
                       if t.info.meta.engine in self.engines]
         self.store.write(SYSTEM_CATALOG_KEY, json.dumps(
-            {"schemas": schemas, "tables": tables}).encode())
+            {"schemas": schemas,
+             "tables": tables + list(self._orphans)}).encode())
 
     def start(self) -> None:
         """Replay the system catalog: register schemas, re-open tables."""
@@ -186,16 +191,23 @@ class LocalCatalogManager(MemoryCatalogManager):
         with self._lock:
             for c, s in doc["schemas"]:
                 self._catalogs.setdefault(c, {}).setdefault(s, {})
+        import logging
         for ent in doc["tables"]:
             engine = self.engines.get(ent["engine"])
-            if engine is None:
+            table = None
+            if engine is not None:
+                table = engine.open_table(OpenTableRequest(
+                    ent["name"], ent["catalog"], ent["schema"]))
+            if table is None:
+                logging.getLogger(__name__).warning(
+                    "catalog: cannot open %s.%s.%s (engine %r); keeping "
+                    "its registration", ent["catalog"], ent["schema"],
+                    ent["name"], ent["engine"])
+                self._orphans.append(ent)
                 continue
-            table = engine.open_table(OpenTableRequest(
-                ent["name"], ent["catalog"], ent["schema"]))
-            if table is not None:
-                with self._lock:
-                    self._catalogs[ent["catalog"]][ent["schema"]][
-                        ent["name"]] = table
+            with self._lock:
+                self._catalogs[ent["catalog"]][ent["schema"]][
+                    ent["name"]] = table
         self._started = True
 
     # ---- durable mutations ----

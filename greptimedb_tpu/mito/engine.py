@@ -106,7 +106,8 @@ class MitoTable(Table):
                 raise InvalidArgumentsError(
                     f"ragged insert column {name!r}")
         splits = split_rows(self.partition_rule, columns, num_rows) \
-            if len(self.regions) > 1 else {min(self.regions): None}
+            if self.partition_rule is not None \
+            else {min(self.regions): None}
         written = 0
         for rnum, idx in splits.items():
             region = self.regions[rnum]
@@ -125,7 +126,8 @@ class MitoTable(Table):
             return 0
         num_rows = len(next(iter(key_columns.values())))
         splits = split_rows(self.partition_rule, key_columns, num_rows) \
-            if len(self.regions) > 1 else {min(self.regions): None}
+            if self.partition_rule is not None \
+            else {min(self.regions): None}
         deleted = 0
         for rnum, idx in splits.items():
             region = self.regions[rnum]
@@ -249,6 +251,9 @@ class MitoEngine(TableEngine):
             if request.partitions is not None:
                 rule = rule_from_partitions(request.partitions)
                 region_numbers = rule.region_numbers()
+            elif len(region_numbers) > 1:
+                raise InvalidArgumentsError(
+                    "multi-region table requires a partition rule")
             schema = request.schema
             meta = TableMeta(
                 schema=schema,
@@ -307,7 +312,7 @@ class MitoEngine(TableEngine):
     def alter_table(self, request: AlterTableRequest) -> MitoTable:
         key = (request.catalog_name, request.schema_name, request.table_name)
         with self._lock:
-            table = self._tables.get(key) or self._open_locked(
+            table = self._open_locked(
                 OpenTableRequest(request.table_name, request.catalog_name,
                                  request.schema_name))
             if table is None:
@@ -333,6 +338,13 @@ class MitoEngine(TableEngine):
                     if cs.name in names:
                         raise ColumnExistsError(
                             f"column {cs.name!r} already exists")
+                    if cs.semantic_type != SemanticType.FIELD:
+                        # the region series dictionary is immutable (same as
+                        # the reference v0.2): new tags/time-index columns
+                        # would corrupt existing series encodings
+                        raise InvalidArgumentsError(
+                            f"only FIELD columns can be added, not "
+                            f"{cs.semantic_type.name}")
                     if not cs.nullable and cs.default is None:
                         raise InvalidArgumentsError(
                             f"new column {cs.name!r} must be nullable or "
@@ -389,7 +401,7 @@ class MitoEngine(TableEngine):
     def drop_table(self, request: DropTableRequest) -> bool:
         key = (request.catalog_name, request.schema_name, request.table_name)
         with self._lock:
-            table = self._tables.get(key) or self._open_locked(
+            table = self._open_locked(
                 OpenTableRequest(request.table_name, request.catalog_name,
                                  request.schema_name))
             if table is None:
@@ -408,8 +420,7 @@ class MitoEngine(TableEngine):
         """Drop + recreate regions, keeping table identity and schema."""
         key = (catalog, schema, name)
         with self._lock:
-            table = self._tables.get(key) or self._open_locked(
-                OpenTableRequest(name, catalog, schema))
+            table = self._open_locked(OpenTableRequest(name, catalog, schema))
             if table is None:
                 return False
             info = table.info

@@ -536,6 +536,9 @@ def _sorted_grouped_aggregate(gids, mask, ts, values, col_masks=(), *,
             results.append(seg_count(m, i).astype(jnp.int32))
         elif op == "sum":
             results.append(seg_sum(col, m, i).astype(fdt))
+        elif op == "sum_sq":
+            # partial moment for distributed/merged stddev computation
+            results.append(seg_sum(col, m, i, square=True))
         elif op == "avg":
             s, c = seg_sum(col, m, i), seg_count(m, i)
             results.append(jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan))

@@ -1,0 +1,16 @@
+"""Query engine: SQL statements → TPU kernels (hot path) or a CPU
+columnar fallback.
+
+Reference behavior: src/query — the `QueryEngine` trait + DataFusion
+executor (src/query/src/datafusion.rs:61-232). Here DataFusion's role is
+split per SURVEY.md §7: a Python analyzer lowers the parsed AST, and XLA is
+the physical executor for the scan→filter→group-by→time-bucket reduce
+pipeline (ops/kernels.py); everything the TPU shape doesn't cover runs on a
+pandas/numpy columnar fallback, mirroring how the reference leans on
+DataFusion for the long tail.
+"""
+
+from .output import Output
+from .engine import QueryEngine
+
+__all__ = ["Output", "QueryEngine"]

@@ -1,0 +1,368 @@
+"""QueryEngine: statement dispatch, CPU fallback executor, TPU fast path.
+
+Reference behavior: src/query/src/datafusion.rs — the engine optimizes and
+executes logical plans, streaming record batches. Here `execute` dispatches
+on statement type; SELECTs try the TPU aggregate path first
+(tpu_exec.try_execute) and otherwise run the pandas columnar fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..catalog import CatalogManager
+from ..datatypes import data_type as dt
+from ..datatypes.record_batch import RecordBatch
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..errors import (
+    PlanError, TableNotFoundError, UnsupportedError)
+from ..session import QueryContext
+from ..sql.ast import (
+    Column, DescribeTable, Explain, Query, ShowCreateTable, ShowDatabases,
+    ShowTables, ShowVariable, Star, Statement, TableRef)
+from ..table.table import Table
+from .expr import Evaluator, expr_name, like_to_regex
+from .functions import AGGREGATE_FUNCTIONS
+from .output import Output
+from .planner import Analysis, analyze, _group_slot
+from . import show as show_impl
+from . import tpu_exec
+
+
+class QueryEngine:
+    """Executes read statements against the catalog."""
+
+    def __init__(self, catalog: CatalogManager):
+        self.catalog = catalog
+
+    # ---- dispatch ----
+    def execute(self, stmt: Statement, ctx: Optional[QueryContext] = None
+                ) -> Output:
+        ctx = ctx or QueryContext()
+        if isinstance(stmt, Query):
+            return self.execute_query(stmt, ctx)
+        if isinstance(stmt, ShowDatabases):
+            return show_impl.show_databases(self, stmt, ctx)
+        if isinstance(stmt, ShowTables):
+            return show_impl.show_tables(self, stmt, ctx)
+        if isinstance(stmt, ShowCreateTable):
+            return show_impl.show_create_table(self, stmt, ctx)
+        if isinstance(stmt, ShowVariable):
+            return show_impl.show_variable(self, stmt, ctx)
+        if isinstance(stmt, DescribeTable):
+            return show_impl.describe_table(self, stmt, ctx)
+        if isinstance(stmt, Explain):
+            return self.explain(stmt, ctx)
+        raise UnsupportedError(
+            f"query engine cannot execute {type(stmt).__name__}")
+
+    def resolve_table(self, ref, ctx: QueryContext) -> Table:
+        if isinstance(ref, TableRef):
+            ref = ref.name
+        catalog, schema, name = ctx.resolve(ref)
+        table = self.catalog.table(catalog, schema, name)
+        if table is None:
+            raise TableNotFoundError(
+                f"table {catalog}.{schema}.{name} not found")
+        return table
+
+    # ---- EXPLAIN ----
+    def explain(self, stmt: Explain, ctx: QueryContext) -> Output:
+        inner = stmt.statement
+        lines: List[str] = []
+        if isinstance(inner, Query):
+            a = analyze(inner)
+            table = None
+            if inner.from_ is not None and inner.from_.name is not None:
+                table = self.resolve_table(inner.from_, ctx)
+            plan = tpu_exec.plan_for(table, a, inner) if table else None
+            if plan is not None:
+                lines.append("TpuAggregateExec: " + plan.describe())
+            elif a.is_aggregate:
+                lines.append("CpuAggregateExec: groups=" + ", ".join(
+                    expr_name(g) for g in a.group_exprs))
+            else:
+                lines.append("CpuProjectionExec")
+            if inner.where is not None:
+                lines.append("  Filter: " + expr_name(inner.where))
+            if table is not None:
+                lines.append(f"  TableScan: {table.name}")
+        else:
+            lines.append(type(inner).__name__)
+        schema = Schema([ColumnSchema("plan_type", dt.STRING),
+                         ColumnSchema("plan", dt.STRING)])
+        rb = RecordBatch.from_pydict(schema, {
+            "plan_type": ["logical_plan"], "plan": ["\n".join(lines)]})
+        if stmt.analyze:
+            out = self.execute_query(inner, ctx) \
+                if isinstance(inner, Query) else None
+            rows = out.num_rows if out else 0
+            rb = RecordBatch.from_pydict(schema, {
+                "plan_type": ["logical_plan", "analyze"],
+                "plan": ["\n".join(lines), f"rows: {rows}"]})
+        return Output.record_batches([rb])
+
+    # ---- SELECT ----
+    def execute_query(self, query: Query, ctx: QueryContext) -> Output:
+        if query.joins:
+            raise UnsupportedError("JOIN is not supported yet")
+        a = analyze(query)
+
+        table: Optional[Table] = None
+        if query.from_ is not None:
+            if query.from_.subquery is not None:
+                inner = self.execute_query(query.from_.subquery, ctx)
+                df = _batches_to_df(inner.batches)
+                return self._run_on_frame(df, a, query, None)
+            table = self.resolve_table(query.from_, ctx)
+
+        if table is None:
+            df = pd.DataFrame(index=[0])
+            return self._run_on_frame(df, a, query, None)
+
+        # TPU fast path
+        result = tpu_exec.try_execute(table, a, query)
+        if result is not None:
+            return self._finish_aggregate_frame(result, a, query, table)
+
+        # CPU fallback: scan the needed columns
+        needed = [c for c in table.schema.names() if c in a.column_refs] \
+            if a.column_refs and not self._needs_all(a, query) else None
+        batches = table.scan_batches(projection=needed)
+        df = _batches_to_df(batches)
+        return self._run_on_frame(df, a, query, table)
+
+    def _needs_all(self, a: Analysis, query: Query) -> bool:
+        return any(isinstance(p.expr, Star) for p in query.projections)
+
+    # ---- fallback execution over a DataFrame ----
+    def _run_on_frame(self, df: pd.DataFrame, a: Analysis, query: Query,
+                      table: Optional[Table]) -> Output:
+        if query.where is not None:
+            ev = Evaluator(df)
+            mask = ev.eval(query.where)
+            if not isinstance(mask, pd.Series):
+                mask = pd.Series([bool(mask)] * len(df), index=df.index)
+            df = df[mask.fillna(False).astype(bool)]
+
+        if a.is_aggregate:
+            grouped = self._aggregate(df, a, table)
+            return self._finish_aggregate_frame(grouped, a, query, table)
+
+        return self._project_and_finish(df, a, query, table)
+
+    def _aggregate(self, df: pd.DataFrame, a: Analysis,
+                   table: Optional[Table]) -> pd.DataFrame:
+        ev = Evaluator(df)
+        # order rows by time index so first/last are time-ordered
+        ts_col = None
+        if table is not None:
+            tc = table.schema.timestamp_column
+            ts_col = tc.name if tc is not None else None
+        if ts_col and ts_col in df.columns:
+            df = df.sort_values(ts_col, kind="stable")
+            ev = Evaluator(df)
+
+        key_cols = []
+        for g in a.group_exprs:
+            name = _group_slot(expr_name(g))
+            df = df.assign(**{name: ev.eval(g)})
+            key_cols.append(name)
+        ev = Evaluator(df)
+
+        arg_cols = []
+        for i, call in enumerate(a.agg_calls):
+            cname = f"__arg{i}"
+            if call.arg is None:
+                df = df.assign(**{cname: np.ones(len(df))})
+            else:
+                df = df.assign(**{cname: ev.eval(call.arg)})
+            arg_cols.append(cname)
+            ev = Evaluator(df)
+
+        def compute(group: pd.DataFrame) -> pd.Series:
+            out = {}
+            for i, call in enumerate(a.agg_calls):
+                vals = group[f"__arg{i}"]
+                if call.op == "count" and call.arg is None:
+                    out[call.slot] = len(group)
+                elif call.distinct and call.op == "count":
+                    out[call.slot] = int(vals.dropna().nunique())
+                elif call.op == "first":
+                    nn = vals.dropna()
+                    out[call.slot] = nn.iloc[0] if len(nn) else None
+                elif call.op == "last":
+                    nn = vals.dropna()
+                    out[call.slot] = nn.iloc[-1] if len(nn) else None
+                else:
+                    fn = AGGREGATE_FUNCTIONS.get(call.op)
+                    if fn is None:
+                        raise UnsupportedError(f"aggregate {call.op!r}")
+                    v = vals.dropna() if call.distinct else vals
+                    if call.distinct:
+                        v = v.drop_duplicates()
+                    out[call.slot] = fn(v.to_numpy(), *call.params)
+            return pd.Series(out)
+
+        if key_cols:
+            if len(df) == 0:
+                return pd.DataFrame(columns=key_cols +
+                                    [c.slot for c in a.agg_calls])
+            grouped = df.groupby(key_cols, dropna=False, sort=False) \
+                .apply(compute, include_groups=False).reset_index()
+        else:
+            grouped = compute(df).to_frame().T
+        return grouped
+
+    def _finish_aggregate_frame(self, grouped: pd.DataFrame, a: Analysis,
+                                query: Query, table: Optional[Table]
+                                ) -> Output:
+        ev = Evaluator(grouped)
+        if a.having is not None:
+            mask = ev.eval(a.having)
+            if isinstance(mask, pd.Series):
+                grouped = grouped[mask.fillna(False).astype(bool)]
+            elif not mask:
+                grouped = grouped.iloc[0:0]
+            ev = Evaluator(grouped)
+        return self._project_and_finish(grouped, a, query, table,
+                                        aggregated=True)
+
+    def _project_and_finish(self, df: pd.DataFrame, a: Analysis, query: Query,
+                            table: Optional[Table], aggregated: bool = False
+                            ) -> Output:
+        ev = Evaluator(df)
+        out_cols: Dict[str, Any] = {}
+        out_names: List[str] = []
+        source_cols: Dict[str, Optional[str]] = {}
+        for item in (a.projections if aggregated or a.is_aggregate
+                     else query.projections):
+            if isinstance(item.expr, Star):
+                cols = list(df.columns) if table is None else \
+                    [c for c in table.schema.names() if c in df.columns]
+                for c in cols:
+                    out_cols[c] = df[c]
+                    out_names.append(c)
+                    source_cols[c] = c
+                continue
+            name = item.alias or expr_name(item.expr)
+            if aggregated and isinstance(item.expr, Column) and \
+                    item.expr.name.startswith("__key__"):
+                name = item.alias or item.expr.name[len("__key__"):]
+            v = ev.eval(item.expr)
+            out_cols[name] = v if isinstance(v, pd.Series) else \
+                pd.Series([v] * max(len(df), 0 if aggregated else 1),
+                          index=df.index if len(df) else None)
+            out_names.append(name)
+            src = None
+            if isinstance(item.expr, Column):
+                src = item.expr.name
+                if aggregated and src.startswith("__key__"):
+                    src = None
+            source_cols[name] = src
+
+        proj = pd.DataFrame(out_cols, index=df.index if len(df) else None)
+        proj = proj[out_names] if out_names else proj
+
+        if query.distinct:
+            proj = proj.drop_duplicates()
+
+        # ORDER BY over the result frame (may reference hidden columns,
+        # which are evaluated against the pre-projection frame)
+        if query.order_by:
+            pairs = a.order_by if (aggregated or a.is_aggregate) \
+                else query.order_by
+            sort_frame = proj.copy()
+            keys: List[str] = []
+            ascs: List[bool] = []
+            base_ev = Evaluator(df)
+            for i, (e, asc) in enumerate(pairs):
+                target = None
+                if isinstance(e, Column) and e.name in proj.columns:
+                    target = e.name
+                elif expr_name(e) in proj.columns:
+                    target = expr_name(e)
+                if target is None:
+                    target = f"__ord{i}"
+                    v = base_ev.eval(e)
+                    sort_frame[target] = v if isinstance(v, pd.Series) \
+                        else pd.Series([v] * len(sort_frame),
+                                       index=sort_frame.index)
+                keys.append(target)
+                ascs.append(asc)
+            if keys and len(sort_frame):
+                sort_frame = sort_frame.sort_values(keys, ascending=ascs,
+                                                    kind="stable")
+                proj = proj.loc[sort_frame.index]
+
+        if query.offset:
+            proj = proj.iloc[query.offset:]
+        if query.limit is not None:
+            proj = proj.iloc[:query.limit]
+
+        schema = _infer_schema(proj, table, source_cols)
+        return Output.record_batches([_df_to_batch(proj, schema)], schema)
+
+
+# ---------------------------------------------------------------------------
+# frame <-> batch conversion
+# ---------------------------------------------------------------------------
+
+def _batches_to_df(batches: Optional[List[RecordBatch]]) -> pd.DataFrame:
+    if not batches:
+        return pd.DataFrame()
+    frames = []
+    for b in batches:
+        frames.append(pd.DataFrame(b.to_pydict()))
+    df = pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+    return df
+
+
+def _infer_schema(df: pd.DataFrame, table: Optional[Table],
+                  source_cols: Dict[str, Optional[str]]) -> Schema:
+    cols = []
+    for name in df.columns:
+        src = source_cols.get(name)
+        if table is not None and src is not None and \
+                table.schema.contains(src):
+            # keep the source dtype but not storage semantics: result sets
+            # are not storage tables (a nullable TIME INDEX is invalid)
+            cs = table.schema.column_schema(src)
+            cols.append(ColumnSchema(name, cs.dtype, nullable=True))
+            continue
+        cols.append(ColumnSchema(name, _np_to_type(df[name])))
+    return Schema(cols)
+
+
+def _np_to_type(s: pd.Series):
+    kind = s.dtype.kind
+    if kind == "b":
+        return dt.BOOLEAN
+    if kind == "i":
+        return dt.INT64
+    if kind == "u":
+        return dt.UINT64
+    if kind == "f":
+        return dt.FLOAT64
+    if kind == "M":
+        return dt.TIMESTAMP_MILLISECOND
+    return dt.STRING
+
+
+def _df_to_batch(df: pd.DataFrame, schema: Schema) -> RecordBatch:
+    cols = {}
+    for cs in schema.column_schemas:
+        s = df[cs.name]
+        if cs.dtype.is_string:
+            vals = [None if v is None or (isinstance(v, float) and np.isnan(v))
+                    else str(v) if not isinstance(v, str) else v
+                    for v in s.tolist()]
+            cols[cs.name] = vals
+        elif s.dtype.kind == "M":
+            cols[cs.name] = (s.astype(np.int64) // 1_000_000).tolist()
+        else:
+            cols[cs.name] = s.tolist()
+    return RecordBatch.from_pydict(schema, cols)

@@ -1,0 +1,233 @@
+"""Query analysis: classify a parsed SELECT and extract aggregate structure.
+
+The analog of the reference's logical planning (sqlparser AST → DataFusion
+LogicalPlan via src/query/src/planner.rs): here the AST is analyzed into an
+`Analysis` that either the TPU executor (tpu_exec.py) or the CPU fallback
+(engine.py) runs. Aggregate calls inside projections/HAVING/ORDER BY are
+rewritten to slot references so post-aggregation expressions evaluate over
+the grouped frame.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import PlanError, UnsupportedError
+from ..sql.ast import (
+    Between, BinaryOp, Case, Cast, Column, Expr, FunctionCall, InList,
+    IsNull, Literal, Query, SelectItem, Star, Subquery, UnaryOp,
+)
+from .expr import expr_name
+from .functions import AGGREGATE_FUNCTIONS
+
+AGG_NAMES = set(AGGREGATE_FUNCTIONS) | {"first", "last", "first_value",
+                                        "last_value"}
+_AGG_CANON = {"mean": "avg", "first_value": "first", "last_value": "last"}
+
+
+@dataclass
+class AggCall:
+    op: str                       # canonical op name
+    arg: Optional[Expr]           # None for count(*)
+    distinct: bool = False
+    params: Tuple = ()            # literal extras (percentile p, ...)
+    slot: str = ""                # column name in the grouped frame
+
+    @property
+    def is_count_star(self) -> bool:
+        return self.op == "count" and self.arg is None
+
+
+@dataclass
+class Analysis:
+    query: Query
+    projections: List[SelectItem] = field(default_factory=list)  # rewritten
+    group_exprs: List[Expr] = field(default_factory=list)
+    agg_calls: List[AggCall] = field(default_factory=list)
+    having: Optional[Expr] = None                                # rewritten
+    order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
+    column_refs: List[str] = field(default_factory=list)
+
+    @property
+    def is_aggregate(self) -> bool:
+        return bool(self.agg_calls) or bool(self.group_exprs)
+
+
+def _walk_columns(e: Expr, out: set) -> None:
+    if isinstance(e, Column):
+        out.add(e.name)
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr):
+            _walk_columns(child, out)
+    if isinstance(e, FunctionCall):
+        for a in e.args:
+            _walk_columns(a, out)
+    if isinstance(e, InList):
+        for a in e.items:
+            _walk_columns(a, out)
+    if isinstance(e, Case):
+        if e.operand:
+            _walk_columns(e.operand, out)
+        for c, v in e.whens:
+            _walk_columns(c, out)
+            _walk_columns(v, out)
+        if e.else_:
+            _walk_columns(e.else_, out)
+
+
+class _AggRewriter:
+    """Replaces aggregate FunctionCalls with slot Columns, collecting calls."""
+
+    def __init__(self):
+        self.calls: List[AggCall] = []
+        self._seen: Dict[str, str] = {}
+
+    def rewrite(self, e: Expr) -> Expr:
+        if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+            key = expr_name(e)
+            if key in self._seen:
+                return Column(self._seen[key])
+            op = _AGG_CANON.get(e.name, e.name)
+            arg: Optional[Expr] = None
+            params: Tuple = ()
+            if e.args and isinstance(e.args[0], Star):
+                if op != "count":
+                    raise PlanError(f"{op}(*) is not valid")
+            elif e.args:
+                arg = self.rewrite_inner_check(e.args[0])
+                params = tuple(a.value for a in e.args[1:]
+                               if isinstance(a, Literal))
+            elif op != "count":
+                raise PlanError(f"{op}() needs an argument")
+            slot = f"__agg{len(self.calls)}"
+            call = AggCall(op=op, arg=arg, distinct=e.distinct,
+                           params=params, slot=slot)
+            self.calls.append(call)
+            self._seen[key] = slot
+            return Column(slot)
+        return self._map_children(e)
+
+    def rewrite_inner_check(self, e: Expr) -> Expr:
+        if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+            raise PlanError("nested aggregate functions are not allowed")
+        return e
+
+    def _map_children(self, e: Expr) -> Expr:
+        if isinstance(e, BinaryOp):
+            return BinaryOp(e.op, self.rewrite(e.left), self.rewrite(e.right))
+        if isinstance(e, UnaryOp):
+            return UnaryOp(e.op, self.rewrite(e.operand))
+        if isinstance(e, Cast):
+            return Cast(self.rewrite(e.expr), e.type_name)
+        if isinstance(e, Between):
+            return Between(self.rewrite(e.expr), self.rewrite(e.low),
+                           self.rewrite(e.high), e.negated)
+        if isinstance(e, InList):
+            return InList(self.rewrite(e.expr),
+                          [self.rewrite(i) for i in e.items], e.negated)
+        if isinstance(e, IsNull):
+            return IsNull(self.rewrite(e.expr), e.negated)
+        if isinstance(e, Case):
+            return Case(
+                self.rewrite(e.operand) if e.operand else None,
+                [(self.rewrite(c), self.rewrite(v)) for c, v in e.whens],
+                self.rewrite(e.else_) if e.else_ else None)
+        if isinstance(e, FunctionCall):
+            return FunctionCall(e.name, [self.rewrite(a) for a in e.args],
+                                e.distinct)
+        return e
+
+
+def contains_aggregate(e: Expr) -> bool:
+    if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+        return True
+    if isinstance(e, FunctionCall):
+        return any(contains_aggregate(a) for a in e.args)
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr) and contains_aggregate(child):
+            return True
+    if isinstance(e, InList):
+        return any(contains_aggregate(i) for i in e.items)
+    if isinstance(e, Case):
+        parts = ([e.operand] if e.operand else []) + \
+            [x for cv in e.whens for x in cv] + \
+            ([e.else_] if e.else_ else [])
+        return any(contains_aggregate(p) for p in parts)
+    return False
+
+
+def analyze(query: Query) -> Analysis:
+    """Resolve GROUP BY / ORDER BY ordinals+aliases and extract aggregates."""
+    a = Analysis(query=query)
+    alias_map: Dict[str, Expr] = {}
+    for item in query.projections:
+        if item.alias:
+            alias_map[item.alias.lower()] = item.expr
+
+    def resolve_ref(e: Expr) -> Expr:
+        if isinstance(e, Literal) and isinstance(e.value, int):
+            idx = e.value - 1
+            if not (0 <= idx < len(query.projections)):
+                raise PlanError(f"ordinal {e.value} out of range")
+            return query.projections[idx].expr
+        if isinstance(e, Column) and e.table is None and \
+                e.name.lower() in alias_map:
+            return alias_map[e.name.lower()]
+        return e
+
+    a.group_exprs = [resolve_ref(g) for g in query.group_by]
+    for g in a.group_exprs:
+        if contains_aggregate(g):
+            raise PlanError("aggregate functions are not allowed in GROUP BY")
+
+    rw = _AggRewriter()
+    group_names = {expr_name(g) for g in a.group_exprs}
+
+    def rewrite_top(e: Expr) -> Expr:
+        # a projection identical to a group expr passes through
+        if expr_name(e) in group_names:
+            return Column(_group_slot(expr_name(e)))
+        return rw.rewrite(e)
+
+    a.projections = []
+    for item in query.projections:
+        if isinstance(item.expr, Star):
+            a.projections.append(item)
+            continue
+        a.projections.append(SelectItem(rewrite_top(item.expr), item.alias))
+    if query.having is not None:
+        a.having = rewrite_top(query.having)
+    a.order_by = []
+    for e, asc in query.order_by:
+        e = resolve_ref(e)
+        a.order_by.append((rewrite_top(e) if (rw.calls or a.group_exprs)
+                           else e, asc))
+    a.agg_calls = rw.calls
+
+    refs: set = set()
+    for item in query.projections:
+        if not isinstance(item.expr, Star):
+            _walk_columns(item.expr, refs)
+    for g in query.group_by:
+        _walk_columns(g, refs)
+    if query.where is not None:
+        _walk_columns(query.where, refs)
+    if query.having is not None:
+        _walk_columns(query.having, refs)
+    for e, _ in query.order_by:
+        _walk_columns(e, refs)
+    a.column_refs = sorted(refs)
+
+    if a.is_aggregate:
+        star = [p for p in a.projections if isinstance(p.expr, Star)]
+        if star:
+            raise PlanError("'*' projection is not valid with GROUP BY")
+    return a
+
+
+def _group_slot(name: str) -> str:
+    return f"__key__{name}"

@@ -1,0 +1,634 @@
+"""The TPU aggregate fast path.
+
+Executes the canonical time-series shape — scan → filter → group by tags
+and/or time bucket → aggregate — as one device kernel pass per region:
+
+1. per-region merged scan (sorted by (series, ts), MVCC-deduped) from a
+   version-keyed cache; arrays are device-resident across queries until the
+   region version changes (the HBM-resident memtable design of SURVEY §7);
+2. group ids are contiguous run ids over (series, bucket) — sorted by
+   construction, so the scatter-free sorted-segment kernel applies;
+3. the kernel computes decomposable *moments* (sum/sum_sq/count/min/max/
+   first+ts/last+ts) per run; runs fold into final SQL groups on the host
+   (tiny), which also merges partials across regions.
+
+Anything outside this shape returns None and the engine falls back to the
+CPU columnar executor — the same division of labor the reference has
+between its pushed-down scans and DataFusion.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..errors import UnsupportedError
+from ..ops.kernels import merge_dedup_numpy, shape_bucket, sorted_grouped_aggregate
+from ..sql.ast import (
+    Between, BinaryOp, Column, Expr, FunctionCall, InList, Interval, IsNull,
+    Literal, Query, UnaryOp,
+)
+from .expr import Evaluator, expr_name
+from .functions import TPU_AGGREGATES, parse_interval_ms
+from .planner import Analysis, _group_slot
+
+_CMP_OPS = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
+            ">=": "ge"}
+
+
+# ---------------------------------------------------------------------------
+# merged-scan cache (per region version)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MergedScan:
+    series_ids: np.ndarray            # int32, sorted
+    ts: np.ndarray                    # int64 epoch (region units)
+    fields: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
+    series_dict: object
+    ts_base: int                      # device ts = ts - ts_base (int32)
+    device: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.ts)
+
+    def device_ts(self):
+        import jax
+        if "__ts" not in self.device:
+            rel = self.ts - self.ts_base
+            if rel.size and (rel.max() >= 2**31 or rel.min() < 0):
+                raise UnsupportedError("region time span exceeds int32")
+            self.device["__ts"] = jax.device_put(rel.astype(np.int32))
+        return self.device["__ts"]
+
+    def device_field(self, name: str):
+        import jax
+        key = f"f:{name}"
+        if key not in self.device:
+            vals, valid = self.fields[name]
+            if vals.dtype == object:
+                raise UnsupportedError(f"field {name} is not numeric")
+            v = vals
+            if v.dtype == np.int64:
+                v = v.astype(np.float64) if abs(v).max(initial=0) >= 2**31 \
+                    else v.astype(np.int32)
+            if v.dtype == np.float64:
+                v = v.astype(np.float32) \
+                    if np.isfinite(v).all() and np.abs(v).max(initial=0) < 1e38 \
+                    else v
+            self.device[key] = jax.device_put(np.ascontiguousarray(v))
+        return self.device[key]
+
+    def device_valid(self, name: str):
+        import jax
+        key = f"v:{name}"
+        if key not in self.device:
+            _, valid = self.fields[name]
+            if valid is None:
+                return self.device_valid_all()
+            self.device[key] = jax.device_put(valid)
+        return self.device[key]
+
+    def device_valid_all(self):
+        import jax
+        if "__all_valid" not in self.device:
+            self.device["__all_valid"] = jax.device_put(
+                np.ones(self.num_rows, dtype=bool))
+        return self.device["__all_valid"]
+
+
+class _ScanCache:
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, MergedScan] = {}
+
+    def get(self, region) -> MergedScan:
+        snap = region.snapshot()
+        v = snap._version
+        key = (region.name, snap.visible_sequence, v.manifest_version,
+               v.schema.version)
+        with self._lock:
+            hit = self._entries.get(key)
+        if hit is not None:
+            return hit
+        data = snap.scan()
+        if data.num_rows:
+            kept = merge_dedup_numpy(data.series_ids, data.ts, data.seq,
+                                     data.op_types)
+            sids = data.series_ids[kept]
+            ts = data.ts[kept]
+            fields = {n: (d[kept], vd[kept] if vd is not None else None)
+                      for n, (d, vd) in data.fields.items()}
+        else:
+            sids, ts, fields = data.series_ids, data.ts, data.fields
+        base = int(ts.min()) if ts.size else 0
+        scan = MergedScan(sids.astype(np.int32), ts, fields,
+                          data.series_dict, base)
+        with self._lock:
+            if len(self._entries) >= self.capacity:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = scan
+        return scan
+
+
+SCAN_CACHE = _ScanCache()
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TagGroup:
+    name: str                         # tag column name
+    tag_index: int
+
+
+@dataclass
+class BucketGroup:
+    stride_ms: int
+    origin: int
+    expr_key: str                     # expr_name of the bucket expression
+
+
+@dataclass
+class FieldFilter:
+    column: str
+    op: str                           # eq/ne/lt/le/gt/ge
+    value: float
+
+
+@dataclass
+class Moment:
+    op: str                           # kernel op
+    column: Optional[str]             # field name; None = row count
+    slot: str
+
+
+@dataclass
+class TpuPlan:
+    tag_groups: List[TagGroup]
+    bucket: Optional[BucketGroup]
+    moments: List[Moment]
+    finals: List[Tuple[str, str, List[str]]]  # (slot, final op, moment slots)
+    time_lo: Optional[int]
+    time_hi: Optional[int]
+    tag_predicates: List[Expr]
+    field_filters: List[FieldFilter]
+
+    def describe(self) -> str:
+        gs = [t.name for t in self.tag_groups]
+        if self.bucket:
+            gs.append(f"time_bucket({self.bucket.stride_ms}ms)")
+        ops = [f"{op}" for _, op, _ in self.finals]
+        return f"groups=[{', '.join(gs)}] aggs=[{', '.join(ops)}]"
+
+
+def _conjuncts(e: Optional[Expr]) -> List[Expr]:
+    if e is None:
+        return []
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _refs(e: Expr) -> set:
+    from .planner import _walk_columns
+    out: set = set()
+    _walk_columns(e, out)
+    return out
+
+
+def _literal_num(e: Expr):
+    if isinstance(e, Literal) and isinstance(e.value, (int, float)) and \
+            not isinstance(e.value, bool):
+        return e.value
+    if isinstance(e, UnaryOp) and e.op == "-":
+        v = _literal_num(e.operand)
+        return -v if v is not None else None
+    return None
+
+
+def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
+    """Return a TpuPlan if (table, query) fits the fast-path shape."""
+    if table is None or not a.is_aggregate or query.joins:
+        return None
+    if not hasattr(table, "regions"):
+        return None  # only region-backed (mito) tables have the SoA path
+    schema = table.schema
+    tc = schema.timestamp_column
+    tag_names = schema.tag_names()
+    field_names = set(schema.field_names())
+
+    # group exprs: tags and at most one time bucket
+    tag_groups: List[TagGroup] = []
+    bucket: Optional[BucketGroup] = None
+    for g in a.group_exprs:
+        if isinstance(g, Column) and g.name in tag_names:
+            tag_groups.append(TagGroup(g.name, tag_names.index(g.name)))
+            continue
+        b = _match_bucket(g, tc.name if tc else None)
+        if b is not None and bucket is None:
+            bucket = b
+            continue
+        return None
+
+    # aggregates → moments
+    moments: List[Moment] = []
+    finals: List[Tuple[str, str, List[str]]] = []
+    seen: Dict[tuple, str] = {}
+
+    def moment(op: str, column: Optional[str]) -> str:
+        k = (op, column)
+        if k in seen:
+            return seen[k]
+        slot = f"__m{len(moments)}"
+        moments.append(Moment(op, column, slot))
+        seen[k] = slot
+        return slot
+
+    for call in a.agg_calls:
+        if call.distinct or call.op not in TPU_AGGREGATES:
+            return None
+        if call.arg is None:
+            if call.op != "count":
+                return None
+            finals.append((call.slot, "count", [moment("count", None)]))
+            continue
+        if not isinstance(call.arg, Column):
+            return None
+        col = call.arg.name
+        if col == (tc.name if tc else None):
+            col_kind = "ts"
+        elif col in field_names:
+            col_kind = "field"
+        else:
+            return None
+        cs = schema.column_schema(col)
+        if cs.dtype.is_string and call.op != "count":
+            return None
+        op = call.op
+        if op == "count":
+            finals.append((call.slot, "count", [moment("count", col)]))
+        elif op == "sum":
+            # count comes along so empty groups finalize to NULL, not 0
+            finals.append((call.slot, "sum",
+                           [moment("sum", col), moment("count", col)]))
+        elif op == "avg":
+            finals.append((call.slot, "avg",
+                           [moment("sum", col), moment("count", col)]))
+        elif op in ("min", "max"):
+            finals.append((call.slot, op,
+                           [moment(op, col), moment("count", col)]))
+        elif op in ("stddev", "variance"):
+            finals.append((call.slot, op,
+                           [moment("sum", col), moment("sum_sq", col),
+                            moment("count", col)]))
+        elif op in ("first", "last"):
+            mts = moment("min_ts" if op == "first" else "max_ts", col)
+            finals.append((call.slot, op, [moment(op, col), mts]))
+        else:
+            return None
+
+    # WHERE decomposition
+    time_lo = time_hi = None
+    tag_predicates: List[Expr] = []
+    field_filters: List[FieldFilter] = []
+    for c in _conjuncts(query.where):
+        refs = _refs(c)
+        if refs and refs <= set(tag_names):
+            tag_predicates.append(c)
+            continue
+        if tc is not None and refs == {tc.name}:
+            rng = _match_time_pred(c, tc.name)
+            if rng is None:
+                return None
+            lo, hi = rng
+            if lo is not None:
+                time_lo = lo if time_lo is None else max(time_lo, lo)
+            if hi is not None:
+                time_hi = hi if time_hi is None else min(time_hi, hi)
+            continue
+        ff = _match_field_pred(c, field_names)
+        if ff is None:
+            return None
+        field_filters.append(ff)
+
+    return TpuPlan(tag_groups, bucket, moments, finals, time_lo, time_hi,
+                   tag_predicates, field_filters)
+
+
+def _match_bucket(e: Expr, ts_name: Optional[str]) -> Optional[BucketGroup]:
+    """date_bin(INTERVAL, ts [, origin]) / date_trunc('unit', ts)."""
+    if ts_name is None or not isinstance(e, FunctionCall):
+        return None
+    if e.name == "date_bin" and len(e.args) >= 2:
+        stride = None
+        if isinstance(e.args[0], Interval):
+            stride = parse_interval_ms(e.args[0].text)
+        elif _literal_num(e.args[0]) is not None:
+            stride = int(_literal_num(e.args[0]))
+        if stride is None or stride <= 0:
+            return None
+        if not (isinstance(e.args[1], Column) and e.args[1].name == ts_name):
+            return None
+        origin = 0
+        if len(e.args) >= 3:
+            o = _literal_num(e.args[2])
+            if o is None:
+                return None
+            origin = int(o)
+        return BucketGroup(stride, origin, expr_name(e))
+    if e.name == "date_trunc" and len(e.args) == 2:
+        from .functions import _TRUNC_MS
+        if not isinstance(e.args[0], Literal):
+            return None
+        unit = str(e.args[0].value).lower()
+        if unit not in _TRUNC_MS:
+            return None
+        if not (isinstance(e.args[1], Column) and e.args[1].name == ts_name):
+            return None
+        return BucketGroup(_TRUNC_MS[unit], 0, expr_name(e))
+    return None
+
+
+def _match_time_pred(e: Expr, ts_name: str):
+    if isinstance(e, Between):
+        lo, hi = _literal_num(e.low), _literal_num(e.high)
+        if e.negated or lo is None or hi is None:
+            return None
+        return int(lo), int(hi) + 1
+    if not isinstance(e, BinaryOp):
+        return None
+    op = e.op
+    if isinstance(e.left, Column) and e.left.name == ts_name:
+        v = _literal_num(e.right)
+    elif isinstance(e.right, Column) and e.right.name == ts_name:
+        v = _literal_num(e.left)
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+    else:
+        return None
+    if v is None:
+        return None
+    v = int(v)
+    if op == "<":
+        return None, v
+    if op == "<=":
+        return None, v + 1
+    if op == ">":
+        return v + 1, None
+    if op == ">=":
+        return v, None
+    if op == "=":
+        return v, v + 1
+    return None
+
+
+def _match_field_pred(e: Expr, field_names: set) -> Optional[FieldFilter]:
+    if not isinstance(e, BinaryOp) or e.op not in _CMP_OPS:
+        return None
+    if isinstance(e.left, Column) and e.left.name in field_names:
+        v = _literal_num(e.right)
+        if v is None:
+            return None
+        return FieldFilter(e.left.name, _CMP_OPS[e.op], float(v))
+    if isinstance(e.right, Column) and e.right.name in field_names:
+        v = _literal_num(e.left)
+        if v is None:
+            return None
+        op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(
+            _CMP_OPS[e.op], _CMP_OPS[e.op])
+        return FieldFilter(e.right.name, op, float(v))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
+    plan = plan_for(table, a, query)
+    if plan is None:
+        return None
+    try:
+        frames = []
+        for region in table.regions.values():
+            part = _execute_region(region, table, plan)
+            if part is not None and len(part):
+                frames.append(part)
+    except UnsupportedError:
+        return None
+    if not frames:
+        cols = [_group_slot(t.name) for t in plan.tag_groups]
+        if plan.bucket:
+            cols.append(_group_slot(plan.bucket.expr_key))
+        cols += [slot for slot, _, _ in plan.finals]
+        return pd.DataFrame(columns=cols)
+    merged = pd.concat(frames, ignore_index=True)
+    return _finalize(merged, plan)
+
+
+def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
+    import jax
+
+    scan = SCAN_CACHE.get(region)
+    n = scan.num_rows
+    if n == 0:
+        return None
+    schema = table.schema
+    tag_names = schema.tag_names()
+
+    # ---- host: run ids over (series [, bucket]) ----
+    sids = scan.series_ids
+    if plan.bucket is not None:
+        b = plan.bucket
+        buckets = ((scan.ts - b.origin) // b.stride_ms).astype(np.int64)
+        flags = np.empty(n, dtype=bool)
+        flags[0] = True
+        np.not_equal(sids[1:], sids[:-1], out=flags[1:])
+        flags[1:] |= buckets[1:] != buckets[:-1]
+    else:
+        buckets = None
+        flags = np.empty(n, dtype=bool)
+        flags[0] = True
+        np.not_equal(sids[1:], sids[:-1], out=flags[1:])
+        if not plan.tag_groups:
+            flags[:] = False
+            flags[0] = True
+    rid = np.cumsum(flags, dtype=np.int32) - 1
+    nruns = int(rid[-1]) + 1
+    run_starts = np.nonzero(flags)[0]
+
+    # ---- host: per-series tag predicate → row mask ----
+    base_mask = None
+    if plan.tag_predicates:
+        sd = scan.series_dict
+        S = sd.num_series
+        tag_cols = {}
+        for i, tname in enumerate(tag_names):
+            tag_cols[tname] = sd.decode_tag_column(
+                np.arange(S, dtype=np.int32), i)
+        sdf = pd.DataFrame(tag_cols)
+        ev = Evaluator(sdf)
+        smask = np.ones(S, dtype=bool)
+        for p in plan.tag_predicates:
+            m = ev.eval(p)
+            m = m.fillna(False).astype(bool).to_numpy() \
+                if isinstance(m, pd.Series) else np.full(S, bool(m))
+            smask &= m
+        if not smask.any():
+            return None
+        base_mask = smask[sids]
+
+    # ---- row mask (host; cheap elementwise) ----
+    mask = base_mask if base_mask is not None else np.ones(n, dtype=bool)
+    if base_mask is not None:
+        mask = mask.copy()
+    if plan.time_lo is not None:
+        mask &= scan.ts >= plan.time_lo
+    if plan.time_hi is not None:
+        mask &= scan.ts < plan.time_hi
+    for ff in plan.field_filters:
+        vals, valid = scan.fields[ff.column]
+        if vals.dtype == object:
+            raise UnsupportedError(f"filter on non-numeric {ff.column}")
+        v = vals.astype(np.float64)
+        cmp = {"eq": v == ff.value, "ne": v != ff.value,
+               "lt": v < ff.value, "le": v <= ff.value,
+               "gt": v > ff.value, "ge": v >= ff.value}[ff.op]
+        if valid is not None:
+            cmp &= valid
+        mask &= cmp
+    if not mask.any():
+        return None
+
+    # ---- device kernel (module-level jit; compile cache shared across
+    # queries with the same moment signature + shape bucket) ----
+    d_ts = scan.device_ts()
+    nbucket = shape_bucket(nruns, minimum=256)
+    d_rid = jax.device_put(rid)
+    d_mask = jax.device_put(mask)
+
+    values = []
+    col_masks = []
+    ops = []
+    for m in plan.moments:
+        if m.op in ("min_ts", "max_ts"):
+            values.append(d_ts)
+            col_masks.append(scan.device_valid(m.column))
+            ops.append("min" if m.op == "min_ts" else "max")
+        elif m.column is None:
+            values.append(d_ts)   # dummy; count reads only the mask
+            col_masks.append(scan.device_valid_all())
+            ops.append("count")
+        else:
+            cs = schema.column_schema(m.column)
+            if cs.dtype.is_string or cs.dtype.is_binary:
+                values.append(d_ts)
+            else:
+                values.append(scan.device_field(m.column))
+            col_masks.append(scan.device_valid(m.column))
+            ops.append(m.op)
+
+    results, counts = sorted_grouped_aggregate(
+        d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
+        num_groups=nbucket, ops=tuple(ops), has_col_masks=True)
+    counts = np.asarray(counts)[:nruns]
+    res_np = [np.asarray(r)[:nruns] for r in results]
+
+    # ---- host: fold runs into final groups ----
+    live = counts > 0
+    if not live.any():
+        return None
+    frame: Dict[str, Any] = {}
+    run_sids = sids[run_starts]
+    sd = scan.series_dict
+    for tg in plan.tag_groups:
+        frame[_group_slot(tg.name)] = sd.decode_tag_column(
+            run_sids, tg.tag_index)
+    if plan.bucket is not None:
+        bkt = buckets[run_starts]
+        frame[_group_slot(plan.bucket.expr_key)] = \
+            bkt * plan.bucket.stride_ms + plan.bucket.origin
+    for m, r in zip(plan.moments, res_np):
+        frame[m.slot] = r
+    frame["__rowcount"] = counts
+    df = pd.DataFrame(frame)[live]
+    return df
+
+
+def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
+    key_cols = [_group_slot(t.name) for t in plan.tag_groups]
+    if plan.bucket is not None:
+        key_cols.append(_group_slot(plan.bucket.expr_key))
+
+    moment_cols = {m.slot: m for m in plan.moments}
+
+    def _ts_slot_for(m: Moment, kind: str) -> str:
+        return next(s for s, mm in moment_cols.items()
+                    if mm.op == kind and mm.column == m.column)
+
+    def merge(group: pd.DataFrame) -> pd.Series:
+        out = {}
+        for slot, m in moment_cols.items():
+            v = group[slot]
+            if m.op in ("sum", "sum_sq", "count"):
+                out[slot] = v.sum()
+            elif m.op in ("min", "min_ts"):
+                out[slot] = v.min()
+            elif m.op in ("max", "max_ts"):
+                out[slot] = v.max()
+            elif m.op in ("first", "last"):
+                # partial with a valid value whose ts is extreme wins
+                kind = "min_ts" if m.op == "first" else "max_ts"
+                ts_slot = _ts_slot_for(m, kind)
+                nn = group[group[slot].notna()]
+                if not len(nn):
+                    out[slot] = None
+                elif m.op == "first":
+                    out[slot] = nn.loc[nn[ts_slot].idxmin(), slot]
+                else:
+                    out[slot] = nn.loc[nn[ts_slot].idxmax(), slot]
+        return pd.Series(out)
+
+    if key_cols:
+        if df[key_cols + list(moment_cols)].duplicated(key_cols).any():
+            merged = df.groupby(key_cols, dropna=False, sort=False) \
+                .apply(merge, include_groups=False).reset_index()
+        else:
+            merged = df
+    else:
+        merged = merge(df).to_frame().T
+
+    # finalize ops from moments
+    out = merged[key_cols].copy() if key_cols else pd.DataFrame(
+        index=merged.index)
+    for slot, op, mslots in plan.finals:
+        if op in ("sum", "min", "max", "first", "last"):
+            out[slot] = merged[mslots[0]]
+        elif op == "count":
+            out[slot] = merged[mslots[0]].astype(np.int64)
+        elif op == "avg":
+            s, c = merged[mslots[0]], merged[mslots[1]]
+            out[slot] = np.where(c > 0, s / np.maximum(c, 1), np.nan)
+        elif op in ("stddev", "variance"):
+            s, sq, c = (merged[m] for m in mslots)
+            c = np.maximum(c, 1)
+            var = np.maximum(sq / c - (s / c) ** 2, 0.0)
+            out[slot] = np.sqrt(var) if op == "stddev" else var
+    # null out empty-count aggregates (kernel yields NaN already for floats)
+    for slot, op, mslots in plan.finals:
+        if op in ("sum", "min", "max", "first", "last", "avg"):
+            cnt = None
+            for ms in mslots:
+                if moment_cols[ms].op == "count":
+                    cnt = merged[ms]
+            if cnt is not None:
+                out.loc[cnt == 0, slot] = np.nan
+    return out.reset_index(drop=True)

@@ -47,7 +47,7 @@ class TableMeta:
     def value_indices(self) -> List[int]:
         pk = set(self.primary_key_indices)
         ts = None
-        tc = self.schema.timestamp_column()
+        tc = self.schema.timestamp_column
         if tc is not None:
             ts = self.schema.column_index(tc.name)
         return [i for i in range(len(self.schema))

@@ -1,0 +1,242 @@
+"""Query engine tests: fallback executor, TPU fast path, SHOW/DESCRIBE.
+
+The fallback (pandas) and TPU paths are cross-checked on identical data —
+the fallback is the oracle, mirroring how the reference validates pushed
+scans against DataFusion."""
+
+import math
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu import DEFAULT_CATALOG_NAME as CAT, DEFAULT_SCHEMA_NAME as SCH
+from greptimedb_tpu.catalog import MemoryCatalogManager
+from greptimedb_tpu.datatypes import data_type as dt
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.errors import TableNotFoundError, UnsupportedError
+from greptimedb_tpu.mito import MitoEngine
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query import tpu_exec
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.sql import parse_sql
+from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
+from greptimedb_tpu.table import CreateTableRequest, NumbersTable
+
+
+@pytest.fixture()
+def world(tmp_path):
+    storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+    mito = MitoEngine(storage)
+    cm = MemoryCatalogManager()
+    schema = Schema([
+        ColumnSchema("host", dt.STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("region", dt.STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", dt.FLOAT64),
+        ColumnSchema("mem", dt.FLOAT64),
+    ])
+    table = mito.create_table(CreateTableRequest(
+        "monitor", schema, primary_key_indices=[0, 1]))
+    rng = np.random.default_rng(9)
+    n = 4000
+    hosts = [f"h{i % 5}" for i in range(n)]
+    regions = ["east" if i % 2 else "west" for i in range(n)]
+    ts = (np.arange(n) * 250).tolist()          # 0..1000s, 4 per second
+    cpu = rng.random(n).round(4).tolist()
+    mem = [None if i % 17 == 0 else float(i % 100) for i in range(n)]
+    table.insert({"host": hosts, "region": regions, "ts": ts,
+                  "cpu": cpu, "mem": mem})
+    cm.register_table(CAT, SCH, "monitor", table)
+    cm.register_table(CAT, SCH, "numbers", NumbersTable())
+    engine = QueryEngine(cm)
+    return engine, table, dict(host=hosts, region=regions, ts=ts, cpu=cpu,
+                               mem=mem)
+
+
+def run(engine, sql):
+    return engine.execute(parse_sql(sql), QueryContext())
+
+
+class TestFallback:
+    def test_select_star_limit(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT * FROM monitor ORDER BY ts LIMIT 3")
+        assert out.num_rows == 3
+        assert out.schema.names() == ["host", "region", "ts", "cpu", "mem"]
+
+    def test_projection_exprs(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT cpu * 100 AS pct, host FROM monitor "
+                          "WHERE ts = 0")
+        row = out.batches[0].to_pylist()[0]
+        assert math.isclose(row["pct"], world[2]["cpu"][0] * 100)
+
+    def test_where_and_order(self, world):
+        engine, _, data = world
+        out = run(engine, "SELECT ts FROM monitor WHERE host = 'h1' AND "
+                          "ts < 10000 ORDER BY ts DESC")
+        vals = [r["ts"] for r in out.batches[0].to_pylist()]
+        want = sorted((t for h, t in zip(data["host"], data["ts"])
+                       if h == "h1" and t < 10000), reverse=True)
+        assert vals == want
+
+    def test_numbers(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT number FROM numbers ORDER BY number DESC "
+                          "LIMIT 5")
+        assert [r["number"] for r in out.batches[0].to_pylist()] == \
+            [99, 98, 97, 96, 95]
+
+    def test_no_from(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT 1 + 1, 'x'")
+        row = out.batches[0].to_pylist()[0]
+        assert list(row.values()) == [2, "x"]
+
+    def test_case_and_functions(self, world):
+        engine, *_ = world
+        out = run(engine, """
+            SELECT host, CASE WHEN cpu > 0.5 THEN 'hot' ELSE 'cold' END AS t
+            FROM monitor WHERE ts = 0""")
+        assert out.batches[0].to_pylist()[0]["t"] in ("hot", "cold")
+        out = run(engine, "SELECT abs(-3.5), pow(2, 10)")
+        row = list(out.batches[0].to_pylist()[0].values())
+        assert row == [3.5, 1024.0]
+
+    def test_aggregate_with_expr_group(self, world):
+        engine, _, data = world
+        # group by an expression the TPU path doesn't take (modulo)
+        out = run(engine, """
+            SELECT ts % 2 AS par, count(*) AS c FROM monitor GROUP BY par
+            ORDER BY par""")
+        rows = out.batches[0].to_pylist()
+        assert sum(r["c"] for r in rows) == 4000
+
+    def test_table_not_found(self, world):
+        engine, *_ = world
+        with pytest.raises(TableNotFoundError):
+            run(engine, "SELECT * FROM nope")
+
+    def test_distinct(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT DISTINCT region FROM monitor ORDER BY region")
+        assert [r["region"] for r in out.batches[0].to_pylist()] == \
+            ["east", "west"]
+
+    def test_count_distinct(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT count(DISTINCT host) AS c FROM monitor")
+        assert out.batches[0].to_pylist()[0]["c"] == 5
+
+    def test_having(self, world):
+        engine, _, data = world
+        out = run(engine, """
+            SELECT host, count(*) AS c FROM monitor GROUP BY host
+            HAVING count(*) > 100 ORDER BY host""")
+        assert all(r["c"] == 800 for r in out.batches[0].to_pylist())
+
+    def test_subquery_from(self, world):
+        engine, *_ = world
+        out = run(engine, """
+            SELECT count(*) AS c FROM
+            (SELECT host FROM monitor WHERE ts < 1000) s""")
+        assert out.batches[0].to_pylist()[0]["c"] == 4
+
+
+class TestTpuPath:
+    def _oracle(self, engine, sql, monkeypatch):
+        """Run the same query with the TPU path disabled."""
+        import greptimedb_tpu.query.tpu_exec as tx
+        orig = tx.try_execute
+        monkeypatch.setattr(tx, "try_execute", lambda *a, **k: None)
+        try:
+            return run(engine, sql)
+        finally:
+            monkeypatch.setattr(tx, "try_execute", orig)
+
+    @pytest.mark.parametrize("sql", [
+        "SELECT host, avg(cpu) FROM monitor GROUP BY host",
+        "SELECT host, region, max(cpu), min(cpu) FROM monitor "
+        "GROUP BY host, region",
+        "SELECT host, count(*) FROM monitor WHERE ts >= 100000 AND "
+        "ts < 500000 GROUP BY host",
+        "SELECT host, sum(mem), count(mem) FROM monitor GROUP BY host",
+        "SELECT host, date_bin(INTERVAL '1 minute', ts) AS minute, "
+        "avg(cpu) FROM monitor GROUP BY host, minute",
+        "SELECT avg(cpu), max(mem), count(*) FROM monitor",
+        "SELECT host, stddev(cpu) FROM monitor GROUP BY host",
+        "SELECT host, first(cpu), last(cpu) FROM monitor GROUP BY host",
+        "SELECT region, avg(cpu) FROM monitor WHERE host != 'h0' "
+        "GROUP BY region",
+        "SELECT host, avg(cpu) FROM monitor WHERE mem > 50 GROUP BY host",
+        "SELECT host, avg(cpu) AS a FROM monitor GROUP BY host "
+        "HAVING avg(cpu) > 0.4 ORDER BY a DESC LIMIT 3",
+    ])
+    def test_matches_fallback(self, world, sql, monkeypatch):
+        engine, table, _ = world
+        a = __import__("greptimedb_tpu.query.planner",
+                       fromlist=["analyze"]).analyze(parse_sql(sql))
+        plan = tpu_exec.plan_for(table, a, parse_sql(sql))
+        assert plan is not None, f"expected TPU plan for: {sql}"
+        got = run(engine, sql)
+        want = self._oracle(engine, sql, monkeypatch)
+        gr = got.batches[0].to_pylist()
+        wr = want.batches[0].to_pylist()
+        key = lambda r: tuple(str(v) for v in r.values())
+        if "ORDER BY" not in sql:
+            gr = sorted(gr, key=key)
+            wr = sorted(wr, key=key)
+        assert len(gr) == len(wr), sql
+        for g, w in zip(gr, wr):
+            assert list(g) == list(w), sql
+            for k in g:
+                gv, wv = g[k], w[k]
+                if isinstance(gv, float) and isinstance(wv, float):
+                    if math.isnan(gv) and math.isnan(wv):
+                        continue
+                    assert math.isclose(gv, wv, rel_tol=1e-3, abs_tol=1e-4), \
+                        (sql, k, gv, wv)
+                else:
+                    assert gv == wv, (sql, k, gv, wv)
+
+    def test_plan_rejects_unsupported(self, world):
+        engine, table, _ = world
+        for sql in [
+            "SELECT host, percentile(cpu, 50) FROM monitor GROUP BY host",
+            "SELECT ts % 2, count(*) FROM monitor GROUP BY 1",
+            "SELECT host, avg(cpu + 1) FROM monitor GROUP BY host",
+            "SELECT host, count(DISTINCT region) FROM monitor GROUP BY host",
+        ]:
+            stmt = parse_sql(sql)
+            a = __import__("greptimedb_tpu.query.planner",
+                           fromlist=["analyze"]).analyze(stmt)
+            assert tpu_exec.plan_for(table, a, stmt) is None, sql
+
+
+class TestShow:
+    def test_show_describe(self, world):
+        engine, *_ = world
+        out = run(engine, "SHOW TABLES")
+        names = [r["Tables"] for r in out.batches[0].to_pylist()]
+        assert "monitor" in names
+        out = run(engine, "SHOW TABLES LIKE 'mon%'")
+        assert [r["Tables"] for r in out.batches[0].to_pylist()] == ["monitor"]
+        out = run(engine, "DESCRIBE monitor")
+        rows = out.batches[0].to_pylist()
+        by_col = {r["Column"]: r for r in rows}
+        assert by_col["ts"]["Key"] == "TIME INDEX"
+        assert by_col["host"]["Semantic Type"] == "TAG"
+        assert by_col["cpu"]["Semantic Type"] == "FIELD"
+        out = run(engine, "SHOW CREATE TABLE monitor")
+        ddl = out.batches[0].to_pylist()[0]["Create Table"]
+        assert "TIME INDEX (ts)" in ddl and "PRIMARY KEY (host, region)" in ddl
+
+    def test_explain(self, world):
+        engine, *_ = world
+        out = run(engine, "EXPLAIN SELECT host, avg(cpu) FROM monitor "
+                          "GROUP BY host")
+        plan = out.batches[0].to_pylist()[0]["plan"]
+        assert "TpuAggregateExec" in plan
