@@ -1,0 +1,173 @@
+"""greptime CLI: option loading (TOML + flags) and server lifecycle.
+
+Reference behavior: src/cmd — `greptime standalone start -c config.toml
+--http-addr ...`; flags override file options (src/cmd/src/options.rs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class StandaloneOptions:
+    data_home: str = "./greptimedb_data"
+    http_addr: str = "127.0.0.1:4000"
+    mysql_addr: str = "127.0.0.1:4002"
+    postgres_addr: str = "127.0.0.1:4003"
+    grpc_addr: str = "127.0.0.1:4001"
+    user_provider: Optional[str] = None
+    enable_mysql: bool = True
+    enable_postgres: bool = True
+    enable_grpc: bool = True
+    log_level: str = "info"
+
+
+def load_options(args) -> StandaloneOptions:
+    opts = StandaloneOptions()
+    if getattr(args, "config_file", None):
+        import tomllib
+        with open(args.config_file, "rb") as f:
+            doc = tomllib.load(f)
+        opts.data_home = doc.get("storage", {}).get("data_home",
+                                                    opts.data_home)
+        http = doc.get("http", {})
+        opts.http_addr = http.get("addr", opts.http_addr)
+        mysql = doc.get("mysql", {})
+        opts.mysql_addr = mysql.get("addr", opts.mysql_addr)
+        opts.enable_mysql = mysql.get("enable", True)
+        pg = doc.get("postgres", {})
+        opts.postgres_addr = pg.get("addr", opts.postgres_addr)
+        opts.enable_postgres = pg.get("enable", True)
+        grpc = doc.get("grpc", {})
+        opts.grpc_addr = grpc.get("addr", opts.grpc_addr)
+        opts.enable_grpc = grpc.get("enable", True)
+        opts.log_level = doc.get("logging", {}).get("level", opts.log_level)
+    for name in ("data_home", "http_addr", "mysql_addr", "postgres_addr",
+                 "grpc_addr", "user_provider"):
+        v = getattr(args, name, None)
+        if v is not None:
+            setattr(opts, name, v)
+    return opts
+
+
+def build_servers(opts: StandaloneOptions):
+    """Compose standalone frontend + protocol servers (not yet started)."""
+    from ..datanode import DatanodeInstance, DatanodeOptions
+    from ..frontend import FrontendInstance
+    from ..servers.auth import NoopUserProvider, StaticUserProvider
+    from ..servers.http import HttpServer
+
+    dn = DatanodeInstance(DatanodeOptions(data_home=opts.data_home))
+    fe = FrontendInstance(dn)
+    fe.start()
+    provider = NoopUserProvider()
+    if opts.user_provider:
+        provider = StaticUserProvider.from_option(opts.user_provider)
+    servers = [HttpServer(fe, provider, opts.http_addr)]
+    if opts.enable_mysql:
+        try:
+            from ..servers.mysql import MysqlServer
+            servers.append(MysqlServer(fe, provider, opts.mysql_addr))
+        except ImportError:
+            pass
+    if opts.enable_postgres:
+        try:
+            from ..servers.postgres import PostgresServer
+            servers.append(PostgresServer(fe, provider, opts.postgres_addr))
+        except ImportError:
+            pass
+    if opts.enable_grpc:
+        try:
+            from ..servers.grpc import GrpcServer
+            servers.append(GrpcServer(fe, provider, opts.grpc_addr))
+        except ImportError:
+            pass
+    return fe, servers
+
+
+def standalone_start(args) -> None:
+    opts = load_options(args)
+    logging.basicConfig(
+        level=getattr(logging, opts.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    fe, servers = build_servers(opts)
+    for s in servers:
+        s.start()
+        logging.info("started %s on %s:%s", type(s).__name__,
+                     getattr(s, "host", "?"), getattr(s, "port", "?"))
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    logging.info("greptimedb_tpu standalone ready (data_home=%s)",
+                 opts.data_home)
+    stop.wait()
+    for s in servers:
+        s.shutdown()
+    fe.shutdown()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="greptime", description="greptimedb_tpu CLI")
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+
+    standalone = sub.add_parser("standalone")
+    ssub = standalone.add_subparsers(dest="action", required=True)
+    start = ssub.add_parser("start")
+    start.add_argument("-c", "--config-file")
+    start.add_argument("--data-home")
+    start.add_argument("--http-addr")
+    start.add_argument("--mysql-addr")
+    start.add_argument("--postgres-addr")
+    start.add_argument("--grpc-addr")
+    start.add_argument("--user-provider")
+    start.set_defaults(func=standalone_start)
+
+    cli = sub.add_parser("cli")
+    csub = cli.add_subparsers(dest="action", required=True)
+    attach = csub.add_parser("attach")
+    attach.add_argument("--grpc-addr", default="127.0.0.1:4001")
+    attach.set_defaults(func=_cli_attach)
+
+    args = parser.parse_args(argv)
+    args.func(args)
+    return 0
+
+
+def _cli_attach(args) -> None:
+    """Interactive SQL REPL over the gRPC client."""
+    from ..client import Database
+    db = Database(args.grpc_addr)
+    print("greptimedb_tpu REPL — end statements with ';', \\q to quit")
+    buf = []
+    while True:
+        try:
+            line = input("> " if not buf else "… ")
+        except EOFError:
+            break
+        if line.strip() in ("\\q", "exit", "quit"):
+            break
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "\n".join(buf)
+            buf = []
+            try:
+                out = db.sql(sql)
+                print(out.pretty())
+            except Exception as e:
+                print(f"error: {e}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

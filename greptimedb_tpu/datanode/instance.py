@@ -1,0 +1,65 @@
+"""Datanode instance: storage + table engines + catalog + query engine.
+
+Reference behavior: src/datanode/src/instance.rs — `Instance::new_with`
+builds object store → log store → storage engine → mito engine → catalog →
+query engine; `start_instance` replays the catalog (which replays region
+WALs via table open).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..catalog import LocalCatalogManager
+from ..mito import MitoEngine
+from ..query import QueryEngine
+from ..storage.engine import EngineConfig, StorageEngine
+from ..storage.object_store import FsObjectStore, ObjectStore
+from ..table import NumbersTable
+from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
+
+
+@dataclass
+class DatanodeOptions:
+    data_home: str = "./greptimedb_data"
+    node_id: int = 0
+    flush_size_bytes: int = 64 * 1024 * 1024
+    wal_sync_on_write: bool = False
+    disable_wal: bool = False
+    register_numbers_table: bool = True   # test fixture, like the reference
+
+
+class DatanodeInstance:
+    def __init__(self, opts: DatanodeOptions,
+                 store: Optional[ObjectStore] = None):
+        self.opts = opts
+        config = EngineConfig(
+            data_home=opts.data_home,
+            flush_size_bytes=opts.flush_size_bytes,
+            wal_sync_on_write=opts.wal_sync_on_write,
+            disable_wal=opts.disable_wal)
+        self.storage = StorageEngine(config, store=store)
+        self.store = self.storage.store
+        self.mito = MitoEngine(self.storage)
+        self.engines = {self.mito.name: self.mito}
+        self.catalog = LocalCatalogManager(self.store, self.engines)
+        self.query_engine = QueryEngine(self.catalog)
+        self._started = False
+
+    def start(self) -> None:
+        """Catalog replay → table open → region WAL replay."""
+        self.catalog.start()
+        if self.opts.register_numbers_table and \
+                self.catalog.table(DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME,
+                                   "numbers") is None:
+            self.catalog.register_table(
+                DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME, "numbers",
+                NumbersTable())
+        self._started = True
+
+    def shutdown(self) -> None:
+        for engine in self.engines.values():
+            engine.close()
+        self.storage.close()

@@ -1,0 +1,205 @@
+"""FrontendInstance: the handler all protocol servers call into.
+
+Reference behavior: src/frontend/src/instance.rs — implements
+`SqlQueryHandler` (do_query), auto create/alter-on-insert for protocol
+ingest (instance.rs:281-342), and wires the statement executor + query
+engine. In standalone mode it sits directly on an in-process datanode
+(instance.rs:200-222).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..datanode import DatanodeInstance
+from ..datatypes.data_type import (
+    ConcreteDataType, FLOAT64, INT64, STRING, TIMESTAMP_MILLISECOND)
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..errors import GreptimeError, TableNotFoundError
+from ..query.output import Output
+from ..session import QueryContext
+from ..sql import ast, parse_statements
+from ..table.requests import (
+    AddColumnRequest, AlterKind, AlterTableRequest, CreateTableRequest)
+from .statement import StatementExecutor
+
+GREPTIME_TIMESTAMP = "greptime_timestamp"
+GREPTIME_VALUE = "greptime_value"
+
+
+class FrontendInstance:
+    def __init__(self, datanode: DatanodeInstance):
+        self.datanode = datanode
+        self.catalog = datanode.catalog
+        self.query_engine = datanode.query_engine
+        self.statement_executor = StatementExecutor(
+            self.catalog, datanode.engines, self.query_engine)
+        self._tql_engine = None
+
+    def start(self) -> None:
+        if not self.datanode._started:
+            self.datanode.start()
+
+    def shutdown(self) -> None:
+        self.datanode.shutdown()
+
+    # ---- SqlQueryHandler ----
+    def do_query(self, sql: str, ctx: Optional[QueryContext] = None
+                 ) -> List[Output]:
+        ctx = ctx or QueryContext()
+        stmts = parse_statements(sql)
+        return [self.execute_stmt(s, ctx) for s in stmts]
+
+    def execute_stmt(self, stmt: ast.Statement, ctx: QueryContext) -> Output:
+        ex = self.statement_executor
+        if isinstance(stmt, ast.CreateTable):
+            return ex.create_table(stmt, ctx)
+        if isinstance(stmt, ast.CreateDatabase):
+            return ex.create_database(stmt, ctx)
+        if isinstance(stmt, ast.DropTable):
+            return ex.drop_table(stmt, ctx)
+        if isinstance(stmt, ast.DropDatabase):
+            return ex.drop_database(stmt, ctx)
+        if isinstance(stmt, ast.AlterTable):
+            return ex.alter_table(stmt, ctx)
+        if isinstance(stmt, ast.TruncateTable):
+            return ex.truncate_table(stmt, ctx)
+        if isinstance(stmt, ast.Insert):
+            return ex.insert(stmt, ctx)
+        if isinstance(stmt, ast.Delete):
+            return ex.delete(stmt, ctx)
+        if isinstance(stmt, ast.Use):
+            return ex.use_database(stmt, ctx)
+        if isinstance(stmt, ast.SetVariable):
+            return ex.set_variable(stmt, ctx)
+        if isinstance(stmt, ast.Copy):
+            return ex.copy(stmt, ctx)
+        if isinstance(stmt, ast.Tql):
+            return self.execute_tql(stmt, ctx)
+        return self.query_engine.execute(stmt, ctx)
+
+    def promql_engine(self):
+        """Lazily-built, shared PromQL engine (TQL + /api/v1 + /v1/promql)."""
+        if self._tql_engine is None:
+            try:
+                from ..promql.engine import PromqlEngine
+            except ImportError as e:
+                from ..errors import UnsupportedError
+                raise UnsupportedError(
+                    f"PromQL engine unavailable: {e}") from e
+            self._tql_engine = PromqlEngine(self.catalog)
+        return self._tql_engine
+
+    def execute_tql(self, stmt: ast.Tql, ctx: QueryContext) -> Output:
+        return self.promql_engine().execute_tql(stmt, ctx)
+
+    # ---- protocol ingest: auto create / alter on demand ----
+    def handle_row_insert(
+        self, table_name: str, columns: Dict[str, Sequence],
+        *, tag_columns: Sequence[str] = (),
+        timestamp_column: str = GREPTIME_TIMESTAMP,
+        types: Optional[Dict[str, ConcreteDataType]] = None,
+        ctx: Optional[QueryContext] = None,
+    ) -> int:
+        """Insert with auto table create / auto column add (reference:
+        create_or_alter_table_on_demand, src/frontend/src/instance.rs:292)."""
+        ctx = ctx or QueryContext()
+        catalog, schema_name = ctx.current_catalog, ctx.current_schema
+        table = self.catalog.table(catalog, schema_name, table_name)
+        types = types or {}
+        if table is None:
+            table = self._create_on_demand(
+                catalog, schema_name, table_name, columns, tag_columns,
+                timestamp_column, types)
+        else:
+            self._alter_on_demand(table, catalog, schema_name, table_name,
+                                  columns, types, tag_columns)
+            table = self.catalog.table(catalog, schema_name, table_name)
+        return table.insert(columns)
+
+    def _infer_type(self, name: str, values: Sequence,
+                    types: Dict[str, ConcreteDataType],
+                    timestamp_column: str) -> ConcreteDataType:
+        if name in types:
+            return types[name]
+        if name == timestamp_column:
+            return TIMESTAMP_MILLISECOND
+        for v in values:
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                from ..datatypes.data_type import BOOLEAN
+                return BOOLEAN
+            if isinstance(v, int):
+                return INT64
+            if isinstance(v, float):
+                return FLOAT64
+            if isinstance(v, str):
+                return STRING
+        return FLOAT64
+
+    def _create_on_demand(self, catalog, schema_name, table_name, columns,
+                          tag_columns, timestamp_column, types):
+        cols = []
+        tag_set = set(tag_columns)
+        for name, values in columns.items():
+            dtype = self._infer_type(name, values, types, timestamp_column)
+            if name == timestamp_column:
+                cols.append(ColumnSchema(name, dtype, nullable=False,
+                                         semantic_type=SemanticType.TIMESTAMP))
+            elif name in tag_set:
+                cols.append(ColumnSchema(name, dtype, nullable=False,
+                                         semantic_type=SemanticType.TAG))
+            else:
+                cols.append(ColumnSchema(name, dtype))
+        # stable layout: tags, timestamp, fields (reference column order)
+        cols.sort(key=lambda c: {SemanticType.TAG: 0,
+                                 SemanticType.TIMESTAMP: 1,
+                                 SemanticType.FIELD: 2}[c.semantic_type])
+        schema = Schema(cols)
+        pk = [i for i, c in enumerate(cols)
+              if c.semantic_type == SemanticType.TAG]
+        engine = self.datanode.mito
+        table = engine.create_table(CreateTableRequest(
+            table_name, schema, catalog_name=catalog,
+            schema_name=schema_name, primary_key_indices=pk,
+            create_if_not_exists=True))
+        self.catalog.register_table(catalog, schema_name, table_name, table)
+        return table
+
+    def _alter_on_demand(self, table, catalog, schema_name, table_name,
+                         columns, types, tag_columns=()):
+        missing = [name for name in columns
+                   if not table.schema.contains(name)]
+        if not missing:
+            return
+        new_tags = [n for n in missing if n in set(tag_columns)]
+        if new_tags:
+            # a new label cannot be added as a FIELD: distinct series that
+            # differ only in it would collapse onto one (row key unchanged)
+            # and MVCC dedup would silently drop samples. The series
+            # dictionary is immutable post-create (reference v0.2 alter has
+            # the same key restriction), so reject the write loudly.
+            from ..errors import InvalidArgumentsError
+            raise InvalidArgumentsError(
+                f"table {table_name!r} has no tag column(s) {new_tags}; "
+                f"tags cannot be added after create — write to a new table "
+                f"or recreate with the full label set")
+        adds = []
+        for name in missing:
+            dtype = self._infer_type(name, columns[name], types, "")
+            adds.append(AddColumnRequest(ColumnSchema(name, dtype)))
+        engine = self.datanode.engines[table.info.meta.engine]
+        engine.alter_table(AlterTableRequest(
+            table_name, AlterKind.ADD_COLUMNS, catalog_name=catalog,
+            schema_name=schema_name, add_columns=adds))
+
+
+def build_standalone(opts=None) -> FrontendInstance:
+    """Compose a standalone instance: frontend on an in-process datanode
+    (reference: src/cmd/src/standalone.rs:317-350)."""
+    from ..datanode import DatanodeOptions
+    dn = DatanodeInstance(opts or DatanodeOptions())
+    fe = FrontendInstance(dn)
+    fe.start()
+    return fe

@@ -1,0 +1,314 @@
+"""Statement executor: DDL + DML statements.
+
+Reference behavior: src/frontend/src/statement.rs + the datanode SQL
+handlers (src/datanode/src/sql/*.rs): CREATE/DROP/ALTER TABLE, CREATE/DROP
+DATABASE, INSERT, DELETE, USE, SET, TRUNCATE, COPY TO/FROM.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..catalog import CatalogManager
+from ..datatypes.data_type import parse_type_name
+from ..datatypes.schema import (
+    ColumnDefaultConstraint, ColumnSchema, Schema, SemanticType)
+from ..errors import (
+    DatabaseAlreadyExistsError, DatabaseNotFoundError, InvalidArgumentsError,
+    PlanError, TableNotFoundError, UnsupportedError)
+from ..query.expr import Evaluator
+from ..query.output import Output
+from ..session import QueryContext
+from ..sql import ast
+from ..table.requests import (
+    AddColumnRequest, AlterKind, AlterTableRequest, CreateTableRequest,
+    DropTableRequest)
+from ..table.table import TableEngine
+
+
+def build_column_schema(col: ast.ColumnDef, *, is_tag: bool,
+                        is_time_index: bool) -> ColumnSchema:
+    dtype = parse_type_name(col.type_name)
+    semantic = SemanticType.FIELD
+    if is_time_index:
+        semantic = SemanticType.TIMESTAMP
+        if not dtype.is_timestamp:
+            raise InvalidArgumentsError(
+                f"TIME INDEX column {col.name!r} must be a timestamp type")
+    elif is_tag:
+        semantic = SemanticType.TAG
+    default = None
+    if col.default is not None:
+        d = col.default
+        if isinstance(d, ast.FunctionCall) and d.name in (
+                "current_timestamp", "now"):
+            default = ColumnDefaultConstraint(function="current_timestamp")
+        elif isinstance(d, ast.Literal):
+            default = ColumnDefaultConstraint(value=d.value)
+        elif isinstance(d, ast.UnaryOp) and d.op == "-" and \
+                isinstance(d.operand, ast.Literal):
+            default = ColumnDefaultConstraint(value=-d.operand.value)
+        else:
+            raise InvalidArgumentsError(
+                f"unsupported default expression for {col.name!r}")
+    nullable = col.nullable and not is_time_index and not is_tag
+    return ColumnSchema(col.name, dtype, nullable=nullable,
+                        semantic_type=semantic, default=default,
+                        comment=col.comment or "")
+
+
+class StatementExecutor:
+    def __init__(self, catalog: CatalogManager,
+                 engines: Dict[str, TableEngine], query_engine):
+        self.catalog = catalog
+        self.engines = engines
+        self.query_engine = query_engine
+
+    def engine_for(self, name: str) -> TableEngine:
+        engine = self.engines.get(name)
+        if engine is None:
+            raise UnsupportedError(f"unknown table engine {name!r}")
+        return engine
+
+    # ---- DDL ----
+    def create_table(self, stmt: ast.CreateTable, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.name)
+        if not self.catalog.schema_exists(catalog, schema_name):
+            raise DatabaseNotFoundError(
+                f"schema {catalog}.{schema_name} not found")
+        if self.catalog.table(catalog, schema_name, table_name) is not None:
+            if stmt.if_not_exists:
+                return Output.rows(0)
+            from ..errors import TableAlreadyExistsError
+            raise TableAlreadyExistsError(
+                f"table {table_name!r} already exists")
+        pk = set(stmt.primary_keys)
+        cols = []
+        for c in stmt.columns:
+            cols.append(build_column_schema(
+                c, is_tag=c.name in pk,
+                is_time_index=c.name == stmt.time_index))
+        schema = Schema(cols)
+        pk_indices = [i for i, c in enumerate(cols)
+                      if c.semantic_type == SemanticType.TAG]
+        engine = self.engine_for(stmt.engine)
+        table = engine.create_table(CreateTableRequest(
+            table_name, schema, catalog_name=catalog,
+            schema_name=schema_name, primary_key_indices=pk_indices,
+            create_if_not_exists=stmt.if_not_exists,
+            table_options=dict(stmt.options), partitions=stmt.partitions))
+        self.catalog.register_table(catalog, schema_name, table_name, table)
+        return Output.rows(0)
+
+    def create_database(self, stmt: ast.CreateDatabase,
+                        ctx: QueryContext) -> Output:
+        try:
+            self.catalog.register_schema(ctx.current_catalog, stmt.name)
+        except DatabaseAlreadyExistsError:
+            if not stmt.if_not_exists:
+                raise
+        return Output.rows(1)
+
+    def drop_table(self, stmt: ast.DropTable, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.name)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            if stmt.if_exists:
+                return Output.rows(0)
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        engine = self.engine_for(table.info.meta.engine)
+        engine.drop_table(DropTableRequest(table_name, catalog, schema_name))
+        self.catalog.deregister_table(catalog, schema_name, table_name)
+        return Output.rows(0)
+
+    def drop_database(self, stmt: ast.DropDatabase,
+                      ctx: QueryContext) -> Output:
+        catalog = ctx.current_catalog
+        if not self.catalog.schema_exists(catalog, stmt.name):
+            if stmt.if_exists:
+                return Output.rows(0)
+            raise DatabaseNotFoundError(f"database {stmt.name!r} not found")
+        for tname in list(self.catalog.table_names(catalog, stmt.name)):
+            table = self.catalog.table(catalog, stmt.name, tname)
+            engine = self.engines.get(table.info.meta.engine)
+            if engine is not None:
+                engine.drop_table(DropTableRequest(tname, catalog, stmt.name))
+            self.catalog.deregister_table(catalog, stmt.name, tname)
+        self.catalog.deregister_schema(catalog, stmt.name)
+        return Output.rows(0)
+
+    def alter_table(self, stmt: ast.AlterTable, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.table)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        engine = self.engine_for(table.info.meta.engine)
+        op = stmt.operation
+        if isinstance(op, ast.AddColumn):
+            cs = build_column_schema(op.column, is_tag=False,
+                                     is_time_index=False)
+            req = AlterTableRequest(
+                table_name, AlterKind.ADD_COLUMNS, catalog_name=catalog,
+                schema_name=schema_name,
+                add_columns=[AddColumnRequest(cs, location=op.location)])
+        elif isinstance(op, ast.DropColumn):
+            req = AlterTableRequest(
+                table_name, AlterKind.DROP_COLUMNS, catalog_name=catalog,
+                schema_name=schema_name, drop_columns=[op.name])
+        elif isinstance(op, ast.RenameTable):
+            req = AlterTableRequest(
+                table_name, AlterKind.RENAME_TABLE, catalog_name=catalog,
+                schema_name=schema_name, new_table_name=op.new_name)
+        else:
+            raise UnsupportedError(f"ALTER operation {type(op).__name__}")
+        engine.alter_table(req)
+        if isinstance(op, ast.RenameTable):
+            self.catalog.rename_table(catalog, schema_name, table_name,
+                                      op.new_name)
+        return Output.rows(0)
+
+    def truncate_table(self, stmt: ast.TruncateTable,
+                       ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.name)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        engine = self.engine_for(table.info.meta.engine)
+        engine.truncate_table(catalog, schema_name, table_name)
+        return Output.rows(0)
+
+    # ---- DML ----
+    def insert(self, stmt: ast.Insert, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.table)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        schema = table.schema
+        columns = stmt.columns or schema.names()
+        for c in columns:
+            if not schema.contains(c):
+                from ..errors import ColumnNotFoundError
+                raise ColumnNotFoundError(
+                    f"column {c!r} not found in {table_name!r}")
+        if stmt.select is not None:
+            out = self.query_engine.execute_query(stmt.select, ctx)
+            rows = [list(r) for b in out.batches for r in b.rows()]
+        else:
+            ev = Evaluator(pd.DataFrame(index=[0]))
+            rows = []
+            for row in stmt.rows:
+                if len(row) != len(columns):
+                    raise InvalidArgumentsError(
+                        f"insert row has {len(row)} values, expected "
+                        f"{len(columns)}")
+                vals = []
+                for e in row:
+                    v = ev.eval(e)
+                    if isinstance(v, pd.Series):
+                        v = v.iloc[0]
+                    vals.append(v)
+                rows.append(vals)
+        data = {c: [r[i] for r in rows] for i, c in enumerate(columns)}
+        n = table.insert(data)
+        return Output.rows(n)
+
+    def delete(self, stmt: ast.Delete, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.table)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        schema = table.schema
+        tc = schema.timestamp_column
+        key_cols = schema.tag_names() + ([tc.name] if tc else [])
+        batches = table.scan_batches(projection=key_cols)
+        frames = [pd.DataFrame(b.to_pydict()) for b in batches]
+        df = pd.concat(frames, ignore_index=True) if frames else \
+            pd.DataFrame(columns=key_cols)
+        if stmt.where is not None and len(df):
+            mask = Evaluator(df).eval(stmt.where)
+            if isinstance(mask, pd.Series):
+                df = df[mask.fillna(False).astype(bool)]
+            elif not mask:
+                df = df.iloc[0:0]
+        if not len(df):
+            return Output.rows(0)
+        df = df.drop_duplicates()
+        n = table.delete({c: df[c].tolist() for c in key_cols})
+        return Output.rows(len(df))
+
+    # ---- session ----
+    def use_database(self, stmt: ast.Use, ctx: QueryContext) -> Output:
+        if not self.catalog.schema_exists(ctx.current_catalog, stmt.database):
+            raise DatabaseNotFoundError(
+                f"database {stmt.database!r} not found")
+        ctx.set_current_schema(stmt.database)
+        return Output.rows(0)
+
+    def set_variable(self, stmt: ast.SetVariable, ctx: QueryContext) -> Output:
+        if stmt.name.lower() in ("time_zone", "timezone"):
+            ctx.time_zone = str(stmt.value)
+        return Output.rows(0)
+
+    # ---- COPY ----
+    def copy(self, stmt: ast.Copy, ctx: QueryContext) -> Output:
+        catalog, schema_name, table_name = ctx.resolve(stmt.table)
+        table = self.catalog.table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        fmt = str(stmt.options.get("format", "parquet")).lower()
+        path = stmt.path
+        if stmt.direction == "to":
+            return self._copy_to(table, path, fmt)
+        return self._copy_from(table, path, fmt)
+
+    def _copy_to(self, table, path: str, fmt: str) -> Output:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        batches = table.scan_batches()
+        arrow_batches = [b.to_arrow() for b in batches if b.num_rows]
+        tbl = pa.Table.from_batches(arrow_batches) if arrow_batches else \
+            pa.Table.from_batches([], schema=table.schema.to_arrow())
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if fmt == "parquet":
+            pq.write_table(tbl, path)
+        elif fmt == "csv":
+            import pyarrow.csv as pcsv
+            pcsv.write_csv(tbl, path)
+        elif fmt == "json":
+            tbl.to_pandas().to_json(path, orient="records", lines=True)
+        else:
+            raise UnsupportedError(f"COPY format {fmt!r}")
+        return Output.rows(tbl.num_rows)
+
+    def _copy_from(self, table, path: str, fmt: str) -> Output:
+        import pyarrow.parquet as pq
+
+        if fmt == "parquet":
+            tbl = pq.read_table(path)
+        elif fmt == "csv":
+            import pyarrow.csv as pcsv
+            tbl = pcsv.read_csv(path)
+        elif fmt == "json":
+            tbl = pd.read_json(path, orient="records", lines=True)
+            import pyarrow as pa
+            tbl = pa.Table.from_pandas(tbl)
+        else:
+            raise UnsupportedError(f"COPY format {fmt!r}")
+        pdf = tbl.to_pandas()
+        cols = {}
+        for name in pdf.columns:
+            if not table.schema.contains(name):
+                continue
+            s = pdf[name]
+            if s.dtype.kind == "M":
+                s = s.astype(np.int64) // 1_000_000
+            cols[name] = [None if v is pd.NaT or (isinstance(v, float) and
+                                                  np.isnan(v)) else v
+                          for v in s.tolist()]
+        n = table.insert(cols)
+        return Output.rows(n)

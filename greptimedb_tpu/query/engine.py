@@ -254,8 +254,7 @@ class QueryEngine:
                 name = item.alias or item.expr.name[len("__key__"):]
             v = ev.eval(item.expr)
             out_cols[name] = v if isinstance(v, pd.Series) else \
-                pd.Series([v] * max(len(df), 0 if aggregated else 1),
-                          index=df.index if len(df) else None)
+                pd.Series([v] * len(df), index=df.index)
             out_names.append(name)
             src = None
             if isinstance(item.expr, Column):
@@ -363,6 +362,9 @@ def _df_to_batch(df: pd.DataFrame, schema: Schema) -> RecordBatch:
             cols[cs.name] = vals
         elif s.dtype.kind == "M":
             cols[cs.name] = (s.astype(np.int64) // 1_000_000).tolist()
+        elif s.dtype.kind == "f":
+            # SQL convention (as in pandas-backed systems): NaN is NULL
+            cols[cs.name] = [None if v != v else v for v in s.tolist()]
         else:
             cols[cs.name] = s.tolist()
     return RecordBatch.from_pydict(schema, cols)

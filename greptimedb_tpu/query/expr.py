@@ -67,10 +67,11 @@ class Evaluator:
         self._now = now_ms()
 
     def series(self, value) -> pd.Series:
-        """Broadcast a scalar result to a column of the frame's length."""
+        """Broadcast a scalar result to a column aligned with the frame's
+        index (the frame may be a WHERE-filtered view with gaps)."""
         if isinstance(value, pd.Series):
             return value
-        return pd.Series([value] * max(len(self.df), 1))
+        return pd.Series([value] * len(self.df), index=self.df.index)
 
     def eval(self, e: Expr):
         if isinstance(e, Literal):
@@ -212,9 +213,9 @@ class Evaluator:
         return dtype.cast_value(v)
 
     def _case(self, e: Case):
-        n = max(len(self.df), 1)
-        result = pd.Series([None] * n, dtype=object)
-        decided = pd.Series([False] * n)
+        idx = self.df.index
+        result = pd.Series([None] * len(idx), dtype=object, index=idx)
+        decided = pd.Series([False] * len(idx), index=idx)
         for cond, value in e.whens:
             if e.operand is not None:
                 c = self.eval(BinaryOp("=", e.operand, cond)) \

@@ -198,7 +198,9 @@ def analyze(query: Query) -> Analysis:
         if isinstance(item.expr, Star):
             a.projections.append(item)
             continue
-        a.projections.append(SelectItem(rewrite_top(item.expr), item.alias))
+        # keep the pre-rewrite display name: `avg(cpu)` not `__agg0`
+        alias = item.alias or expr_name(item.expr)
+        a.projections.append(SelectItem(rewrite_top(item.expr), alias))
     if query.having is not None:
         a.having = rewrite_top(query.having)
     a.order_by = []

@@ -73,14 +73,16 @@ class MergedScan:
             vals, valid = self.fields[name]
             if vals.dtype == object:
                 raise UnsupportedError(f"field {name} is not numeric")
+            import jax as _jax
             v = vals
-            if v.dtype == np.int64:
+            x64 = _jax.config.jax_enable_x64
+            if v.dtype == np.int64 and not x64:
                 v = v.astype(np.float64) if abs(v).max(initial=0) >= 2**31 \
                     else v.astype(np.int32)
-            if v.dtype == np.float64:
-                v = v.astype(np.float32) \
-                    if np.isfinite(v).all() and np.abs(v).max(initial=0) < 1e38 \
-                    else v
+            if v.dtype == np.float64 and not x64:
+                # TPU has no f64: the device mirrors are f32 (documented
+                # precision tradeoff); with x64 on (CPU) keep full precision
+                v = v.astype(np.float32)
             self.device[key] = jax.device_put(np.ascontiguousarray(v))
         return self.device[key]
 
@@ -111,7 +113,7 @@ class _ScanCache:
     def get(self, region) -> MergedScan:
         snap = region.snapshot()
         v = snap._version
-        key = (region.name, snap.visible_sequence, v.manifest_version,
+        key = (region.uid, snap.visible_sequence, v.manifest_version,
                v.schema.version)
         with self._lock:
             hit = self._entries.get(key)
@@ -271,7 +273,7 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
         else:
             return None
         cs = schema.column_schema(col)
-        if cs.dtype.is_string and call.op != "count":
+        if (cs.dtype.is_string or cs.dtype.is_binary) and call.op != "count":
             return None
         op = call.op
         if op == "count":
@@ -359,11 +361,13 @@ def _match_bucket(e: Expr, ts_name: Optional[str]) -> Optional[BucketGroup]:
 
 
 def _match_time_pred(e: Expr, ts_name: str):
+    import math as _math
     if isinstance(e, Between):
         lo, hi = _literal_num(e.low), _literal_num(e.high)
         if e.negated or lo is None or hi is None:
             return None
-        return int(lo), int(hi) + 1
+        # inclusive range: directional rounding for fractional bounds
+        return _math.ceil(lo), _math.floor(hi) + 1
     if not isinstance(e, BinaryOp):
         return None
     op = e.op
@@ -376,17 +380,19 @@ def _match_time_pred(e: Expr, ts_name: str):
         return None
     if v is None:
         return None
-    v = int(v)
+    # timestamps are integral: round fractional bounds toward the predicate
     if op == "<":
-        return None, v
+        return None, _math.ceil(v)          # ts < 10.5 ≡ ts < 11
     if op == "<=":
-        return None, v + 1
+        return None, _math.floor(v) + 1
     if op == ">":
-        return v + 1, None
+        return _math.floor(v) + 1, None     # ts > 10.5 ≡ ts >= 11
     if op == ">=":
-        return v, None
+        return _math.ceil(v), None
     if op == "=":
-        return v, v + 1
+        if v != int(v):
+            return 0, 0                     # fractional equality: empty
+        return int(v), int(v) + 1
     return None
 
 
@@ -428,8 +434,13 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
         cols = [_group_slot(t.name) for t in plan.tag_groups]
         if plan.bucket:
             cols.append(_group_slot(plan.bucket.expr_key))
-        cols += [slot for slot, _, _ in plan.finals]
-        return pd.DataFrame(columns=cols)
+        if cols:
+            return pd.DataFrame(columns=cols +
+                                [slot for slot, _, _ in plan.finals])
+        # global aggregate over zero rows still yields one row
+        row = {slot: (0 if op == "count" else np.nan)
+               for slot, op, _ in plan.finals}
+        return pd.DataFrame([row])
     merged = pd.concat(frames, ignore_index=True)
     return _finalize(merged, plan)
 

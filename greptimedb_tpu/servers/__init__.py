@@ -1,0 +1,7 @@
+"""Protocol servers.
+
+Reference behavior: src/servers — HTTP (axum → aiohttp here), MySQL,
+Postgres, gRPC/Flight, InfluxDB line protocol, OpenTSDB, Prometheus remote
+read/write, with pluggable auth (src/servers/src/auth/) and per-protocol
+handler traits implemented by the frontend.
+"""

@@ -1,0 +1,402 @@
+"""HTTP API server (aiohttp).
+
+Reference behavior: src/servers/src/http.rs:434-578 — routes /v1/sql,
+/v1/promql, /v1/influxdb/write, /v1/opentsdb/api/put,
+/v1/prometheus/{write,read}, /metrics, health/status, admin flush, plus the
+Prometheus-compatible query API (src/servers/src/prom.rs) mounted under
+/api/v1. Responses use the GreptimeDB JSON envelope
+{"code": 0, "output": [...], "execution_time_ms": n}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from ..errors import AuthError, GreptimeError, StatusCode
+from ..query.output import Output
+from ..session import Channel, QueryContext
+from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
+from . import influxdb as influx_mod
+from . import opentsdb as tsdb_mod
+from . import prometheus as prom_mod
+from .auth import NoopUserProvider, UserProvider
+
+
+def parse_db_param(db: Optional[str]) -> tuple:
+    if not db:
+        return DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
+    if "-" in db:
+        catalog, _, schema = db.partition("-")
+        return catalog, schema
+    return DEFAULT_CATALOG_NAME, db
+
+
+def output_to_json(out: Output) -> Dict[str, Any]:
+    if not out.is_batches:
+        return {"affectedrows": out.affected_rows or 0}
+    schema = out.schema
+    col_schemas = [{"name": c.name, "data_type": c.dtype.name}
+                   for c in schema.column_schemas] if schema else []
+    rows: List[list] = []
+    for b in out.batches or []:
+        for r in b.rows():
+            rows.append([None if v != v else v
+                         if isinstance(v, float) else v for v in r])
+    return {"records": {"schema": {"column_schemas": col_schemas},
+                        "rows": rows}}
+
+
+class HttpServer:
+    def __init__(self, frontend, user_provider: Optional[UserProvider] = None,
+                 addr: str = "127.0.0.1:4000"):
+        self.frontend = frontend
+        self.user_provider = user_provider or NoopUserProvider()
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    # ---- app ----
+    def make_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._error_middleware])
+        r = app.router
+        r.add_route("*", "/v1/sql", self.handle_sql)
+        r.add_route("*", "/v1/promql", self.handle_promql)
+        r.add_post("/v1/influxdb/write", self.handle_influx_write)
+        r.add_post("/v1/influxdb/api/v2/write", self.handle_influx_write)
+        r.add_get("/v1/influxdb/health", self.handle_health)
+        r.add_post("/v1/opentsdb/api/put", self.handle_opentsdb_put)
+        r.add_post("/v1/prometheus/write", self.handle_prom_write)
+        r.add_post("/v1/prometheus/read", self.handle_prom_read)
+        r.add_get("/metrics", self.handle_metrics)
+        r.add_get("/health", self.handle_health)
+        r.add_get("/status", self.handle_status)
+        r.add_post("/v1/admin/flush", self.handle_flush)
+        r.add_route("*", "/api/v1/query", self.handle_prom_api_query)
+        r.add_route("*", "/api/v1/query_range", self.handle_prom_api_range)
+        r.add_route("*", "/api/v1/labels", self.handle_prom_api_labels)
+        r.add_route("*", "/api/v1/series", self.handle_prom_api_series)
+        r.add_route("*", "/api/v1/label/{name}/values",
+                    self.handle_prom_api_label_values)
+        return app
+
+    @web.middleware
+    async def _error_middleware(self, request, handler):
+        start = time.perf_counter()
+        try:
+            resp = await handler(request)
+            return resp
+        except AuthError as e:
+            return web.json_response(
+                {"code": int(StatusCode.USER_PASSWORD_MISMATCH),
+                 "error": str(e)}, status=401)
+        except GreptimeError as e:
+            return web.json_response(
+                {"code": int(getattr(e, "status_code", StatusCode.INTERNAL)),
+                 "error": str(e),
+                 "execution_time_ms": int((time.perf_counter() - start) * 1e3)},
+                status=400)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            return web.json_response(
+                {"code": int(StatusCode.INTERNAL), "error": str(e)},
+                status=500)
+
+    def _ctx(self, request) -> QueryContext:
+        self.user_provider.auth_http_basic(
+            request.headers.get("Authorization"))
+        db = request.query.get("db") or request.headers.get("x-greptime-db")
+        catalog, schema = parse_db_param(db)
+        return QueryContext(catalog, schema, Channel.HTTP)
+
+    async def _param(self, request, name: str) -> Optional[str]:
+        if name in request.query:
+            return request.query[name]
+        if request.method == "POST":
+            if request.content_type == "application/x-www-form-urlencoded":
+                form = await request.post()
+                if name in form:
+                    return form[name]
+            elif request.content_type in ("application/json",):
+                try:
+                    body = await request.json()
+                    if isinstance(body, dict) and name in body:
+                        return str(body[name])
+                except Exception:
+                    pass
+        return None
+
+    # ---- handlers ----
+    async def handle_sql(self, request):
+        t0 = time.perf_counter()
+        ctx = self._ctx(request)
+        sql = await self._param(request, "sql")
+        if not sql:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "missing 'sql' parameter"}, status=400)
+        loop = asyncio.get_running_loop()
+        outputs = await loop.run_in_executor(
+            None, lambda: self.frontend.do_query(sql, ctx))
+        return web.json_response({
+            "code": 0,
+            "output": [output_to_json(o) for o in outputs],
+            "execution_time_ms": int((time.perf_counter() - t0) * 1e3),
+        })
+
+    async def handle_promql(self, request):
+        t0 = time.perf_counter()
+        ctx = self._ctx(request)
+        query = await self._param(request, "query")
+        start = await self._param(request, "start")
+        end = await self._param(request, "end")
+        step = await self._param(request, "step")
+        if not all([query, start, end, step]):
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "query/start/end/step are required"}, status=400)
+        from ..sql.ast import Tql
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, lambda: self.frontend.execute_tql(
+                Tql("eval", start, end, step, None, query), ctx))
+        return web.json_response({
+            "code": 0,
+            "output": [output_to_json(out)],
+            "execution_time_ms": int((time.perf_counter() - t0) * 1e3),
+        })
+
+    async def handle_influx_write(self, request):
+        ctx = self._ctx_influx(request)
+        precision = request.query.get("precision", "ns")
+        body = (await request.read()).decode()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            parsed = influx_mod.parse_lines(body, precision)
+            inserts, tag_cols = influx_mod.lines_to_inserts(parsed)
+            n = 0
+            for table, cols in inserts.items():
+                n += self.frontend.handle_row_insert(
+                    table, cols, tag_columns=tag_cols[table],
+                    timestamp_column=influx_mod.GREPTIME_TIMESTAMP, ctx=ctx)
+            return n
+
+        await loop.run_in_executor(None, work)
+        return web.Response(status=204)
+
+    def _ctx_influx(self, request) -> QueryContext:
+        # influxdb v1 auth: u/p params; v2: Token header; else basic
+        u = request.query.get("u")
+        p = request.query.get("p")
+        if u is not None or p is not None:
+            if not self.user_provider.authenticate(u or "", p or ""):
+                raise AuthError("bad username or password")
+        else:
+            auth = request.headers.get("Authorization")
+            if auth and auth.startswith("Token "):
+                token = auth[len("Token "):]
+                name, _, pwd = token.partition(":")
+                if not self.user_provider.authenticate(name, pwd):
+                    raise AuthError("bad token")
+            else:
+                self.user_provider.auth_http_basic(auth)
+        db = request.query.get("db") or request.query.get("bucket")
+        catalog, schema = parse_db_param(db)
+        return QueryContext(catalog, schema, Channel.INFLUX)
+
+    async def handle_opentsdb_put(self, request):
+        ctx = self._ctx(request)
+        body = await request.json()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            points = tsdb_mod.parse_http_put(body)
+            inserts, tag_cols = tsdb_mod.points_to_inserts(points)
+            for table, cols in inserts.items():
+                self.frontend.handle_row_insert(
+                    table, cols, tag_columns=tag_cols[table],
+                    timestamp_column=tsdb_mod.GREPTIME_TIMESTAMP, ctx=ctx)
+            return len(points)
+
+        n = await loop.run_in_executor(None, work)
+        return web.json_response({"success": n, "failed": 0}, status=200)
+
+    async def handle_prom_write(self, request):
+        ctx = self._ctx(request)
+        body = await request.read()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            series = prom_mod.decode_write_request(body)
+            inserts, tag_cols = prom_mod.series_to_inserts(series)
+            for table, cols in inserts.items():
+                self.frontend.handle_row_insert(
+                    table, cols, tag_columns=tag_cols[table],
+                    timestamp_column=prom_mod.GREPTIME_TIMESTAMP, ctx=ctx)
+
+        await loop.run_in_executor(None, work)
+        return web.Response(status=204)
+
+    async def handle_prom_read(self, request):
+        ctx = self._ctx(request)
+        body = await request.read()
+        loop = asyncio.get_running_loop()
+
+        def work():
+            queries = prom_mod.decode_read_request(body)
+            results = []
+            for q in queries:
+                results.append(self._remote_read_query(q, ctx))
+            return prom_mod.encode_read_response(results)
+
+        payload = await loop.run_in_executor(None, work)
+        return web.Response(body=payload,
+                            content_type="application/x-protobuf",
+                            headers={"Content-Encoding": "snappy"})
+
+    def _remote_read_query(self, q, ctx) -> List[prom_mod.TimeSeries]:
+        """Scan the metric table over [start, end] and re-assemble series
+        (reference: prometheus.rs remote read → SQL)."""
+        metric = q.metric_name()
+        if metric is None:
+            return []
+        table = self.frontend.catalog.table(
+            ctx.current_catalog, ctx.current_schema, metric)
+        if table is None:
+            return []
+        from ..common.time import TimestampRange
+        batches = table.scan_batches(
+            time_range=TimestampRange(q.start_ms, q.end_ms + 1))
+        tag_names = table.schema.tag_names()
+        ts_name = table.schema.timestamp_column.name
+        by_series: Dict[tuple, prom_mod.TimeSeries] = {}
+        for b in batches:
+            for row in b.to_pylist():
+                labels = {t: str(row[t]) for t in tag_names if t in row}
+                ok = True
+                for m in q.matchers:
+                    if m.name == prom_mod.METRIC_NAME_LABEL:
+                        continue
+                    if not m.matches(labels.get(m.name, "")):
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                key = tuple(sorted(labels.items()))
+                s = by_series.get(key)
+                if s is None:
+                    full = dict(labels)
+                    full[prom_mod.METRIC_NAME_LABEL] = metric
+                    s = prom_mod.TimeSeries(labels=full)
+                    by_series[key] = s
+                val = row.get(prom_mod.GREPTIME_VALUE)
+                if val is None:
+                    fields = table.schema.field_names()
+                    val = row.get(fields[0]) if fields else None
+                if val is not None:
+                    s.samples.append((float(val), int(row[ts_name])))
+        return list(by_series.values())
+
+    async def handle_metrics(self, request):
+        try:
+            from prometheus_client import generate_latest
+            return web.Response(body=generate_latest(),
+                                content_type="text/plain")
+        except ImportError:  # pragma: no cover
+            return web.Response(text="")
+
+    async def handle_health(self, request):
+        return web.json_response({})
+
+    async def handle_status(self, request):
+        from .. import __version__
+        return web.json_response({"version": __version__})
+
+    async def handle_flush(self, request):
+        ctx = self._ctx(request)
+        table_name = request.query.get("table")
+        loop = asyncio.get_running_loop()
+
+        def work():
+            cat = self.frontend.catalog
+            names = [table_name] if table_name else \
+                cat.table_names(ctx.current_catalog, ctx.current_schema)
+            for name in names:
+                t = cat.table(ctx.current_catalog, ctx.current_schema, name)
+                if t is not None:
+                    t.flush()
+
+        await loop.run_in_executor(None, work)
+        return web.json_response({"code": 0})
+
+    # ---- Prometheus HTTP API (prom.rs) ----
+    async def handle_prom_api_query(self, request):
+        from .prom_api import instant_query
+        return await instant_query(self, request)
+
+    async def handle_prom_api_range(self, request):
+        from .prom_api import range_query
+        return await range_query(self, request)
+
+    async def handle_prom_api_labels(self, request):
+        from .prom_api import labels_query
+        return await labels_query(self, request)
+
+    async def handle_prom_api_series(self, request):
+        from .prom_api import series_query
+        return await series_query(self, request)
+
+    async def handle_prom_api_label_values(self, request):
+        from .prom_api import label_values_query
+        return await label_values_query(self, request)
+
+    # ---- lifecycle (thread-hosted event loop) ----
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="http-server")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("http server failed to start")
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def boot():
+            app = self.make_app()
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            if self.port == 0:
+                self.port = self._runner.addresses[0][1]
+            self._started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    def shutdown(self) -> None:
+        if self._loop is None:
+            return
+
+        async def stop():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            asyncio.get_event_loop().stop()
+
+        self._loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(stop()))
+        if self._thread is not None:
+            self._thread.join(timeout=5)

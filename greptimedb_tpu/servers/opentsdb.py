@@ -1,0 +1,85 @@
+"""OpenTSDB ingestion: telnet `put` lines and the HTTP /api/put JSON body.
+
+Reference behavior: src/servers/src/opentsdb/codec.rs:291 — a DataPoint
+(metric, ts, value, tags) stored as table=metric, tags→tags,
+greptime_timestamp/greptime_value columns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..errors import InvalidArgumentsError
+
+GREPTIME_TIMESTAMP = "greptime_timestamp"
+GREPTIME_VALUE = "greptime_value"
+
+
+@dataclass
+class DataPoint:
+    metric: str
+    ts_ms: int
+    value: float
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+def parse_telnet_put(line: str) -> DataPoint:
+    """`put <metric> <timestamp> <value> <tagk=tagv> [...]`"""
+    parts = line.strip().split()
+    if not parts or parts[0] != "put":
+        raise InvalidArgumentsError(
+            "unknown command (expected 'put')" if parts else "empty line")
+    if len(parts) < 4:
+        raise InvalidArgumentsError(f"bad put line: {line!r}")
+    metric = parts[1]
+    ts = int(parts[2])
+    # seconds vs milliseconds heuristic (OpenTSDB convention)
+    ts_ms = ts * 1000 if ts < 10_000_000_000 else ts
+    value = float(parts[3])
+    tags = {}
+    for kv in parts[4:]:
+        k, sep, v = kv.partition("=")
+        if not sep or not k:
+            raise InvalidArgumentsError(f"bad tag {kv!r}")
+        tags[k] = v
+    return DataPoint(metric, ts_ms, value, tags)
+
+
+def parse_http_put(body) -> List[DataPoint]:
+    items = body if isinstance(body, list) else [body]
+    out = []
+    for it in items:
+        try:
+            ts = int(it["timestamp"])
+            out.append(DataPoint(
+                str(it["metric"]),
+                ts * 1000 if ts < 10_000_000_000 else ts,
+                float(it["value"]),
+                {str(k): str(v) for k, v in (it.get("tags") or {}).items()}))
+        except (KeyError, TypeError, ValueError) as e:
+            raise InvalidArgumentsError(f"bad datapoint: {it!r}") from e
+    return out
+
+
+def points_to_inserts(points: List[DataPoint]):
+    """Group per metric into aligned column dicts."""
+    by_metric: Dict[str, List[DataPoint]] = {}
+    for p in points:
+        by_metric.setdefault(p.metric, []).append(p)
+    result = {}
+    tag_cols = {}
+    for metric, pts in by_metric.items():
+        tag_names = sorted({k for p in pts for k in p.tags})
+        cols: Dict[str, list] = {GREPTIME_TIMESTAMP: [],
+                                 GREPTIME_VALUE: []}
+        for t in tag_names:
+            cols[t] = []
+        for p in pts:
+            cols[GREPTIME_TIMESTAMP].append(p.ts_ms)
+            cols[GREPTIME_VALUE].append(p.value)
+            for t in tag_names:
+                cols[t].append(p.tags.get(t, ""))
+        result[metric] = cols
+        tag_cols[metric] = tag_names
+    return result, tag_cols

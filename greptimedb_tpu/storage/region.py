@@ -188,6 +188,11 @@ class Region:
                  row_group_size: int = 65536):
         self.descriptor = descriptor
         self.name = descriptor.name
+        # unique per in-process region object: cache keys must not collide
+        # across engines whose regions share names (same table ids in
+        # different data homes)
+        import uuid
+        self.uid = uuid.uuid4().hex
         self.store = store
         self.flush_size_bytes = flush_size_bytes
         self._writer_lock = threading.RLock()

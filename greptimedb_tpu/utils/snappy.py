@@ -1,0 +1,108 @@
+"""Pure-Python snappy block-format codec.
+
+Prometheus remote read/write bodies are snappy-compressed protobuf
+(reference: src/servers/src/prometheus.rs:286). The image has no snappy
+binding, so this implements the block format directly: decompression is
+complete; compression emits literal-only blocks (valid snappy, ~0% ratio —
+fine for tests and small responses).
+"""
+
+from __future__ import annotations
+
+
+def _read_varint(data: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("snappy: truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 35:
+            raise ValueError("snappy: varint too long")
+
+
+def _write_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    expected, pos = _read_varint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        elem_type = tag & 0x03
+        if elem_type == 0x00:                       # literal
+            length = (tag >> 2) + 1
+            pos += 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > n:
+                    raise ValueError("snappy: truncated literal length")
+                length = int.from_bytes(data[pos:pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("snappy: truncated literal")
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if elem_type == 0x01:                       # copy, 1-byte offset
+            length = ((tag >> 2) & 0x07) + 4
+            if pos + 1 >= n:
+                raise ValueError("snappy: truncated copy1")
+            offset = ((tag >> 5) << 8) | data[pos + 1]
+            pos += 2
+        elif elem_type == 0x02:                     # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            if pos + 2 >= n:
+                raise ValueError("snappy: truncated copy2")
+            offset = int.from_bytes(data[pos + 1:pos + 3], "little")
+            pos += 3
+        else:                                       # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            if pos + 4 >= n:
+                raise ValueError("snappy: truncated copy4")
+            offset = int.from_bytes(data[pos + 1:pos + 5], "little")
+            pos += 5
+        if offset == 0 or offset > len(out):
+            raise ValueError("snappy: invalid copy offset")
+        start = len(out) - offset
+        for i in range(length):                     # may self-overlap
+            out.append(out[start + i])
+    if len(out) != expected:
+        raise ValueError(
+            f"snappy: length mismatch ({len(out)} != {expected})")
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """Literal-only snappy encoding (valid, uncompressed)."""
+    out = bytearray(_write_varint(len(data)))
+    pos = 0
+    n = len(data)
+    while pos < n:
+        chunk = min(n - pos, 65536)
+        if chunk <= 60:
+            out.append((chunk - 1) << 2)
+        else:
+            extra = (chunk - 1).bit_length() + 7 >> 3
+            out.append((59 + extra) << 2)
+            out += (chunk - 1).to_bytes(extra, "little")
+        out += data[pos:pos + chunk]
+        pos += chunk
+    return bytes(out)

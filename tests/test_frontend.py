@@ -1,0 +1,186 @@
+"""Standalone frontend end-to-end SQL tests — the README quick-start flow
+(reference: src/frontend/src/tests/instance_test.rs shapes)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datanode import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import (
+    DatabaseNotFoundError, GreptimeError, TableNotFoundError)
+from greptimedb_tpu.frontend import FrontendInstance
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+    inst = FrontendInstance(dn)
+    inst.start()
+    yield inst
+    inst.shutdown()
+
+
+def q(fe, sql, ctx=None):
+    outs = fe.do_query(sql, ctx)
+    return outs[-1]
+
+
+MONITOR_DDL = """
+CREATE TABLE monitor (
+  host STRING,
+  ts TIMESTAMP TIME INDEX,
+  cpu DOUBLE DEFAULT 0,
+  memory DOUBLE,
+  PRIMARY KEY(host))"""
+
+
+class TestStandaloneFlow:
+    def test_readme_quickstart(self, fe):
+        q(fe, MONITOR_DDL)
+        out = q(fe, """
+            INSERT INTO monitor(host, ts, cpu, memory) VALUES
+              ('host1', 1000, 0.5, 1024),
+              ('host2', 1000, 0.9, 2048),
+              ('host1', 2000, 0.7, 1100)""")
+        assert out.affected_rows == 3
+        out = q(fe, "SELECT * FROM monitor ORDER BY host, ts")
+        rows = out.batches[0].to_pylist()
+        assert rows[0]["host"] == "host1" and rows[0]["cpu"] == 0.5
+        out = q(fe, "SELECT host, avg(cpu) AS c FROM monitor GROUP BY host "
+                    "ORDER BY host")
+        rows = out.batches[0].to_pylist()
+        assert math.isclose(rows[0]["c"], 0.6, rel_tol=1e-6)
+        assert math.isclose(rows[1]["c"], 0.9, rel_tol=1e-6)
+
+    def test_default_values_and_partial_insert(self, fe):
+        q(fe, MONITOR_DDL)
+        q(fe, "INSERT INTO monitor(host, ts) VALUES ('h', 5)")
+        rows = q(fe, "SELECT cpu, memory FROM monitor").batches[0].to_pylist()
+        assert rows[0]["cpu"] == 0.0 and rows[0]["memory"] is None
+
+    def test_restart_recovers_everything(self, tmp_path):
+        dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+        fe1 = FrontendInstance(dn)
+        fe1.start()
+        fe1.do_query(MONITOR_DDL)
+        fe1.do_query("INSERT INTO monitor(host, ts, cpu) VALUES ('a', 1, 0.1)")
+        fe1.do_query("CREATE DATABASE mydb")
+        fe1.shutdown()
+        dn2 = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+        fe2 = FrontendInstance(dn2)
+        fe2.start()
+        out = q(fe2, "SELECT host, cpu FROM monitor")
+        assert out.batches[0].to_pylist() == [{"host": "a", "cpu": 0.1}]
+        dbs = [r["Databases"] for r in
+               q(fe2, "SHOW DATABASES").batches[0].to_pylist()]
+        assert "mydb" in dbs
+        fe2.shutdown()
+
+    def test_use_database_and_qualified_names(self, fe):
+        ctx = QueryContext()
+        q(fe, "CREATE DATABASE db2", ctx)
+        q(fe, "USE db2", ctx)
+        assert ctx.current_schema == "db2"
+        q(fe, MONITOR_DDL, ctx)
+        q(fe, "INSERT INTO monitor(host, ts) VALUES ('x', 1)", ctx)
+        out = q(fe, "SELECT count(*) AS c FROM db2.monitor")
+        assert out.batches[0].to_pylist()[0]["c"] == 1
+        with pytest.raises(TableNotFoundError):
+            q(fe, "SELECT * FROM public.monitor")
+
+    def test_alter_flow(self, fe):
+        q(fe, MONITOR_DDL)
+        q(fe, "INSERT INTO monitor(host, ts) VALUES ('a', 1)")
+        q(fe, "ALTER TABLE monitor ADD COLUMN disk DOUBLE")
+        q(fe, "INSERT INTO monitor(host, ts, disk) VALUES ('a', 2, 9.5)")
+        rows = q(fe, "SELECT ts, disk FROM monitor ORDER BY ts") \
+            .batches[0].to_pylist()
+        assert rows[0]["disk"] is None and rows[1]["disk"] == 9.5
+        q(fe, "ALTER TABLE monitor RENAME TO monitor2")
+        assert q(fe, "SELECT count(*) AS c FROM monitor2") \
+            .batches[0].to_pylist()[0]["c"] == 2
+
+    def test_delete_and_truncate(self, fe):
+        q(fe, MONITOR_DDL)
+        q(fe, "INSERT INTO monitor(host, ts) VALUES ('a', 1), ('b', 1), "
+              "('a', 2)")
+        out = q(fe, "DELETE FROM monitor WHERE host = 'a' AND ts = 1")
+        assert out.affected_rows == 1
+        assert q(fe, "SELECT count(*) AS c FROM monitor") \
+            .batches[0].to_pylist()[0]["c"] == 2
+        q(fe, "TRUNCATE TABLE monitor")
+        assert q(fe, "SELECT count(*) AS c FROM monitor") \
+            .batches[0].to_pylist()[0]["c"] == 0
+
+    def test_insert_select(self, fe):
+        q(fe, MONITOR_DDL)
+        q(fe, "CREATE TABLE copy1 (host STRING, ts TIMESTAMP TIME INDEX, "
+              "cpu DOUBLE, memory DOUBLE, PRIMARY KEY(host))")
+        q(fe, "INSERT INTO monitor(host, ts, cpu) VALUES ('a', 1, 0.5)")
+        out = q(fe, "INSERT INTO copy1 SELECT host, ts, cpu, memory "
+                    "FROM monitor")
+        assert out.affected_rows == 1
+        assert q(fe, "SELECT host FROM copy1").batches[0].to_pylist() == \
+            [{"host": "a"}]
+
+    def test_copy_to_from(self, fe, tmp_path):
+        q(fe, MONITOR_DDL)
+        q(fe, "INSERT INTO monitor(host, ts, cpu) VALUES ('a', 1, 0.5), "
+              "('b', 2, 0.7)")
+        path = str(tmp_path / "out.parquet")
+        out = q(fe, f"COPY monitor TO '{path}'")
+        assert out.affected_rows == 2
+        q(fe, "CREATE TABLE m2 (host STRING, ts TIMESTAMP TIME INDEX, "
+              "cpu DOUBLE, memory DOUBLE, PRIMARY KEY(host))")
+        out = q(fe, f"COPY m2 FROM '{path}'")
+        assert out.affected_rows == 2
+        rows = q(fe, "SELECT host, cpu FROM m2 ORDER BY host") \
+            .batches[0].to_pylist()
+        assert rows == [{"host": "a", "cpu": 0.5}, {"host": "b", "cpu": 0.7}]
+
+    def test_multi_statement(self, fe):
+        outs = fe.do_query(MONITOR_DDL + ";"
+                           "INSERT INTO monitor(host, ts) VALUES ('a', 1);"
+                           "SELECT count(*) AS c FROM monitor")
+        assert outs[-1].batches[0].to_pylist()[0]["c"] == 1
+
+    def test_drop_database(self, fe):
+        ctx = QueryContext()
+        q(fe, "CREATE DATABASE tmp1", ctx)
+        q(fe, "USE tmp1", ctx)
+        q(fe, MONITOR_DDL, ctx)
+        q(fe, "USE public", ctx)
+        q(fe, "DROP DATABASE tmp1", ctx)
+        with pytest.raises(DatabaseNotFoundError):
+            q(fe, "SHOW TABLES FROM tmp1")
+
+
+class TestAutoCreateIngest:
+    def test_create_on_demand(self, fe):
+        n = fe.handle_row_insert(
+            "metrics_auto",
+            {"host": ["a", "b"], "greptime_timestamp": [1000, 2000],
+             "value": [1.5, 2.5]},
+            tag_columns=["host"])
+        assert n == 2
+        rows = q(fe, "SELECT * FROM metrics_auto ORDER BY greptime_timestamp") \
+            .batches[0].to_pylist()
+        assert rows[0]["host"] == "a" and rows[0]["value"] == 1.5
+        desc = q(fe, "DESCRIBE metrics_auto").batches[0].to_pylist()
+        by = {r["Column"]: r for r in desc}
+        assert by["host"]["Semantic Type"] == "TAG"
+        assert by["greptime_timestamp"]["Key"] == "TIME INDEX"
+
+    def test_alter_on_demand(self, fe):
+        fe.handle_row_insert(
+            "m", {"host": ["a"], "greptime_timestamp": [1], "v1": [1.0]},
+            tag_columns=["host"])
+        fe.handle_row_insert(
+            "m", {"host": ["a"], "greptime_timestamp": [2], "v1": [2.0],
+                  "v2": [3.0]},
+            tag_columns=["host"])
+        rows = q(fe, "SELECT v1, v2 FROM m ORDER BY greptime_timestamp") \
+            .batches[0].to_pylist()
+        assert rows[0]["v2"] is None and rows[1]["v2"] == 3.0

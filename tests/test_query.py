@@ -240,3 +240,58 @@ class TestShow:
                           "GROUP BY host")
         plan = out.batches[0].to_pylist()[0]["plan"]
         assert "TpuAggregateExec" in plan
+
+
+class TestReviewRegressions:
+    def test_case_on_filtered_frame(self, world):
+        # CASE over a WHERE-filtered frame must align with the frame index
+        engine, _, data = world
+        out = run(engine, """
+            SELECT ts, CASE WHEN cpu > 0.5 THEN 'hot' ELSE 'cold' END AS t
+            FROM monitor WHERE ts >= 500 AND ts < 1500 ORDER BY ts""")
+        rows = out.batches[0].to_pylist()
+        assert len(rows) == 4
+        for r in rows:
+            i = data["ts"].index(r["ts"])
+            want = "hot" if data["cpu"][i] > 0.5 else "cold"
+            assert r["t"] == want
+
+    def test_constant_projection_empty_result(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT 1 AS one FROM monitor WHERE ts < 0")
+        assert out.num_rows == 0
+        # but SELECT without FROM still yields one row
+        assert run(engine, "SELECT 1").num_rows == 1
+
+    def test_fractional_time_bounds_match_fallback(self, world, monkeypatch):
+        engine, table, _ = world
+        sql = ("SELECT count(*) AS c FROM monitor WHERE ts >= 499.5 "
+               "AND ts < 750.5")
+        got = run(engine, sql).batches[0].to_pylist()
+        import greptimedb_tpu.query.tpu_exec as tx
+        monkeypatch.setattr(tx, "try_execute", lambda *a, **k: None)
+        want = run(engine, sql).batches[0].to_pylist()
+        assert got == want
+
+    def test_unaliased_aggregate_names(self, world):
+        engine, *_ = world
+        out = run(engine, "SELECT host, avg(cpu) FROM monitor GROUP BY host")
+        assert out.schema.names() == ["host", "avg(cpu)"]
+
+
+def test_alter_on_demand_rejects_new_tags(tmp_path):
+    from greptimedb_tpu.datanode import DatanodeInstance, DatanodeOptions
+    from greptimedb_tpu.frontend import FrontendInstance
+    from greptimedb_tpu.errors import InvalidArgumentsError
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+    fe = FrontendInstance(dn)
+    fe.start()
+    fe.handle_row_insert("up", {"host": ["a"], "greptime_timestamp": [1000],
+                                "greptime_value": [1.0]},
+                         tag_columns=["host"])
+    with pytest.raises(InvalidArgumentsError, match="tag"):
+        fe.handle_row_insert(
+            "up", {"host": ["a"], "az": ["az1"],
+                   "greptime_timestamp": [2000], "greptime_value": [2.0]},
+            tag_columns=["host", "az"])
+    fe.shutdown()

@@ -1,0 +1,256 @@
+"""Protocol server tests: HTTP API, ingest protocols, snappy, auth.
+
+Mirrors the reference integration matrix (tests-integration/tests/http.rs)
+against a live server on an ephemeral port.
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datanode import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.frontend import FrontendInstance
+from greptimedb_tpu.servers.auth import StaticUserProvider
+from greptimedb_tpu.servers.http import HttpServer
+from greptimedb_tpu.servers import prometheus as prom
+from greptimedb_tpu.utils import snappy
+
+
+@pytest.fixture()
+def server(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+    fe = FrontendInstance(dn)
+    fe.start()
+    srv = HttpServer(fe, addr="127.0.0.1:0")
+    srv.start()
+    yield srv
+    srv.shutdown()
+    fe.shutdown()
+
+
+def req(server, path, method="GET", body=None, headers=None, params=None,
+        raise_on_error=True):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params, doseq=True)
+    r = urllib.request.Request(url, data=body, method=method,
+                               headers=headers or {})
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        if raise_on_error and e.code == 401:
+            raise
+        return e.code, e.read()
+
+
+def sql(server, stmt):
+    status, body = req(server, "/v1/sql", "POST",
+                       urllib.parse.urlencode({"sql": stmt}).encode(),
+                       {"Content-Type": "application/x-www-form-urlencoded"})
+    assert status == 200, body
+    return json.loads(body)
+
+
+class TestSnappy:
+    def test_round_trip(self):
+        for payload in (b"", b"a", b"hello world " * 100,
+                        bytes(range(256)) * 50):
+            assert snappy.decompress(snappy.compress(payload)) == payload
+
+    def test_backreference_decode(self):
+        # handcrafted: literal 'abcd' + copy(offset=4, len=4) → 'abcdabcd'
+        data = bytes([8]) + bytes([(4 - 1) << 2]) + b"abcd" + \
+            bytes([0x01 | ((4 - 4) << 2)]) + bytes([4])
+        assert snappy.decompress(data) == b"abcdabcd"
+
+
+class TestHttpSql:
+    def test_sql_round_trip(self, server):
+        out = sql(server, "CREATE TABLE m (host STRING, ts TIMESTAMP TIME "
+                          "INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+        assert out["code"] == 0
+        out = sql(server, "INSERT INTO m VALUES ('a', 1000, 0.5)")
+        assert out["output"][0]["affectedrows"] == 1
+        out = sql(server, "SELECT * FROM m")
+        rec = out["output"][0]["records"]
+        assert [c["name"] for c in rec["schema"]["column_schemas"]] == \
+            ["host", "ts", "cpu"]
+        assert rec["rows"] == [["a", 1000, 0.5]]
+
+    def test_sql_error(self, server):
+        status, body = req(
+            server, "/v1/sql", "POST",
+            urllib.parse.urlencode({"sql": "SELECT * FROM missing"}).encode(),
+            {"Content-Type": "application/x-www-form-urlencoded"})
+        assert status == 400
+        assert "not found" in json.loads(body)["error"]
+
+    def test_get_with_query_param(self, server):
+        status, body = req(server, "/v1/sql", params={"sql": "SELECT 1"})
+        assert status == 200
+        assert json.loads(body)["output"][0]["records"]["rows"] == [[1]]
+
+    def test_health_status_metrics(self, server):
+        assert req(server, "/health")[0] == 200
+        status, body = req(server, "/status")
+        assert json.loads(body)["version"]
+        status, body = req(server, "/metrics")
+        assert status == 200
+
+    def test_db_param(self, server):
+        sql(server, "CREATE DATABASE db9")
+        status, _ = req(
+            server, "/v1/sql", "POST",
+            urllib.parse.urlencode({
+                "sql": "CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)",
+            }).encode(),
+            {"Content-Type": "application/x-www-form-urlencoded"},
+            params={"db": "db9"})
+        assert status == 200
+        out = sql(server, "SHOW TABLES FROM db9")
+        names = [r[0] for r in out["output"][0]["records"]["rows"]]
+        assert "t" in names
+
+
+class TestInfluxIngest:
+    def test_line_protocol_write(self, server):
+        body = (b"weather,location=us-midwest temperature=82.5 "
+                b"1465839830100400200\n"
+                b"weather,location=us-east temperature=75,humidity=32i "
+                b"1465839830100400200")
+        status, _ = req(server, "/v1/influxdb/write", "POST", body)
+        assert status == 204
+        out = sql(server, "SELECT location, temperature, humidity FROM "
+                          "weather ORDER BY location")
+        rows = out["output"][0]["records"]["rows"]
+        assert rows == [["us-east", 75.0, 32], ["us-midwest", 82.5, None]]
+
+    def test_precision(self, server):
+        status, _ = req(server, "/v1/influxdb/write", "POST",
+                        b"m1 v=1 1700000000", params={"precision": "s"})
+        assert status == 204
+        out = sql(server, "SELECT greptime_timestamp FROM m1")
+        assert out["output"][0]["records"]["rows"][0][0] == 1700000000000
+
+
+class TestOpenTsdb:
+    def test_http_put(self, server):
+        body = json.dumps([
+            {"metric": "sys.cpu", "timestamp": 1700000000, "value": 18.0,
+             "tags": {"host": "web01"}},
+            {"metric": "sys.cpu", "timestamp": 1700000001, "value": 19.5,
+             "tags": {"host": "web02"}},
+        ]).encode()
+        status, _ = req(server, "/v1/opentsdb/api/put", "POST", body,
+                        {"Content-Type": "application/json"})
+        assert status == 200
+        out = sql(server, 'SELECT host, greptime_value FROM "sys.cpu" '
+                          "ORDER BY host")
+        assert out["output"][0]["records"]["rows"] == [
+            ["web01", 18.0], ["web02", 19.5]]
+
+
+class TestPrometheusRemote:
+    def test_write_then_read(self, server):
+        series = [
+            prom.TimeSeries(
+                labels={"__name__": "up", "job": "api", "instance": "i1"},
+                samples=[(1.0, 1000), (0.0, 2000)]),
+            prom.TimeSeries(
+                labels={"__name__": "up", "job": "api", "instance": "i2"},
+                samples=[(1.0, 1500)]),
+        ]
+        body = prom.encode_write_request(series)
+        status, _ = req(server, "/v1/prometheus/write", "POST", body)
+        assert status == 204
+        out = sql(server, "SELECT instance, job, greptime_value FROM up "
+                          "ORDER BY greptime_timestamp")
+        assert out["output"][0]["records"]["rows"] == [
+            ["i1", "api", 1.0], ["i2", "api", 1.0], ["i1", "api", 0.0]]
+
+        # remote read round trip
+        read_q = (prom.pw.field_bytes(1, (
+            prom.pw.field_varint(1, 0) + prom.pw.field_varint(2, 5000) +
+            prom.pw.field_bytes(3, (
+                prom.pw.field_varint(1, prom.MATCH_EQ) +
+                prom.pw.field_bytes(2, b"__name__") +
+                prom.pw.field_bytes(3, b"up"))))))
+        status, body = req(server, "/v1/prometheus/read", "POST",
+                           snappy.compress(bytes(read_q)))
+        assert status == 200
+        decoded = snappy.decompress(body)
+        text = decoded.decode("latin1")
+        assert "job" in text and "api" in text and "instance" in text
+
+    def test_prom_metadata_endpoints(self, server):
+        series = [prom.TimeSeries(
+            labels={"__name__": "cpu_seconds", "host": "a"},
+            samples=[(0.5, 1000)])]
+        req(server, "/v1/prometheus/write", "POST",
+            prom.encode_write_request(series))
+        status, body = req(server, "/api/v1/labels")
+        data = json.loads(body)["data"]
+        assert "host" in data and "__name__" in data
+        status, body = req(server, "/api/v1/label/host/values")
+        assert json.loads(body)["data"] == ["a"]
+        status, body = req(server, "/api/v1/series",
+                           params={"match[]": "cpu_seconds"})
+        assert json.loads(body)["data"] == [
+            {"__name__": "cpu_seconds", "host": "a"}]
+
+
+class TestAuth:
+    def test_basic_auth_required(self, tmp_path):
+        dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+        fe = FrontendInstance(dn)
+        fe.start()
+        provider = StaticUserProvider({"admin": "pwd123"})
+        srv = HttpServer(fe, provider, addr="127.0.0.1:0")
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                req(srv, "/v1/sql", params={"sql": "SELECT 1"})
+            assert err.value.code == 401
+            import base64
+            token = base64.b64encode(b"admin:pwd123").decode()
+            status, body = req(srv, "/v1/sql", params={"sql": "SELECT 1"},
+                               headers={"Authorization": f"Basic {token}"})
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as err:
+                bad = base64.b64encode(b"admin:nope").decode()
+                req(srv, "/v1/sql", params={"sql": "SELECT 1"},
+                    headers={"Authorization": f"Basic {bad}"})
+            assert err.value.code == 401
+        finally:
+            srv.shutdown()
+            fe.shutdown()
+
+
+class TestCli:
+    def test_load_options_from_toml_and_flags(self, tmp_path):
+        from greptimedb_tpu.cmd.main import load_options
+        cfg = tmp_path / "config.toml"
+        cfg.write_text("""
+[storage]
+data_home = "/tmp/x"
+[http]
+addr = "0.0.0.0:9999"
+[mysql]
+enable = false
+""")
+        import argparse
+        args = argparse.Namespace(config_file=str(cfg),
+                                  data_home=None, http_addr=None,
+                                  mysql_addr="127.0.0.1:1234",
+                                  postgres_addr=None, grpc_addr=None,
+                                  user_provider=None)
+        opts = load_options(args)
+        assert opts.data_home == "/tmp/x"
+        assert opts.http_addr == "0.0.0.0:9999"
+        assert opts.mysql_addr == "127.0.0.1:1234"
+        assert opts.enable_mysql is False
