@@ -1,0 +1,16 @@
+"""Native PromQL engine.
+
+Reference behavior: src/promql — a PromQL planner compiling to DataFusion
+plans with custom streaming nodes (SeriesNormalize / SeriesDivide /
+Instant- and RangeManipulate) and per-window UDFs
+(src/promql/src/planner.rs, extension_plan/, functions/). Here the same
+stages execute on the TPU window kernels (ops/window.py): series become a
+dense [series, time] matrix in HBM; instant selection and every range
+function are vmapped (series × step) device passes; label grouping,
+vector matching, and JSON shaping stay on host.
+"""
+
+from .parser import parse_promql, PromqlParseError
+from .engine import PromqlEngine
+
+__all__ = ["parse_promql", "PromqlParseError", "PromqlEngine"]
