@@ -378,6 +378,16 @@ def _sorted_seg_sum(x, starts, ends, bs, be, has_inner, n):
     return inner + edges.sum(axis=1)
 
 
+def _floor_log2(ln, K):
+    """Integral floor(log2(ln)) clamped to [0, K-1]: float32 log2 can round
+    up for huge segments (>= ~2^23 blocks), making the RMQ read past the
+    segment."""
+    k = jnp.zeros_like(ln)
+    for j in range(1, min(K, 31)):
+        k = k + (ln >= (1 << j)).astype(ln.dtype)
+    return jnp.clip(k, 0, K - 1).astype(jnp.int32)
+
+
 def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
     red = jnp.minimum if is_min else jnp.maximum
     ident = _max_ident(x.dtype) if is_min else _min_ident(x.dtype)
@@ -395,8 +405,7 @@ def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
         st.append(red(prev, rolled))
     ST = jnp.stack(st)                                    # [K, NB]
     ln = jnp.maximum(be - bs, 1)
-    k = jnp.floor(jnp.log2(ln.astype(jnp.float32) + 0.5)).astype(jnp.int32)
-    k = jnp.clip(k, 0, K - 1)
+    k = _floor_log2(ln, K)
     lo = jnp.minimum(bs, nb - 1)
     hi = jnp.clip(be - (1 << k), 0, nb - 1)
     inner = red(ST[k, lo], ST[k, hi])
@@ -450,8 +459,7 @@ def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min):
         st_p.append(np_)
     ST_T, ST_P = jnp.stack(st_t), jnp.stack(st_p)
     ln = jnp.maximum(be - bs, 1)
-    k = jnp.floor(jnp.log2(ln.astype(jnp.float32) + 0.5)).astype(jnp.int32)
-    k = jnp.clip(k, 0, K - 1)
+    k = _floor_log2(ln, K)
     lo = jnp.minimum(bs, nb - 1)
     hi = jnp.clip(be - (1 << k), 0, nb - 1)
     it, ip = pick(ST_T[k, lo], ST_P[k, lo], ST_T[k, hi], ST_P[k, hi])

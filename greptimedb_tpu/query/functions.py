@@ -99,6 +99,9 @@ def _date_bin(interval_ms, ts, origin=0):
 
 _TRUNC_MS = {"second": 1000, "minute": 60_000, "hour": 3_600_000,
              "day": 86_400_000, "week": 604_800_000}
+# weeks are Monday-aligned (epoch 1970-01-01 is a Thursday; first epoch
+# Monday is 1970-01-05), matching DataFusion date_trunc
+_WEEK_ORIGIN_MS = 4 * 86_400_000
 
 
 def _date_trunc(unit, ts):
@@ -106,6 +109,8 @@ def _date_trunc(unit, ts):
     if u in _TRUNC_MS:
         step = _TRUNC_MS[u]
         t = np.asarray(ts, dtype=np.int64)
+        if u == "week":
+            return ((t - _WEEK_ORIGIN_MS) // step) * step + _WEEK_ORIGIN_MS
         return (t // step) * step
     # month/year need calendar math
     import pandas as pd

@@ -356,7 +356,9 @@ def _match_bucket(e: Expr, ts_name: Optional[str]) -> Optional[BucketGroup]:
             return None
         if not (isinstance(e.args[1], Column) and e.args[1].name == ts_name):
             return None
-        return BucketGroup(_TRUNC_MS[unit], 0, expr_name(e))
+        from .functions import _WEEK_ORIGIN_MS
+        origin = _WEEK_ORIGIN_MS if unit == "week" else 0
+        return BucketGroup(_TRUNC_MS[unit], origin, expr_name(e))
     return None
 
 
@@ -568,6 +570,11 @@ def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
         frame[_group_slot(plan.bucket.expr_key)] = \
             bkt * plan.bucket.stride_ms + plan.bucket.origin
     for m, r in zip(plan.moments, res_np):
+        if m.op in ("min_ts", "max_ts"):
+            # device ts is region-relative (ts - ts_base, base differs per
+            # region); rebase to absolute so cross-region first/last merge
+            # in _finalize compares comparable timestamps
+            r = r.astype(np.int64) + scan.ts_base
         frame[m.slot] = r
     frame["__rowcount"] = counts
     df = pd.DataFrame(frame)[live]

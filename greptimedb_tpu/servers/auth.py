@@ -8,6 +8,7 @@ option (`user=pwd`) or a htpasswd-style file.
 from __future__ import annotations
 
 import base64
+import hmac
 from typing import Dict, Optional
 
 from ..errors import AuthError
@@ -60,7 +61,10 @@ class StaticUserProvider(UserProvider):
         return StaticUserProvider(users)
 
     def authenticate(self, username: str, password: str) -> bool:
-        return self.users.get(username) == password
+        expected = self.users.get(username)
+        if expected is None:
+            return False
+        return hmac.compare_digest(expected.encode(), password.encode())
 
 
 class NoopUserProvider(UserProvider):

@@ -295,3 +295,75 @@ def test_alter_on_demand_rejects_new_tags(tmp_path):
                    "greptime_timestamp": [2000], "greptime_value": [2.0]},
             tag_columns=["host", "az"])
     fe.shutdown()
+
+
+class TestAdviceRegressions:
+    """Regressions for the round-1 advisor findings (ADVICE.md)."""
+
+    def _partitioned(self, tmp_path):
+        from greptimedb_tpu.mito import MitoEngine
+        from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
+        storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        mito = MitoEngine(storage)
+        stmt = parse_sql("""
+            CREATE TABLE p (host STRING, ts TIMESTAMP TIME INDEX,
+                            cpu DOUBLE, PRIMARY KEY(host))
+            PARTITION BY RANGE COLUMNS (host) (
+              PARTITION r0 VALUES LESS THAN ('m'),
+              PARTITION r1 VALUES LESS THAN (MAXVALUE))""")
+        schema = Schema([
+            ColumnSchema("host", dt.STRING, nullable=False,
+                         semantic_type=SemanticType.TAG),
+            ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                         semantic_type=SemanticType.TIMESTAMP),
+            ColumnSchema("cpu", dt.FLOAT64),
+        ])
+        t = mito.create_table(CreateTableRequest(
+            "p", schema, primary_key_indices=[0], partitions=stmt.partitions))
+        cm = MemoryCatalogManager()
+        cm.register_table(CAT, SCH, "p", t)
+        return QueryEngine(cm), t
+
+    def test_first_last_across_regions_absolute_ts(self, tmp_path):
+        # region bases differ: r1's earliest row (ts=50) precedes r0's
+        # (ts=100); region-relative min_ts would tie at 0 and pick r0
+        engine, t = self._partitioned(tmp_path)
+        t.insert({"host": ["alpha", "alpha", "zulu", "zulu"],
+                  "ts": [100, 200, 50, 300],
+                  "cpu": [111.0, 5.0, 999.0, 7.0]})
+        out = run(engine, "SELECT first(cpu) AS f, last(cpu) AS l FROM p")
+        row = out.batches[0].to_pylist()[0]
+        assert row["f"] == 999.0    # value at absolute earliest ts=50
+        assert row["l"] == 7.0      # value at absolute latest ts=300
+
+    def test_fallback_first_without_ts_projection(self, tmp_path, monkeypatch):
+        # CPU fallback must project the time index even when the query
+        # doesn't reference it, so first/last stay time-ordered. Scan order
+        # is series-major (host asc, ts asc): host 'b' holds the earliest
+        # row, so unsorted scan order would return 'a's value.
+        engine, t = self._partitioned(tmp_path)
+        t.insert({"host": ["a", "a", "b", "b"],
+                  "ts": [100, 200, 10, 300],
+                  "cpu": [111.0, 5.0, 999.0, 7.0]})
+        import greptimedb_tpu.query.tpu_exec as tx
+        monkeypatch.setattr(tx, "try_execute", lambda *a, **k: None)
+        out = run(engine, "SELECT first(cpu) AS f, last(cpu) AS l FROM p")
+        row = out.batches[0].to_pylist()[0]
+        assert row["f"] == 999.0 and row["l"] == 7.0
+
+    def test_date_trunc_week_monday_aligned(self, world, monkeypatch):
+        engine, *_ = world
+        from greptimedb_tpu.query.functions import _date_trunc
+        # 1970-01-08 (Thursday) truncates to Monday 1970-01-05
+        assert _date_trunc("week", [7 * 86_400_000])[0] == 4 * 86_400_000
+        # pre-epoch-Monday values floor to the previous Monday
+        assert _date_trunc("week", [0])[0] == 4 * 86_400_000 - 604_800_000
+        # TPU bucket path agrees with the fallback
+        sql = ("SELECT date_trunc('week', ts) AS w, count(*) AS c "
+               "FROM monitor GROUP BY w")
+        got = run(engine, sql).batches[0].to_pylist()
+        import greptimedb_tpu.query.tpu_exec as tx
+        monkeypatch.setattr(tx, "try_execute", lambda *a, **k: None)
+        want = run(engine, sql).batches[0].to_pylist()
+        key = lambda r: r["w"]
+        assert sorted(got, key=key) == sorted(want, key=key)
