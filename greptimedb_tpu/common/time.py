@@ -192,3 +192,37 @@ def parse_duration_ms(s: str) -> int:
     if pos != len(s):
         raise ValueError(f"invalid duration: {s!r}")
     return int(total)
+
+
+def parse_prom_time(v, default: Optional[float] = None) -> Optional[int]:
+    """Prometheus API time parameter: unix seconds (float/str) or RFC3339
+    → epoch ms (reference: src/servers/src/prom.rs query params)."""
+    if v is None or v == "":
+        if default is None:
+            return None
+        return int(float(default) * 1000)
+    if isinstance(v, (int, float)):
+        return int(float(v) * 1000)
+    s = str(v).strip().strip("'\"")
+    try:
+        return int(float(s) * 1000)
+    except ValueError:
+        pass
+    import pandas as pd
+    return int(pd.Timestamp(s).value // 1_000_000)
+
+
+def parse_prom_duration(v) -> int:
+    """Prometheus step/duration parameter: '15s' / '1m' / bare seconds → ms."""
+    if isinstance(v, (int, float)):
+        return int(float(v) * 1000)
+    s = str(v).strip().strip("'\"")
+    try:
+        return int(float(s) * 1000)
+    except ValueError:
+        pass
+    try:
+        return parse_duration_ms(s)
+    except ValueError as e:
+        from ..errors import InvalidArgumentsError
+        raise InvalidArgumentsError(f"invalid duration {v!r}") from e

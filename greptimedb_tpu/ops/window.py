@@ -34,7 +34,7 @@ TS_PAD = np.iinfo(np.int64).max
 CUMSUM_OPS = {
     "sum_over_time", "count_over_time", "avg_over_time", "stddev_over_time",
     "stdvar_over_time", "last_over_time", "first_over_time", "present_over_time",
-    "rate", "increase", "delta", "idelta", "changes", "resets",
+    "rate", "increase", "delta", "idelta", "irate_num", "changes", "resets",
 }
 GATHER_OPS = {"min_over_time", "max_over_time", "quantile_over_time",
               "deriv", "predict_linear", "mad_over_time", "holt_winters"}
@@ -168,10 +168,14 @@ def range_aggregate_cumsum(
     if op == "last_over_time":
         return pick_last(), ok1
 
-    if op == "idelta":
+    if op in ("idelta", "irate_num"):
         ok2 = count >= 2
         last = pick_last()
         prev = _gather(val2d, jnp.maximum(hi - 2, 0))
+        if op == "irate_num":
+            # prometheus instantValue counter-reset rule: on reset
+            # (last < prev) the delta is the last sample alone
+            return jnp.where(last < prev, last, last - prev), ok2
         return last - prev, ok2
 
     if op in ("changes", "resets"):

@@ -8,43 +8,13 @@ endpoints. Query evaluation delegates to the PromQL engine.
 from __future__ import annotations
 
 import asyncio
-import re
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from aiohttp import web
 
+from ..common.time import parse_prom_duration, parse_prom_time
 from ..errors import GreptimeError
-
-_DUR_RX = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)?$")
-_DUR_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
-           "d": 86_400_000, "w": 604_800_000, "y": 31_536_000_000}
-
-
-def parse_prom_time(v: Optional[str], default: Optional[float] = None
-                    ) -> Optional[int]:
-    """RFC3339 or unix (float) seconds → ms."""
-    if v is None or v == "":
-        if default is None:
-            return None
-        return int(default * 1000)
-    try:
-        return int(float(v) * 1000)
-    except ValueError:
-        pass
-    import pandas as pd
-    return int(pd.Timestamp(v).value // 1_000_000)
-
-
-def parse_prom_duration(v: str) -> int:
-    """'15s' / '1m' / bare seconds → ms."""
-    m = _DUR_RX.match(v.strip())
-    if not m:
-        from ..query.functions import parse_interval_ms
-        return parse_interval_ms(v)
-    num = float(m.group(1))
-    unit = m.group(2) or "s"
-    return int(num * _DUR_MS[unit])
 
 
 def _error(typ: str, msg: str, status=400):

@@ -254,3 +254,64 @@ enable = false
         assert opts.http_addr == "0.0.0.0:9999"
         assert opts.mysql_addr == "127.0.0.1:1234"
         assert opts.enable_mysql is False
+
+
+class TestPromApiQuery:
+    """/api/v1/query{,_range} + /v1/promql end-to-end (reference:
+    src/servers/src/prom.rs:70-95 — the round-1 gap where routes crashed)."""
+
+    def _seed(self, server):
+        sql(server, "CREATE TABLE qcpu (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, val DOUBLE, PRIMARY KEY(host))")
+        rows = ",".join(
+            f"('h{j}', {i * 10_000}, {float(i * (j + 1))})"
+            for i in range(30) for j in range(2))
+        sql(server, f"INSERT INTO qcpu VALUES {rows}")
+
+    def test_query_range(self, server):
+        self._seed(server)
+        status, body = req(server, "/api/v1/query_range", params={
+            "query": "rate(qcpu[1m])", "start": "120", "end": "240",
+            "step": "60"})
+        assert status == 200, body
+        data = json.loads(body)
+        assert data["status"] == "success"
+        res = data["data"]
+        assert res["resultType"] == "matrix"
+        by_host = {r["metric"]["host"]: r for r in res["result"]}
+        for _, v in by_host["h0"]["values"]:
+            assert abs(float(v) - 0.1) < 1e-9
+        for _, v in by_host["h1"]["values"]:
+            assert abs(float(v) - 0.2) < 1e-9
+
+    def test_instant_query(self, server):
+        self._seed(server)
+        status, body = req(server, "/api/v1/query", params={
+            "query": "sum(qcpu)", "time": "100"})
+        assert status == 200, body
+        data = json.loads(body)
+        res = data["data"]
+        assert res["resultType"] == "vector"
+        assert float(res["result"][0]["value"][1]) == 30.0
+
+    def test_query_error_shape(self, server):
+        status, body = req(server, "/api/v1/query", params={
+            "query": "rate(", "time": "100"}, raise_on_error=False)
+        assert status == 422
+        data = json.loads(body)
+        assert data["status"] == "error"
+
+    def test_v1_promql(self, server):
+        self._seed(server)
+        status, body = req(server, "/v1/promql", params={
+            "query": "qcpu", "start": "100", "end": "100", "step": "10s"})
+        assert status == 200, body
+
+    def test_series_endpoint_still_works(self, server):
+        self._seed(server)
+        status, body = req(server, "/api/v1/series",
+                           params={"match[]": "qcpu"})
+        assert status == 200
+        data = json.loads(body)
+        hosts = {e.get("host") for e in data["data"]}
+        assert hosts == {"h0", "h1"}
