@@ -52,6 +52,20 @@ from ..table.table import Table, TableEngine
 MIN_USER_TABLE_ID = 1024
 
 
+def region_opts_from_table_options(options: Dict) -> Optional[Dict]:
+    """Map CREATE TABLE WITH(...) options onto region knobs
+    (ttl='7d', compaction_time_window='1h')."""
+    from ..common.time import parse_duration_ms
+    opts = {}
+    ttl = options.get("ttl")
+    if ttl:
+        opts["ttl_ms"] = parse_duration_ms(str(ttl))
+    cw = options.get("compaction_time_window")
+    if cw:
+        opts["compaction_time_window_ms"] = parse_duration_ms(str(cw))
+    return opts or None
+
+
 def region_name(table_id: int, region_number: int) -> str:
     return f"{table_id}_{region_number:010d}"
 
@@ -273,8 +287,9 @@ class MitoEngine(TableEngine):
             self.store.write(
                 self._manifest_key(*key[:2], table_id),
                 json.dumps(info.to_dict()).encode())
+            ropts = region_opts_from_table_options(meta.options)
             regions = {rn: self.storage.create_region(
-                region_name(table_id, rn), schema)
+                region_name(table_id, rn), schema, opts=ropts)
                 for rn in region_numbers}
             table = MitoTable(info, regions, rule)
             self._tables[key] = table
@@ -298,12 +313,13 @@ class MitoEngine(TableEngine):
         info = TableInfo.from_dict(json.loads(raw))
         rule = _deserialize_rule(info.meta.partition_rule)
         regions = {}
+        ropts = region_opts_from_table_options(info.meta.options)
         for rn in info.meta.region_numbers:
             region = self.storage.open_region(region_name(table_id, rn),
-                                              info.meta.schema)
+                                              info.meta.schema, opts=ropts)
             if region is None:
                 region = self.storage.create_region(
-                    region_name(table_id, rn), info.meta.schema)
+                    region_name(table_id, rn), info.meta.schema, opts=ropts)
             regions[rn] = region
         table = MitoTable(info, regions, rule)
         self._tables[key] = table
@@ -424,11 +440,12 @@ class MitoEngine(TableEngine):
             if table is None:
                 return False
             info = table.info
+            ropts = region_opts_from_table_options(info.meta.options)
             for rn in list(table.regions):
                 rname = region_name(info.ident.table_id, rn)
                 self.storage.drop_region(rname)
                 table.regions[rn] = self.storage.create_region(
-                    rname, info.meta.schema)
+                    rname, info.meta.schema, opts=ropts)
             return True
 
     def table_exists(self, catalog: str, schema: str, name: str) -> bool:

@@ -263,13 +263,18 @@ def _sort_merge_dedup(series_ids: jax.Array,  # int32 [N]
 
 
 def merge_dedup_numpy(series_ids: np.ndarray, ts: np.ndarray, seq: np.ndarray,
-                      op_types: np.ndarray) -> np.ndarray:
+                      op_types: np.ndarray, *,
+                      keep_deletes: bool = False) -> np.ndarray:
     """Host/NumPy twin of sort_merge_dedup returning kept row indices in
-    (series, ts) order — used by the flush path and as the test oracle."""
+    (series, ts) order — used by the flush path and as the test oracle.
+
+    keep_deletes=True keeps the newest row per key even when it is a delete
+    tombstone (compaction must preserve tombstones that shadow older files
+    outside the merge set)."""
     order = np.lexsort((seq, ts, series_ids))
     s, t, o = series_ids[order], ts[order], op_types[order]
     nxt_same = np.concatenate([(s[1:] == s[:-1]) & (t[1:] == t[:-1]), [False]])
-    keep = (~nxt_same) & (o == OP_PUT)
+    keep = ~nxt_same if keep_deletes else (~nxt_same) & (o == OP_PUT)
     return order[keep]
 
 

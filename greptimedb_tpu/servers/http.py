@@ -81,6 +81,7 @@ class HttpServer:
         r.add_get("/health", self.handle_health)
         r.add_get("/status", self.handle_status)
         r.add_post("/v1/admin/flush", self.handle_flush)
+        r.add_post("/v1/admin/compact", self.handle_compact)
         r.add_route("*", "/api/v1/query", self.handle_prom_api_query)
         r.add_route("*", "/api/v1/query_range", self.handle_prom_api_range)
         r.add_route("*", "/api/v1/labels", self.handle_prom_api_labels)
@@ -336,6 +337,23 @@ class HttpServer:
                 t = cat.table(ctx.current_catalog, ctx.current_schema, name)
                 if t is not None:
                     t.flush()
+
+        await loop.run_in_executor(None, work)
+        return web.json_response({"code": 0})
+
+    async def handle_compact(self, request):
+        ctx = self._ctx(request)
+        table_name = request.query.get("table")
+        loop = asyncio.get_running_loop()
+
+        def work():
+            cat = self.frontend.catalog
+            names = [table_name] if table_name else \
+                cat.table_names(ctx.current_catalog, ctx.current_schema)
+            for name in names:
+                t = cat.table(ctx.current_catalog, ctx.current_schema, name)
+                for region in getattr(t, "regions", {}).values():
+                    region.compact()
 
         await loop.run_in_executor(None, work)
         return web.json_response({"code": 0})

@@ -1,0 +1,148 @@
+"""Downsampling: aggregate a region's rows into coarser time buckets.
+
+The north-star maintenance job (BASELINE config 5: 1s→1m downsample).
+The reference has no downsample in v0.2 — its compaction only merges
+files — so this is a capability extension: a background job that reads a
+source region (merged + deduped), reduces every (series, bucket) group
+with the scatter-free sorted-segment TPU kernel, and writes the result
+into a destination region whose time index carries the bucket timestamps.
+
+Data flow (all static-shaped for XLA):
+  merged scan (sorted by series, ts) → run ids over (series, bucket)
+  → sorted_grouped_aggregate moments on device → host fold → WriteBatch.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SUPPORTED = ("avg", "sum", "min", "max", "count", "first", "last")
+
+
+def downsample_region(src, dst, *, stride_ms: int,
+                      aggs: Optional[Dict[str, str]] = None,
+                      time_range=None) -> int:
+    """Aggregate `src` rows into `stride_ms` buckets and append to `dst`.
+
+    aggs maps field name → op (default: avg for every numeric field).
+    Destination schema must have the same tags, a timestamp column, and the
+    aggregated field columns. Returns the number of rows written."""
+    import jax
+
+    from ..ops.kernels import shape_bucket, sorted_grouped_aggregate
+    from .write_batch import WriteBatch
+
+    schema = src.schema
+    field_names = [c.name for c in schema.field_columns()
+                   if not schema.column_schema(c.name).dtype.is_string]
+    if aggs is None:
+        aggs = {f: "avg" for f in field_names}
+    for f, op in aggs.items():
+        if op not in _SUPPORTED:
+            raise ValueError(f"unsupported downsample op {op}")
+
+    data = src.snapshot().read_merged(time_range=time_range)
+    if data.num_rows == 0:
+        return 0
+    # keep only PUT rows (tombstones end their keys' history)
+    puts = data.op_types == 0
+    sids = data.series_ids[puts]
+    ts = data.ts[puts]
+    if not len(ts):
+        return 0
+
+    buckets = (ts // stride_ms).astype(np.int64)
+    # run ids over the (series, bucket) pairs — rows arrive sorted by
+    # (series, ts) so pair changes are run boundaries (device-friendly ids)
+    change = np.empty(len(ts), dtype=bool)
+    change[0] = True
+    change[1:] = (sids[1:] != sids[:-1]) | (buckets[1:] != buckets[:-1])
+    rid = np.cumsum(change) - 1
+    nruns = int(rid[-1]) + 1
+
+    base = int(ts.min())
+    rel = ts - base
+    if rel.max(initial=0) >= 2**31:
+        raise ValueError("downsample window exceeds int32 relative span")
+    d_rid = jax.device_put(rid.astype(np.int32))
+    d_ts = jax.device_put(rel.astype(np.int32))
+    d_mask = jax.device_put(np.ones(len(ts), dtype=bool))
+
+    values, col_masks, ops, slots = [], [], [], []
+    for fname in field_names:
+        if fname not in aggs:
+            continue
+        op = aggs[fname]
+        vals, valid = data.fields[fname]
+        vals = vals[puts]
+        valid_p = valid[puts] if valid is not None else \
+            np.ones(len(ts), dtype=bool)
+        v = vals.astype(np.float64)
+        x64 = jax.config.jax_enable_x64
+        d_vals = jax.device_put(v.astype(np.float64 if x64 else np.float32))
+        d_valid = jax.device_put(valid_p)
+        if op == "avg":
+            for sub in ("sum", "count"):
+                values.append(d_vals)
+                col_masks.append(d_valid)
+                ops.append(sub)
+                slots.append((fname, sub))
+        elif op in ("first", "last"):
+            values.append(d_vals)
+            col_masks.append(d_valid)
+            ops.append(op)
+            slots.append((fname, op))
+        else:
+            values.append(d_vals)
+            col_masks.append(d_valid)
+            ops.append(op)
+            slots.append((fname, op))
+
+    nbucket = shape_bucket(nruns, minimum=256)
+    results, counts = sorted_grouped_aggregate(
+        d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
+        num_groups=nbucket, ops=tuple(ops), has_col_masks=True)
+    counts = np.asarray(counts)[:nruns]
+    res = {slot: np.asarray(r)[:nruns] for slot, r in zip(slots, results)}
+
+    run_starts = np.nonzero(change)[0]
+    out_sids = sids[run_starts]
+    out_ts = buckets[run_starts] * stride_ms
+    live = counts > 0
+    out_sids, out_ts = out_sids[live], out_ts[live]
+
+    cols: Dict[str, list] = {}
+    sd = src.series_dict
+    for i, tag in enumerate(sd.tag_names):
+        cols[tag] = sd.decode_tag_column(out_sids, i)
+    ts_name = dst.schema.timestamp_column.name
+    cols[ts_name] = out_ts.tolist()
+    for fname in field_names:
+        if fname not in aggs:
+            continue
+        op = aggs[fname]
+        if op == "avg":
+            s = res[(fname, "sum")][live]
+            c = res[(fname, "count")][live]
+            vals = np.where(c > 0, s / np.maximum(c, 1), np.nan)
+        elif op == "count":
+            vals = res[(fname, "count")][live].astype(np.float64)
+        else:
+            vals = res[(fname, op)][live].astype(np.float64)
+        cols[fname] = [None if np.isnan(v) else float(v) for v in
+                       np.asarray(vals, dtype=np.float64)]
+
+    n = len(out_ts)
+    if n == 0:
+        return 0
+    wb = WriteBatch(dst.schema)
+    wb.put(cols)
+    dst.write(wb)
+    logger.info("downsampled %s -> %s: %d rows into %d buckets (stride %dms)",
+                src.name, dst.name, len(ts), n, stride_ms)
+    return n

@@ -27,16 +27,31 @@ class EngineConfig:
     disable_wal: bool = False           # benchmarks / ephemeral regions
     checkpoint_margin: int = 10
     row_group_size: int = 65536
+    # background machinery (reference: scheduler.rs + file_purger.rs)
+    bg_workers: int = 4
+    purge_grace_s: float = 60.0
+    purge_interval_s: float = 30.0
+    max_l0_files: int = 4               # L0 count that triggers compaction
+    ttl_ms: Optional[int] = None        # engine-wide default TTL
+    compaction_time_window_ms: Optional[int] = None
 
 
 class StorageEngine:
     def __init__(self, config: EngineConfig,
                  store: Optional[ObjectStore] = None):
+        from .file_purger import FilePurger
+        from .scheduler import LocalScheduler, RepeatedTask
         self.config = config
         self.store = store or FsObjectStore(os.path.join(config.data_home, "data"))
         self.wal_home = os.path.join(config.data_home, "wal")
         self._regions: Dict[str, Region] = {}
         self._lock = threading.Lock()
+        self.scheduler = LocalScheduler(max_inflight=config.bg_workers,
+                                        name="storage-bg")
+        self.purger = FilePurger(grace_s=config.purge_grace_s)
+        self._purge_task = RepeatedTask(config.purge_interval_s,
+                                        self.purger.sweep, name="file-purge")
+        self._purge_task.start()
 
     def _descriptor(self, name: str, schema: Schema) -> RegionDescriptor:
         return RegionDescriptor(
@@ -44,32 +59,41 @@ class StorageEngine:
             region_dir=name,
             wal_dir=os.path.join(self.wal_home, name))
 
-    def _region_kwargs(self) -> dict:
+    def _region_kwargs(self, opts: Optional[dict] = None) -> dict:
         kwargs = dict(
             flush_size_bytes=self.config.flush_size_bytes,
             checkpoint_margin=self.config.checkpoint_margin,
-            row_group_size=self.config.row_group_size)
+            row_group_size=self.config.row_group_size,
+            scheduler=self.scheduler,
+            purger=self.purger,
+            ttl_ms=self.config.ttl_ms,
+            max_l0_files=self.config.max_l0_files,
+            compaction_time_window_ms=self.config.compaction_time_window_ms)
         if self.config.disable_wal:
             kwargs["wal"] = NoopWal()
+        if opts:
+            kwargs.update(opts)
         return kwargs
 
-    def create_region(self, name: str, schema: Schema) -> Region:
+    def create_region(self, name: str, schema: Schema,
+                      opts: Optional[dict] = None) -> Region:
         with self._lock:
             if name in self._regions:
                 return self._regions[name]
             region = Region.create(self._descriptor(name, schema), self.store,
-                                   **self._region_kwargs())
+                                   **self._region_kwargs(opts))
             self._regions[name] = region
             return region
 
-    def open_region(self, name: str, schema: Optional[Schema] = None
-                    ) -> Optional[Region]:
+    def open_region(self, name: str, schema: Optional[Schema] = None,
+                    opts: Optional[dict] = None) -> Optional[Region]:
         """Open an existing region (schema recovered from its manifest)."""
         with self._lock:
             if name in self._regions:
                 return self._regions[name]
             desc = self._descriptor(name, schema)
-            region = Region.open(desc, self.store, **self._region_kwargs())
+            region = Region.open(desc, self.store,
+                                 **self._region_kwargs(opts))
             if region is not None:
                 self._regions[name] = region
             return region
@@ -96,6 +120,12 @@ class StorageEngine:
             return dict(self._regions)
 
     def close(self) -> None:
+        self._purge_task.stop()
+        self.scheduler.stop(drain=True)
+        # files pending purge would leak forever otherwise: nothing
+        # re-discovers SSTs absent from the manifest after a restart, and
+        # no reader can outlive the engine
+        self.purger.sweep(force=True)
         with self._lock:
             for region in self._regions.values():
                 region.close()

@@ -1,0 +1,56 @@
+"""Deleted-SST garbage collection.
+
+Reference behavior: src/storage/src/file_purger.rs — files removed from a
+region version by compaction are deleted asynchronously once no reader holds
+them. Snapshots here are short-lived and the scan cache is version-keyed, so
+a grace delay stands in for the reference's handle refcounting: a file
+becomes eligible `grace_s` seconds after it left the version (0 = purge on
+the next sweep).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class FilePurger:
+    def __init__(self, grace_s: float = 60.0):
+        self.grace_s = grace_s
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[float, Callable[[], None], str]] = []
+
+    def schedule(self, delete_fn: Callable[[], None], name: str) -> None:
+        with self._lock:
+            self._pending.append((time.time() + self.grace_s, delete_fn, name))
+
+    def sweep(self, force: bool = False) -> int:
+        """Delete everything whose grace period has passed (force=True:
+        everything pending — engine shutdown, when no reader can remain).
+        Returns the number deleted."""
+        now = time.time()
+        with self._lock:
+            due = [(t, fn, n) for t, fn, n in self._pending
+                   if force or t <= now]
+            self._pending = [] if force else \
+                [(t, fn, n) for t, fn, n in self._pending if t > now]
+        deleted = 0
+        for _, fn, name in due:
+            try:
+                fn()
+                deleted += 1
+            except FileNotFoundError:
+                deleted += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("purging %s failed; dropping from queue",
+                                 name)
+        return deleted
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)

@@ -185,7 +185,13 @@ class Region:
                  *, wal: Optional[Wal] = None,
                  flush_size_bytes: int = 64 * 1024 * 1024,
                  checkpoint_margin: int = 10,
-                 row_group_size: int = 65536):
+                 row_group_size: int = 65536,
+                 scheduler=None,
+                 purger=None,
+                 ttl_ms: Optional[int] = None,
+                 compaction_time_window_ms: Optional[int] = None,
+                 max_l0_files: int = 4,
+                 stall_bytes: Optional[int] = None):
         self.descriptor = descriptor
         self.name = descriptor.name
         # unique per in-process region object: cache keys must not collide
@@ -195,6 +201,18 @@ class Region:
         self.uid = uuid.uuid4().hex
         self.store = store
         self.flush_size_bytes = flush_size_bytes
+        # background machinery (None = synchronous inline fallback)
+        self.scheduler = scheduler
+        self.purger = purger
+        self.ttl_ms = ttl_ms
+        self.compaction_time_window_ms = compaction_time_window_ms
+        self.max_l0_files = max_l0_files
+        # writers stall when frozen-but-unflushed memtables pile up past
+        # this (reference write-stall: src/storage/src/region/writer.rs:584)
+        self.stall_bytes = stall_bytes if stall_bytes is not None \
+            else 4 * flush_size_bytes
+        self._flush_done = threading.Event()
+        self._flush_done.set()
         self._writer_lock = threading.RLock()
         self.wal = wal if wal is not None else Wal(descriptor.wal_dir)
         self.manifest = RegionManifest(
@@ -323,6 +341,7 @@ class Region:
     # ---- write path ----
     def write(self, batch: WriteBatch) -> int:
         """WAL append → memtable insert → sequence bump. Returns rows written."""
+        stall = False
         with self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
@@ -335,30 +354,90 @@ class Region:
             # it (duplicate-seq WAL records would corrupt replay)
             vc.set_committed_sequence(seq)
             vc.current.memtables.mutable.write(seq, batch)
-            if vc.current.memtables.mutable_bytes >= self.flush_size_bytes:
-                self.flush()
-            return batch.num_rows
+            mts = vc.current.memtables
+            if mts.mutable_bytes >= self.flush_size_bytes:
+                if self.scheduler is None:
+                    self.flush()          # no background pool: inline
+                else:
+                    self._freeze_and_schedule_flush()
+            stall = (self.version_control.current.memtables.total_bytes -
+                     self.version_control.current.memtables.mutable_bytes
+                     ) >= self.stall_bytes
+        if stall and self.scheduler is not None:
+            # write stall: block (outside the writer lock so the flush
+            # worker can commit) until the backlog drains
+            self._flush_done.wait(timeout=300)
+        return batch.num_rows
 
     # ---- flush ----
+    def _freeze_and_schedule_flush(self):
+        """Freeze the mutable memtable and queue a background flush.
+        Caller holds the writer lock."""
+        vc = self.version_control
+        if vc.current.memtables.mutable.num_rows:
+            vc.freeze_mutable(Memtable(vc.current.schema, self.series_dict))
+        if not vc.current.memtables.immutables:
+            return None
+        self._flush_done.clear()
+        try:
+            return self.scheduler.submit(f"flush:{self.uid}",
+                                         self._flush_job)
+        except RuntimeError:
+            # engine shutting down: skip — the WAL keeps the frozen data
+            # durable and replay restores it on the next open
+            self._flush_done.set()
+            return None
+
     def flush(self) -> List[FileMeta]:
-        """Freeze the mutable memtable and write every frozen memtable to L0
-        SSTs; record the edit in the manifest; truncate the WAL.
-        (reference: src/storage/src/flush.rs FlushJob)"""
+        """Flush all frozen + mutable data to L0 SSTs and wait for
+        completion (reference: src/storage/src/flush.rs FlushJob). The
+        write path instead schedules `_flush_job` asynchronously."""
+        if self.scheduler is None:
+            with self._writer_lock:
+                vc = self.version_control
+                if vc.current.memtables.mutable.num_rows:
+                    vc.freeze_mutable(Memtable(vc.current.schema,
+                                               self.series_dict))
+                if not vc.current.memtables.immutables:
+                    return []
+                return self._flush_job()
         with self._writer_lock:
-            vc = self.version_control
-            v = vc.current
-            if v.memtables.mutable.num_rows:
-                vc.freeze_mutable(Memtable(v.schema, self.series_dict))
-            v = vc.current
-            to_flush = list(v.memtables.immutables)
-            if not to_flush:
-                return []
-            flushed_seq = vc.committed_sequence
-            files: List[FileMeta] = []
-            for mt in to_flush:
-                meta = self._flush_memtable(mt)
-                if meta is not None:
-                    files.append(meta)
+            handle = self._freeze_and_schedule_flush()
+        return handle.wait(timeout=600) if handle is not None else []
+
+    def _flush_job(self) -> List[FileMeta]:
+        """Write every frozen memtable to L0 SSTs; record the edit in the
+        manifest; truncate the WAL. Runs on a scheduler worker: SST encode
+        happens outside the writer lock, only the commit takes it."""
+        try:
+            return self._flush_job_inner()
+        finally:
+            # a failed flush must not leave stalled writers blocking their
+            # full timeout — they re-check the backlog and stall again if
+            # it is still above the limit
+            self._flush_done.set()
+
+    def _flush_job_inner(self) -> List[FileMeta]:
+        vc = self.version_control
+        to_flush = list(vc.current.memtables.immutables)
+        if not to_flush:
+            return []
+        # safe WAL truncation point: every row with seq <= the max sequence
+        # in the frozen set lives in these memtables (the mutable only
+        # receives later sequences)
+        flushed_seq = 0
+        files: List[FileMeta] = []
+        for mt in to_flush:
+            snap = mt.snapshot()
+            if snap.num_rows:
+                flushed_seq = max(flushed_seq, int(snap.seq.max()))
+            meta = self._flush_memtable(mt)
+            if meta is not None:
+                files.append(meta)
+        with self._writer_lock:
+            if self.closed:
+                return files
+            flushed_seq = max(flushed_seq, vc.current.flushed_sequence)
             dict_file = self._persist_series_dict()
             edit = {
                 "type": "edit",
@@ -369,11 +448,15 @@ class Region:
             if dict_file:
                 edit["series_dict_file"] = dict_file
             mv = self.manifest.save([edit])
-            vc.apply_flush(memtable_ids=[m.id for m in to_flush], files=files,
-                           flushed_sequence=flushed_seq, manifest_version=mv)
+            vc.apply_flush(memtable_ids=[m.id for m in to_flush],
+                           files=files, flushed_sequence=flushed_seq,
+                           manifest_version=mv)
             self._maybe_checkpoint()
             self.wal.obsolete(flushed_seq)
-            return files
+            l0_count = len(vc.current.ssts.levels[0])
+        if self.scheduler is not None and l0_count >= self.max_l0_files:
+            self.schedule_compaction()
+        return files
 
     def _flush_memtable(self, mt: Memtable) -> Optional[FileMeta]:
         snap = mt.snapshot()
@@ -392,7 +475,8 @@ class Region:
             fields[name] = (data[order], valid[order] if valid is not None else None)
         return self.access_layer.write_sst(
             level=0, series_ids=sids, ts=snap.ts[order], seq=snap.seq[order],
-            op_types=snap.op_types[order], fields=fields, tag_columns=tag_cols)
+            op_types=snap.op_types[order], fields=fields,
+            tag_columns=tag_cols, schema=mt.schema)
 
     def _persist_series_dict(self) -> Optional[str]:
         if self.series_dict.num_series == self._persisted_series:
@@ -419,6 +503,104 @@ class Region:
             "series_dict_file": dict_file,
         })
         self.manifest.gc()
+
+    # ---- compaction ----
+    def schedule_compaction(self, wait: bool = False):
+        """Queue a background compaction (dedup-keyed: repeat submits while
+        one is queued coalesce). Returns the job handle."""
+        if self.scheduler is None:
+            return self._compact_job()
+        try:
+            handle = self.scheduler.submit(f"compact:{self.uid}",
+                                           self._compact_job)
+        except RuntimeError:
+            return None                  # engine shutting down
+        if wait:
+            return handle.wait(timeout=600)
+        return handle
+
+    def compact(self, now_ms: Optional[int] = None) -> List[FileMeta]:
+        """Synchronous manual compaction (reference: writer.rs:681 manual
+        compact path; ALTER TABLE ... COMPACT / admin endpoint). Serialized
+        with background compactions through the scheduler's dedup key —
+        two concurrent runs over the same L0 inputs would each write an L1
+        copy of every row."""
+        if self.closed:
+            return []
+        if self.scheduler is not None:
+            try:
+                out = self.scheduler.submit(
+                    f"compact:{self.uid}",
+                    lambda: self._compact_job(min_l0_files=1,
+                                              now_ms=now_ms)
+                ).wait(timeout=600)
+                if not out and \
+                        self.version_control.current.ssts.levels[0]:
+                    # the submit coalesced into an already-queued background
+                    # job that declined (below its L0 threshold) — run the
+                    # manual plan now that the key is free
+                    out = self.scheduler.submit(
+                        f"compact:{self.uid}",
+                        lambda: self._compact_job(min_l0_files=1,
+                                                  now_ms=now_ms)
+                    ).wait(timeout=600)
+                return out
+            except RuntimeError:
+                return []
+        return self._compact_job(min_l0_files=1, now_ms=now_ms)
+
+    def _compact_job(self, min_l0_files: Optional[int] = None,
+                     now_ms: Optional[int] = None) -> List[FileMeta]:
+        from .compaction import pick_compaction, run_compaction
+        if self.closed:
+            return []
+        plan = pick_compaction(
+            self.version_control.current.ssts, ttl_ms=self.ttl_ms,
+            now_ms=now_ms,
+            min_l0_files=self.max_l0_files if min_l0_files is None
+            else min_l0_files,
+            time_window_ms=self.compaction_time_window_ms)
+        if plan is None:
+            return []
+        return run_compaction(self, plan, ttl_ms=self.ttl_ms, now_ms=now_ms)
+
+    def commit_compaction(self, *, removed: List[str],
+                          added: List[FileMeta]) -> None:
+        """Swap compaction outputs into the version + manifest and hand the
+        removed files to the purger (they stay readable until the grace
+        period passes)."""
+        with self._writer_lock:
+            if self.closed:
+                return
+            mv = self.manifest.save([{
+                "type": "edit",
+                "added": [f.to_dict() for f in added],
+                "removed": list(removed),
+            }])
+            self.version_control.apply_compaction(
+                removed=removed, added=added, manifest_version=mv)
+            self._maybe_checkpoint()
+        for name in removed:
+            if self.purger is not None:
+                self.purger.schedule(
+                    (lambda n=name: self.access_layer.delete_sst(n)), name)
+
+    # ---- TTL ----
+    def apply_ttl(self, now_ms: Optional[int] = None) -> int:
+        """Drop whole SSTs past the region TTL (row-level expiry happens at
+        compaction). Returns the number of files dropped."""
+        if self.ttl_ms is None:
+            return 0
+        import time as _time
+        now_ms = int(_time.time() * 1000) if now_ms is None else now_ms
+        cutoff = now_ms - self.ttl_ms
+        expired = [f for f in self.version_control.current.ssts.all_files()
+                   if f.time_range[1] < cutoff]
+        if not expired:
+            return 0
+        self.commit_compaction(removed=[f.file_name for f in expired],
+                               added=[])
+        return len(expired)
 
     # ---- alter ----
     def alter(self, new_schema: Schema) -> None:

@@ -129,12 +129,15 @@ class AccessLayer:
     def write_sst(self, *, level: int, series_ids: np.ndarray, ts: np.ndarray,
                   seq: np.ndarray, op_types: np.ndarray,
                   fields: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]],
-                  tag_columns: Dict[str, list]) -> Optional[FileMeta]:
-        """Write one SST from sorted SoA arrays. Returns None for empty input."""
+                  tag_columns: Dict[str, list],
+                  schema: Optional[Schema] = None) -> Optional[FileMeta]:
+        """Write one SST from sorted SoA arrays. Returns None for empty
+        input. `schema` overrides the layer's current schema (background
+        flush of a memtable frozen before an ALTER)."""
         n = len(ts)
         if n == 0:
             return None
-        schema = self.schema
+        schema = schema if schema is not None else self.schema
         arrays: List[pa.Array] = []
         names: List[str] = []
         for c in schema.column_schemas:

@@ -315,3 +315,19 @@ class TestPromApiQuery:
         data = json.loads(body)
         hosts = {e.get("host") for e in data["data"]}
         assert hosts == {"h0", "h1"}
+
+
+class TestAdminCompact:
+    def test_flush_then_compact_endpoint(self, server):
+        sql(server, "CREATE TABLE ac (host STRING, ts TIMESTAMP TIME INDEX,"
+                    " cpu DOUBLE, PRIMARY KEY(host))")
+        for gen in range(2):
+            sql(server, f"INSERT INTO ac VALUES ('a', 1, {gen}.0)")
+            req(server, "/v1/admin/flush?table=ac", "POST", b"")
+        status, body = req(server, "/v1/admin/compact?table=ac", "POST", b"")
+        assert status == 200
+        t = server.frontend.catalog.table("greptime", "public", "ac")
+        region = next(iter(t.regions.values()))
+        assert len(region.version_control.current.ssts.levels[1]) == 1
+        out = sql(server, "SELECT cpu FROM ac")
+        assert out["output"][0]["records"]["rows"] == [[1.0]]
