@@ -51,6 +51,7 @@ class MergedScan:
     fields: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
     series_dict: object
     ts_base: int                      # device ts = ts - ts_base (int32)
+    seq: Optional[np.ndarray] = None  # per-row sequence (incremental merge)
     device: Dict[str, object] = field(default_factory=dict)
 
     @property
@@ -104,39 +105,189 @@ class MergedScan:
         return self.device["__all_valid"]
 
 
+@dataclass
+class _CacheEntry:
+    scan: MergedScan
+    visible: int                      # sequences <= visible are merged in
+    sst_names: frozenset              # SSTs whose content is merged in
+    schema_version: int
+    retraction_epoch: int
+
+
 class _ScanCache:
+    """Per-region merged-scan cache with incremental maintenance.
+
+    On a version bump the cache merges only the *delta* — memtable rows
+    with sequences beyond the cached watermark plus SSTs that carry such
+    rows — into the cached sorted arrays, instead of re-reading and
+    re-sorting the whole region (VERDICT round-1 weakness 5: scan prep
+    must be proportional to new data, not region size). Flushes and
+    compactions whose files only contain already-covered sequences reuse
+    the cache as-is; TTL retraction (region.retraction_epoch) and schema
+    changes force a full rebuild."""
+
     def __init__(self, capacity: int = 16):
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._entries: Dict[tuple, MergedScan] = {}
+        self._entries: Dict[str, _CacheEntry] = {}
 
     def get(self, region) -> MergedScan:
         snap = region.snapshot()
         v = snap._version
-        key = (region.uid, snap.visible_sequence, v.manifest_version,
-               v.schema.version)
+        visible = snap.visible_sequence
+        sst_names = frozenset(f.file_name for f in v.ssts.all_files())
+        epoch = getattr(region, "retraction_epoch", 0)
         with self._lock:
-            hit = self._entries.get(key)
-        if hit is not None:
-            return hit
+            entry = self._entries.get(region.uid)
+        if entry is not None and entry.schema_version == v.schema.version \
+                and entry.retraction_epoch == epoch \
+                and entry.visible <= visible:
+            if entry.visible == visible and entry.sst_names == sst_names:
+                return entry.scan
+            scan = self._incremental(region, snap, v, entry, visible)
+        else:
+            scan = self._full(region, snap)
+        entry = _CacheEntry(scan, visible, sst_names, v.schema.version,
+                            epoch)
+        with self._lock:
+            if region.uid not in self._entries and \
+                    len(self._entries) >= self.capacity:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[region.uid] = entry
+        return scan
+
+    def _full(self, region, snap) -> MergedScan:
         data = snap.scan()
         if data.num_rows:
             kept = merge_dedup_numpy(data.series_ids, data.ts, data.seq,
                                      data.op_types)
             sids = data.series_ids[kept]
             ts = data.ts[kept]
+            seq = data.seq[kept]
             fields = {n: (d[kept], vd[kept] if vd is not None else None)
                       for n, (d, vd) in data.fields.items()}
         else:
-            sids, ts, fields = data.series_ids, data.ts, data.fields
+            sids, ts, seq = data.series_ids, data.ts, data.seq
+            fields = data.fields
         base = int(ts.min()) if ts.size else 0
-        scan = MergedScan(sids.astype(np.int32), ts, fields,
-                          data.series_dict, base)
-        with self._lock:
-            if len(self._entries) >= self.capacity:
-                self._entries.pop(next(iter(self._entries)))
-            self._entries[key] = scan
-        return scan
+        return MergedScan(sids.astype(np.int32), ts, fields,
+                          data.series_dict, base, seq=seq)
+
+    def _incremental(self, region, snap, v, entry: _CacheEntry,
+                     visible: int) -> MergedScan:
+        from ..datatypes.vector import null_column
+        schema = v.schema
+        field_names = [c.name for c in schema.field_columns()]
+        lo = entry.visible
+        runs = []
+        # memtable rows beyond the cached watermark
+        for mt in v.memtables.all_memtables():
+            ms = mt.snapshot()
+            if ms.num_rows == 0:
+                continue
+            sel = (ms.seq > lo) & (ms.seq <= visible)
+            if not sel.any():
+                continue
+            fields = {}
+            for name in field_names:
+                if name in ms.fields:
+                    d, vd = ms.fields[name]
+                    fields[name] = (d[sel],
+                                    vd[sel] if vd is not None else None)
+                else:
+                    fields[name] = null_column(
+                        schema.column_schema(name).dtype, int(sel.sum()))
+            runs.append((ms.series_ids[sel], ms.ts[sel], ms.seq[sel],
+                         ms.op_types[sel], fields))
+        # SSTs not yet covered that carry rows beyond the watermark
+        # (freshly flushed files whose max_sequence <= lo are already in
+        # the cache via the memtable — skip reading them entirely)
+        for meta in v.ssts.all_files():
+            if meta.file_name in entry.sst_names or meta.max_sequence <= lo:
+                continue
+            sst = region.access_layer.read_sst(meta,
+                                               projection=field_names)
+            if sst.num_rows == 0:
+                continue
+            sel = (sst.seq > lo) & (sst.seq <= visible)
+            if not sel.any():
+                continue
+            fields = {n: (d[sel], vd[sel] if vd is not None else None)
+                      for n, (d, vd) in sst.fields.items()}
+            runs.append((sst.series_ids[sel], sst.ts[sel], sst.seq[sel],
+                         sst.op_types[sel], fields))
+
+        cached = entry.scan
+        if not runs:
+            return cached
+        # sort + dedup the delta alone (small), then splice it into the
+        # already-sorted cached arrays with searchsorted + np.insert —
+        # O(delta·log + n) memcpy, no sort over the region
+        dsid = np.concatenate([r[0] for r in runs])
+        dts = np.concatenate([r[1] for r in runs])
+        dseq = np.concatenate([r[2] for r in runs])
+        dop = np.concatenate([r[3] for r in runs])
+        dorder = np.lexsort((dseq, dts, dsid))
+        dsid, dts, dseq, dop = (a[dorder] for a in (dsid, dts, dseq, dop))
+        # within-delta dedup: keep the newest version of each (sid, ts)
+        nxt_same = np.concatenate([(dsid[1:] == dsid[:-1]) &
+                                   (dts[1:] == dts[:-1]), [False]])
+        dkeep0 = ~nxt_same
+        dsel = dorder[dkeep0]
+        dsid, dts, dseq, dop = (a[dkeep0] for a in (dsid, dts, dseq, dop))
+
+        csid, cts = cached.series_ids, cached.ts
+        n_cached = cached.num_rows
+        # two-level searchsorted: sid bounds, then ts inside each sid run
+        pos = np.empty(len(dsid), dtype=np.int64)
+        for s in np.unique(dsid):
+            m = dsid == s
+            lo = int(np.searchsorted(csid, s, side="left"))
+            hi = int(np.searchsorted(csid, s, side="right"))
+            pos[m] = lo + np.searchsorted(cts[lo:hi], dts[m], side="left")
+        # collisions: a delta key that already exists replaces (or deletes)
+        # the cached row; all delta sequences are newer by construction
+        collide = (pos < n_cached)
+        if collide.any():
+            pc = np.minimum(pos, n_cached - 1)
+            collide &= (csid[pc] == dsid) & (cts[pc] == dts)
+        ckeep = np.ones(n_cached, dtype=bool)
+        ckeep[pos[collide]] = False
+        dlive = dop == 0                      # delete tombstones vanish
+        # adjust insert positions for dropped cached rows
+        dropped_prefix = np.concatenate([[0], np.cumsum(~ckeep)])
+        adj = pos - dropped_prefix[pos]
+
+        ins = dlive
+        sids = np.insert(csid[ckeep] if not ckeep.all() else csid,
+                         adj[ins], dsid[ins]).astype(np.int32)
+        ts = np.insert(cts[ckeep] if not ckeep.all() else cts,
+                       adj[ins], dts[ins])
+        cseq = cached.seq if cached.seq is not None \
+            else np.zeros(n_cached, np.int64)
+        seq = np.insert(cseq[ckeep] if not ckeep.all() else cseq,
+                        adj[ins], dseq[ins])
+        fields = {}
+        for name in field_names:
+            cd, cv = cached.fields[name]
+            dd = np.concatenate([r[4][name][0] for r in runs])[dsel]
+            dvs = [r[4][name][1] for r in runs]
+            if cv is not None or any(x is not None for x in dvs):
+                dv = np.concatenate([
+                    x if x is not None else np.ones(len(r[4][name][0]),
+                                                    dtype=bool)
+                    for x, r in zip(dvs, runs)])[dsel]
+                cvf = cv if cv is not None else np.ones(n_cached, bool)
+                valid = np.insert(cvf[ckeep] if not ckeep.all() else cvf,
+                                  adj[ins], dv[ins])
+            else:
+                valid = None
+            data = np.insert(cd[ckeep] if not ckeep.all() else cd,
+                             adj[ins], dd[ins])
+            fields[name] = (data, valid)
+        base = int(ts.min()) if ts.size else 0
+        return MergedScan(sids, ts, fields, cached.series_dict, base,
+                          seq=seq)
 
 
 SCAN_CACHE = _ScanCache()

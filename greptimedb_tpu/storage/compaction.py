@@ -90,6 +90,7 @@ def run_compaction(region, plan: CompactionPlan,
     schema = region.schema
     field_names = [c.name for c in schema.field_columns()]
 
+    retracts = bool(plan.expired)
     new_files: List[FileMeta] = []
     if plan.inputs:
         datas = [al.read_sst(m) for m in plan.inputs]
@@ -120,6 +121,7 @@ def run_compaction(region, plan: CompactionPlan,
             if ttl_ms is not None:
                 live = ts >= (now_ms - ttl_ms)
                 if not live.all():
+                    retracts = True
                     sids, ts, seq, op = (a[live] for a in (sids, ts, seq, op))
                     fields = {n: (d[live], v[live] if v is not None else None)
                               for n, (d, v) in fields.items()}
@@ -143,7 +145,8 @@ def run_compaction(region, plan: CompactionPlan,
 
     removed = [f.file_name for f in plan.inputs] + \
         [f.file_name for f in plan.expired]
-    region.commit_compaction(removed=removed, added=new_files)
+    region.commit_compaction(removed=removed, added=new_files,
+                             retracts=retracts)
     logger.info("region %s compacted %d inputs (+%d expired) -> %d L1 files",
                 region.name, len(plan.inputs), len(plan.expired),
                 len(new_files))

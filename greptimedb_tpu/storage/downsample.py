@@ -92,11 +92,6 @@ def downsample_region(src, dst, *, stride_ms: int,
                 col_masks.append(d_valid)
                 ops.append(sub)
                 slots.append((fname, sub))
-        elif op in ("first", "last"):
-            values.append(d_vals)
-            col_masks.append(d_valid)
-            ops.append(op)
-            slots.append((fname, op))
         else:
             values.append(d_vals)
             col_masks.append(d_valid)

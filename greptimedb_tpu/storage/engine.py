@@ -31,6 +31,7 @@ class EngineConfig:
     bg_workers: int = 4
     purge_grace_s: float = 60.0
     purge_interval_s: float = 30.0
+    ttl_check_interval_s: float = 300.0
     max_l0_files: int = 4               # L0 count that triggers compaction
     ttl_ms: Optional[int] = None        # engine-wide default TTL
     compaction_time_window_ms: Optional[int] = None
@@ -52,6 +53,19 @@ class StorageEngine:
         self._purge_task = RepeatedTask(config.purge_interval_s,
                                         self.purger.sweep, name="file-purge")
         self._purge_task.start()
+        # TTL is otherwise only enforced when write volume trips a
+        # compaction — quiet regions must still expire (whole-file drops
+        # here; row-level expiry rides the next compaction)
+        self._ttl_task = RepeatedTask(config.ttl_check_interval_s,
+                                      self._ttl_sweep, name="ttl-sweep")
+        self._ttl_task.start()
+
+    def _ttl_sweep(self) -> None:
+        for region in self.list_regions().values():
+            if region.ttl_ms is not None and not region.closed:
+                region.apply_ttl()
+                if region.version_control.current.ssts.levels[0]:
+                    region.schedule_compaction()
 
     def _descriptor(self, name: str, schema: Schema) -> RegionDescriptor:
         return RegionDescriptor(
@@ -120,6 +134,7 @@ class StorageEngine:
             return dict(self._regions)
 
     def close(self) -> None:
+        self._ttl_task.stop()
         self._purge_task.stop()
         self.scheduler.stop(drain=True)
         # files pending purge would leak forever otherwise: nothing

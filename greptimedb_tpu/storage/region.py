@@ -213,6 +213,9 @@ class Region:
             else 4 * flush_size_bytes
         self._flush_done = threading.Event()
         self._flush_done.set()
+        # bumped whenever committed data is *retracted* (TTL expiry) rather
+        # than superseded — incremental scan caches must rebuild then
+        self.retraction_epoch = 0
         self._writer_lock = threading.RLock()
         self.wal = wal if wal is not None else Wal(descriptor.wal_dir)
         self.manifest = RegionManifest(
@@ -349,11 +352,17 @@ class Region:
             seq = vc.next_sequence()
             self.wal.append(seq, batch.encode(),
                             schema_version=vc.current.schema.version)
-            # the sequence is consumed the moment it hits the WAL — even if
-            # the memtable insert below throws, the next write must not reuse
-            # it (duplicate-seq WAL records would corrupt replay)
-            vc.set_committed_sequence(seq)
-            vc.current.memtables.mutable.write(seq, batch)
+            # committed_sequence advances only after the memtable insert:
+            # snapshot readers sample it without the writer lock, so rows
+            # must be visible in the memtable before their sequence is —
+            # the incremental scan cache records `visible` as its permanent
+            # high-watermark and would otherwise skip the batch forever.
+            # The finally still consumes the sequence on insert failure
+            # (it hit the WAL; reuse would corrupt replay).
+            try:
+                vc.current.memtables.mutable.write(seq, batch)
+            finally:
+                vc.set_committed_sequence(seq)
             mts = vc.current.memtables
             if mts.mutable_bytes >= self.flush_size_bytes:
                 if self.scheduler is None:
@@ -565,10 +574,12 @@ class Region:
         return run_compaction(self, plan, ttl_ms=self.ttl_ms, now_ms=now_ms)
 
     def commit_compaction(self, *, removed: List[str],
-                          added: List[FileMeta]) -> None:
+                          added: List[FileMeta],
+                          retracts: bool = False) -> None:
         """Swap compaction outputs into the version + manifest and hand the
         removed files to the purger (they stay readable until the grace
-        period passes)."""
+        period passes). retracts=True marks that visible rows disappeared
+        (TTL expiry), invalidating incremental scan caches."""
         with self._writer_lock:
             if self.closed:
                 return
@@ -579,6 +590,8 @@ class Region:
             }])
             self.version_control.apply_compaction(
                 removed=removed, added=added, manifest_version=mv)
+            if retracts:
+                self.retraction_epoch += 1
             self._maybe_checkpoint()
         for name in removed:
             if self.purger is not None:
@@ -599,7 +612,7 @@ class Region:
         if not expired:
             return 0
         self.commit_compaction(removed=[f.file_name for f in expired],
-                               added=[])
+                               added=[], retracts=True)
         return len(expired)
 
     # ---- alter ----

@@ -403,3 +403,19 @@ class TestReviewRegressions:
         eng.close()
         for n in names:
             assert not store.exists(f"{region_dir}/sst/{n}")
+
+
+class TestTtlSweepTask:
+    def test_periodic_ttl_sweep_drops_expired(self, tmp_path):
+        eng = mk_engine(tmp_path, ttl_check_interval_s=0.1)
+        r = eng.create_region("r", monitor_schema())
+        r.ttl_ms = 1           # everything (epoch-near data) is expired
+        put(r, ["a"], [1000], [1.0])
+        r.flush()
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                r.version_control.current.ssts.all_files():
+            time.sleep(0.05)
+        assert not r.version_control.current.ssts.all_files(), \
+            "ttl sweep never dropped the expired file"
+        eng.close()

