@@ -1,0 +1,118 @@
+"""Datanode client: the router↔worker data-plane interface.
+
+Reference behavior: src/client — `Database` sends per-region inserts and
+ships plans to datanodes, streaming results back over Arrow Flight
+(database.rs:39,209-260). The same surface here has two implementations:
+
+- `LocalDatanodeClient`: direct in-process calls (the reference's
+  MockDistributedInstance topology, frontend/src/tests.rs:60) — also the
+  fast path when router and worker share a host;
+- a Flight/gRPC client implements the identical surface over sockets for
+  multi-host (servers/flight.py).
+
+Aggregate pushdown note: v0.2 of the reference pushes only scans
+(projection/filter/limit) to datanodes and aggregates on the frontend
+(frontend/src/table.rs:109-156). Here `region_moments` pushes the
+*aggregation moments* down: each worker reduces its regions with the TPU
+kernel and returns per-run moment frames that the frontend folds — a
+strict upgrade the SURVEY (§3.4) calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import pandas as pd
+
+from ..table.requests import CreateTableRequest, DropTableRequest
+
+
+class DatanodeClient:
+    """Abstract data-plane client for one datanode."""
+
+    def ddl_create_table(self, request: CreateTableRequest) -> None:
+        raise NotImplementedError
+
+    def ddl_drop_table(self, catalog: str, schema: str, name: str) -> bool:
+        raise NotImplementedError
+
+    def write_region(self, catalog: str, schema: str, table: str,
+                     region_number: int, columns: Dict[str, Sequence],
+                     op: str = "put") -> int:
+        raise NotImplementedError
+
+    def region_moments(self, catalog: str, schema: str, table: str,
+                       plan) -> List[pd.DataFrame]:
+        """Run the TPU aggregate plan over this node's regions of the
+        table; returns per-region moment frames for the frontend fold."""
+        raise NotImplementedError
+
+    def scan_batches(self, catalog: str, schema: str, table: str,
+                     projection: Optional[Sequence[str]] = None,
+                     time_range=None) -> list:
+        raise NotImplementedError
+
+    def flush_table(self, catalog: str, schema: str, table: str) -> None:
+        raise NotImplementedError
+
+    def describe_table(self, catalog: str, schema: str, name: str):
+        """(TableInfo, partition_rule) of a hosted table, or None."""
+        raise NotImplementedError
+
+
+class LocalDatanodeClient(DatanodeClient):
+    def __init__(self, datanode):
+        self.datanode = datanode
+
+    @property
+    def node_id(self) -> int:
+        return self.datanode.opts.node_id
+
+    def _table(self, catalog: str, schema: str, name: str):
+        from ..errors import TableNotFoundError
+        t = self.datanode.catalog.table(catalog, schema, name)
+        if t is None:
+            raise TableNotFoundError(f"table {catalog}.{schema}.{name} "
+                                     f"not on datanode {self.node_id}")
+        return t
+
+    def ddl_create_table(self, request: CreateTableRequest) -> None:
+        table = self.datanode.mito.create_table(request)
+        cat = self.datanode.catalog
+        if cat.table(request.catalog_name, request.schema_name,
+                     request.table_name) is None:
+            cat.register_table(request.catalog_name, request.schema_name,
+                               request.table_name, table)
+
+    def ddl_drop_table(self, catalog: str, schema: str, name: str) -> bool:
+        ok = self.datanode.mito.drop_table(
+            DropTableRequest(name, catalog, schema))
+        self.datanode.catalog.deregister_table(catalog, schema, name)
+        return ok
+
+    def write_region(self, catalog: str, schema: str, table: str,
+                     region_number: int, columns: Dict[str, Sequence],
+                     op: str = "put") -> int:
+        return self._table(catalog, schema, table).write_region(
+            region_number, columns, op)
+
+    def region_moments(self, catalog: str, schema: str, table: str,
+                       plan) -> List[pd.DataFrame]:
+        from ..query.tpu_exec import region_moment_frames
+        return region_moment_frames(self._table(catalog, schema, table),
+                                    plan)
+
+    def scan_batches(self, catalog: str, schema: str, table: str,
+                     projection: Optional[Sequence[str]] = None,
+                     time_range=None) -> list:
+        return self._table(catalog, schema, table).scan_batches(
+            projection=projection, time_range=time_range)
+
+    def flush_table(self, catalog: str, schema: str, table: str) -> None:
+        self._table(catalog, schema, table).flush()
+
+    def describe_table(self, catalog: str, schema: str, name: str):
+        t = self.datanode.catalog.table(catalog, schema, name)
+        if t is None:
+            return None
+        return t.info, getattr(t, "partition_rule", None)

@@ -47,6 +47,7 @@ class DatanodeInstance:
         self.catalog = LocalCatalogManager(self.store, self.engines)
         self.query_engine = QueryEngine(self.catalog)
         self._started = False
+        self._heartbeat_task = None
 
     def start(self) -> None:
         """Catalog replay → table open → region WAL replay."""
@@ -59,7 +60,36 @@ class DatanodeInstance:
                 NumbersTable())
         self._started = True
 
+    def start_heartbeat(self, meta_client, interval_s: float = 5.0) -> None:
+        """Report liveness + region stats to the meta service (reference:
+        src/datanode/src/heartbeat.rs:27-141; stats feed the load-based
+        selector and the phi failure detector)."""
+        from ..meta import DatanodeStat
+        from ..storage.scheduler import RepeatedTask
+
+        def beat():
+            regions = self.storage.list_regions()
+            stat = DatanodeStat(region_count=len(regions))
+            resp = meta_client.heartbeat(self.opts.node_id, stat)
+            for msg in resp.mailbox:
+                self._handle_mailbox(msg)
+
+        beat()                         # immediate first beat (registration)
+        self._heartbeat_task = RepeatedTask(
+            interval_s, beat, name=f"heartbeat-dn{self.opts.node_id}")
+        self._heartbeat_task.start()
+
+    def _handle_mailbox(self, msg: dict) -> None:
+        """Meta→datanode control messages riding heartbeat responses."""
+        if msg.get("type") == "flush_table":
+            t = self.catalog.table(msg["catalog"], msg["schema"],
+                                   msg["table"])
+            if t is not None:
+                t.flush()
+
     def shutdown(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.stop()
         for engine in self.engines.values():
             engine.close()
         self.storage.close()

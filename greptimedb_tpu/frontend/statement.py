@@ -61,6 +61,68 @@ def build_column_schema(col: ast.ColumnDef, *, is_tag: bool,
                         comment=col.comment or "")
 
 
+def build_schema_from_create(stmt: ast.CreateTable):
+    """CREATE TABLE statement → (Schema, primary-key indices)."""
+    pk = set(stmt.primary_keys)
+    cols = []
+    for c in stmt.columns:
+        cols.append(build_column_schema(
+            c, is_tag=c.name in pk,
+            is_time_index=c.name == stmt.time_index))
+    schema = Schema(cols)
+    pk_indices = [i for i, c in enumerate(cols)
+                  if c.semantic_type == SemanticType.TAG]
+    return schema, pk_indices
+
+
+def evaluate_insert_rows(stmt: ast.Insert, columns, query_engine, ctx
+                         ) -> dict:
+    """INSERT VALUES/SELECT → column dict (shared by the standalone and
+    distributed executors)."""
+    if stmt.select is not None:
+        out = query_engine.execute_query(stmt.select, ctx)
+        rows = [list(r) for b in out.batches for r in b.rows()]
+    else:
+        ev = Evaluator(pd.DataFrame(index=[0]))
+        rows = []
+        for row in stmt.rows:
+            if len(row) != len(columns):
+                raise InvalidArgumentsError(
+                    f"insert row has {len(row)} values, expected "
+                    f"{len(columns)}")
+            vals = []
+            for e in row:
+                v = ev.eval(e)
+                if isinstance(v, pd.Series):
+                    v = v.iloc[0]
+                vals.append(v)
+            rows.append(vals)
+    return {c: [r[i] for r in rows] for i, c in enumerate(columns)}
+
+
+def delete_matching_rows(table, stmt: ast.Delete) -> Output:
+    """DELETE ... WHERE: scan key columns, filter, delete by key (shared by
+    the standalone and distributed executors)."""
+    schema = table.schema
+    tc = schema.timestamp_column
+    key_cols = schema.tag_names() + ([tc.name] if tc else [])
+    batches = table.scan_batches(projection=key_cols)
+    frames = [pd.DataFrame(b.to_pydict()) for b in batches]
+    df = pd.concat(frames, ignore_index=True) if frames else \
+        pd.DataFrame(columns=key_cols)
+    if stmt.where is not None and len(df):
+        mask = Evaluator(df).eval(stmt.where)
+        if isinstance(mask, pd.Series):
+            df = df[mask.fillna(False).astype(bool)]
+        elif not mask:
+            df = df.iloc[0:0]
+    if not len(df):
+        return Output.rows(0)
+    df = df.drop_duplicates()
+    table.delete({c: df[c].tolist() for c in key_cols})
+    return Output.rows(len(df))
+
+
 class StatementExecutor:
     def __init__(self, catalog: CatalogManager,
                  engines: Dict[str, TableEngine], query_engine):
@@ -86,15 +148,7 @@ class StatementExecutor:
             from ..errors import TableAlreadyExistsError
             raise TableAlreadyExistsError(
                 f"table {table_name!r} already exists")
-        pk = set(stmt.primary_keys)
-        cols = []
-        for c in stmt.columns:
-            cols.append(build_column_schema(
-                c, is_tag=c.name in pk,
-                is_time_index=c.name == stmt.time_index))
-        schema = Schema(cols)
-        pk_indices = [i for i, c in enumerate(cols)
-                      if c.semantic_type == SemanticType.TAG]
+        schema, pk_indices = build_schema_from_create(stmt)
         engine = self.engine_for(stmt.engine)
         table = engine.create_table(CreateTableRequest(
             table_name, schema, catalog_name=catalog,
@@ -194,25 +248,7 @@ class StatementExecutor:
                 from ..errors import ColumnNotFoundError
                 raise ColumnNotFoundError(
                     f"column {c!r} not found in {table_name!r}")
-        if stmt.select is not None:
-            out = self.query_engine.execute_query(stmt.select, ctx)
-            rows = [list(r) for b in out.batches for r in b.rows()]
-        else:
-            ev = Evaluator(pd.DataFrame(index=[0]))
-            rows = []
-            for row in stmt.rows:
-                if len(row) != len(columns):
-                    raise InvalidArgumentsError(
-                        f"insert row has {len(row)} values, expected "
-                        f"{len(columns)}")
-                vals = []
-                for e in row:
-                    v = ev.eval(e)
-                    if isinstance(v, pd.Series):
-                        v = v.iloc[0]
-                    vals.append(v)
-                rows.append(vals)
-        data = {c: [r[i] for r in rows] for i, c in enumerate(columns)}
+        data = evaluate_insert_rows(stmt, columns, self.query_engine, ctx)
         n = table.insert(data)
         return Output.rows(n)
 
@@ -221,24 +257,7 @@ class StatementExecutor:
         table = self.catalog.table(catalog, schema_name, table_name)
         if table is None:
             raise TableNotFoundError(f"table {table_name!r} not found")
-        schema = table.schema
-        tc = schema.timestamp_column
-        key_cols = schema.tag_names() + ([tc.name] if tc else [])
-        batches = table.scan_batches(projection=key_cols)
-        frames = [pd.DataFrame(b.to_pydict()) for b in batches]
-        df = pd.concat(frames, ignore_index=True) if frames else \
-            pd.DataFrame(columns=key_cols)
-        if stmt.where is not None and len(df):
-            mask = Evaluator(df).eval(stmt.where)
-            if isinstance(mask, pd.Series):
-                df = df[mask.fillna(False).astype(bool)]
-            elif not mask:
-                df = df.iloc[0:0]
-        if not len(df):
-            return Output.rows(0)
-        df = df.drop_duplicates()
-        n = table.delete({c: df[c].tolist() for c in key_cols})
-        return Output.rows(len(df))
+        return delete_matching_rows(table, stmt)
 
     # ---- session ----
     def use_database(self, stmt: ast.Use, ctx: QueryContext) -> Output:

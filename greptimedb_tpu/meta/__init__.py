@@ -1,0 +1,17 @@
+"""Meta plane: cluster metadata service, client, failure detection.
+
+Reference behavior: src/meta-srv + src/meta-client (see service.py).
+"""
+
+from .failure_detector import PhiAccrualFailureDetector
+from .kv import MemKv
+from .service import (
+    DatanodeStat, HeartbeatResponse, MetaClient, MetaSrv,
+    NoAliveDatanodeError, Peer, RegionRoute, TableRoute,
+)
+
+__all__ = [
+    "DatanodeStat", "HeartbeatResponse", "MemKv", "MetaClient", "MetaSrv",
+    "NoAliveDatanodeError", "Peer", "PhiAccrualFailureDetector",
+    "RegionRoute", "TableRoute",
+]

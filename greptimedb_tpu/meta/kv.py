@@ -1,0 +1,52 @@
+"""In-memory metadata KV store.
+
+Reference behavior: src/meta-srv/src/service/store/memory.rs — `MemStore`,
+the etcd stand-in used by every in-process distributed test (and the same
+API shape the etcd-backed store implements: range scans by prefix, CAS).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class MemKv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, bytes] = {}
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def range(self, prefix: str) -> List[Tuple[str, bytes]]:
+        with self._lock:
+            return sorted((k, v) for k, v in self._data.items()
+                          if k.startswith(prefix))
+
+    def compare_and_put(self, key: str, expect: Optional[bytes],
+                        value: bytes) -> bool:
+        """Atomic put iff the current value equals `expect` (None = absent)."""
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = value
+            return True
+
+    def incr(self, key: str, start: int = 0) -> int:
+        """Atomic counter (sequence allocation, reference sequence.rs)."""
+        with self._lock:
+            cur = int(self._data.get(key, str(start).encode()))
+            nxt = cur + 1
+            self._data[key] = str(nxt).encode()
+            return nxt

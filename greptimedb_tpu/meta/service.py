@@ -1,0 +1,243 @@
+"""Meta service: the cluster brain.
+
+Reference behavior: src/meta-srv — datanode registration + lease-tracked
+heartbeats (handler.rs:115-176), table-route creation with region placement
+via selectors (service/router.rs:86-238, selector/load_based.rs:27-80),
+table-id sequences (sequence.rs:28), phi-accrual failure detection driven
+off heartbeats (failure_detector.rs, handler/failure_handler/runner.rs),
+and route/table metadata persisted to the KV store
+(keys.rs:398, catalog/src/helper.rs:95-132).
+
+This runs in-process over MemKv (the reference's MemStore test topology,
+meta-srv/src/mocks.rs); a gRPC facade can wrap it 1:1 for multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..errors import GreptimeError
+from .failure_detector import PhiAccrualFailureDetector
+from .kv import MemKv
+
+
+@dataclass(frozen=True)
+class Peer:
+    id: int
+    addr: str = ""
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "addr": self.addr}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Peer":
+        return Peer(d["id"], d.get("addr", ""))
+
+
+@dataclass
+class RegionRoute:
+    region_number: int
+    leader: Peer
+
+    def to_dict(self) -> dict:
+        return {"region_number": self.region_number,
+                "leader": self.leader.to_dict()}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RegionRoute":
+        return RegionRoute(d["region_number"], Peer.from_dict(d["leader"]))
+
+
+@dataclass
+class TableRoute:
+    table_id: int
+    table_name: str                    # catalog.schema.table
+    region_routes: List[RegionRoute] = field(default_factory=list)
+
+    def regions_on(self, peer_id: int) -> List[int]:
+        return [r.region_number for r in self.region_routes
+                if r.leader.id == peer_id]
+
+    def peers(self) -> List[Peer]:
+        seen: Dict[int, Peer] = {}
+        for r in self.region_routes:
+            seen[r.leader.id] = r.leader
+        return [seen[i] for i in sorted(seen)]
+
+    def to_dict(self) -> dict:
+        return {"table_id": self.table_id, "table_name": self.table_name,
+                "region_routes": [r.to_dict() for r in self.region_routes]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TableRoute":
+        return TableRoute(d["table_id"], d["table_name"],
+                          [RegionRoute.from_dict(r)
+                           for r in d["region_routes"]])
+
+
+@dataclass
+class DatanodeStat:
+    region_count: int = 0
+    approximate_rows: int = 0
+
+
+@dataclass
+class HeartbeatResponse:
+    mailbox: List[dict] = field(default_factory=list)
+
+
+TABLE_ID_SEQ = "__meta/seq/table_id"
+ROUTE_PREFIX = "__meta/route/"
+PEER_PREFIX = "__meta/peer/"
+
+
+class NoAliveDatanodeError(GreptimeError):
+    status_code = "RuntimeResourcesExhausted"
+
+
+class MetaSrv:
+    """Single-leader metadata service over a KV store."""
+
+    def __init__(self, kv: Optional[MemKv] = None, *,
+                 datanode_lease_secs: float = 15.0,
+                 selector: str = "load_based",
+                 phi_threshold: float = 8.0):
+        self.kv = kv if kv is not None else MemKv()
+        self.datanode_lease_secs = datanode_lease_secs
+        self.selector = selector
+        self._stats: Dict[int, DatanodeStat] = {}
+        self._last_seen: Dict[int, float] = {}
+        self._detectors: Dict[int, PhiAccrualFailureDetector] = {}
+        self._phi_threshold = phi_threshold
+        self._mailboxes: Dict[int, List[dict]] = {}
+
+    # ---- membership ----
+    def register_datanode(self, peer: Peer) -> None:
+        self.kv.put(f"{PEER_PREFIX}{peer.id}",
+                    json.dumps(peer.to_dict()).encode())
+        self._last_seen[peer.id] = time.time()
+        self._detectors.setdefault(
+            peer.id, PhiAccrualFailureDetector(threshold=self._phi_threshold))
+
+    def peers(self) -> List[Peer]:
+        return [Peer.from_dict(json.loads(v))
+                for _, v in self.kv.range(PEER_PREFIX)]
+
+    def alive_datanodes(self, now: Optional[float] = None) -> List[Peer]:
+        now = time.time() if now is None else now
+        out = []
+        for p in self.peers():
+            seen = self._last_seen.get(p.id)
+            if seen is not None and now - seen <= self.datanode_lease_secs:
+                det = self._detectors.get(p.id)
+                if det is None or det.sample_count == 0 or \
+                        det.is_available(now * 1000.0):
+                    out.append(p)
+        return out
+
+    def failed_datanodes(self, now: Optional[float] = None) -> List[Peer]:
+        """Peers whose phi crossed the threshold (failover candidates —
+        the action itself is still TODO in the reference too)."""
+        now = time.time() if now is None else now
+        out = []
+        for p in self.peers():
+            det = self._detectors.get(p.id)
+            if det is not None and det.sample_count > 0 and \
+                    not det.is_available(now * 1000.0):
+                out.append(p)
+        return out
+
+    # ---- heartbeat ----
+    def handle_heartbeat(self, node_id: int,
+                         stat: Optional[DatanodeStat] = None,
+                         now: Optional[float] = None) -> HeartbeatResponse:
+        now = time.time() if now is None else now
+        if self.kv.get(f"{PEER_PREFIX}{node_id}") is None:
+            # first contact registers the peer (reference: heartbeats are
+            # the registration channel, keep_lease_handler.rs)
+            self.register_datanode(Peer(node_id))
+        self._last_seen[node_id] = now
+        det = self._detectors.setdefault(
+            node_id, PhiAccrualFailureDetector(threshold=self._phi_threshold))
+        det.heartbeat(now * 1000.0)
+        if stat is not None:
+            self._stats[node_id] = stat
+        msgs = self._mailboxes.pop(node_id, [])
+        return HeartbeatResponse(mailbox=msgs)
+
+    def send_mailbox(self, node_id: int, message: dict) -> None:
+        """Reverse control: meta→datanode messages ride the next heartbeat
+        response (reference handler.rs:244-302)."""
+        self._mailboxes.setdefault(node_id, []).append(message)
+
+    # ---- sequences ----
+    def allocate_table_id(self) -> int:
+        return self.kv.incr(TABLE_ID_SEQ, start=1023)
+
+    # ---- routes ----
+    def create_table_route(self, full_table_name: str,
+                           region_numbers: List[int],
+                           now: Optional[float] = None) -> TableRoute:
+        alive = self.alive_datanodes(now)
+        if not alive:
+            raise NoAliveDatanodeError("no alive datanode to place regions")
+        if self.selector == "load_based":
+            # fewest-regions-first (reference load_based.rs:27-80)
+            load = {p.id: self._stats.get(p.id, DatanodeStat()).region_count
+                    for p in alive}
+            order = sorted(alive, key=lambda p: (load[p.id], p.id))
+        else:
+            order = sorted(alive, key=lambda p: p.id)
+        table_id = self.allocate_table_id()
+        routes = [RegionRoute(rn, order[i % len(order)])
+                  for i, rn in enumerate(sorted(region_numbers))]
+        route = TableRoute(table_id, full_table_name, routes)
+        key = f"{ROUTE_PREFIX}{full_table_name}"
+        if not self.kv.compare_and_put(
+                key, None, json.dumps(route.to_dict()).encode()):
+            raise GreptimeError(f"table route exists: {full_table_name}")
+        return route
+
+    def table_route(self, full_table_name: str) -> Optional[TableRoute]:
+        raw = self.kv.get(f"{ROUTE_PREFIX}{full_table_name}")
+        if raw is None:
+            return None
+        return TableRoute.from_dict(json.loads(raw))
+
+    def delete_table_route(self, full_table_name: str) -> bool:
+        return self.kv.delete(f"{ROUTE_PREFIX}{full_table_name}")
+
+    def all_table_routes(self) -> List[TableRoute]:
+        return [TableRoute.from_dict(json.loads(v))
+                for _, v in self.kv.range(ROUTE_PREFIX)]
+
+
+class MetaClient:
+    """Client SDK facade (reference: src/meta-client). In-process it calls
+    the service directly; the wire version keeps the same surface."""
+
+    def __init__(self, srv: MetaSrv):
+        self._srv = srv
+
+    def register(self, peer: Peer) -> None:
+        self._srv.register_datanode(peer)
+
+    def heartbeat(self, node_id: int, stat: Optional[DatanodeStat] = None
+                  ) -> HeartbeatResponse:
+        return self._srv.handle_heartbeat(node_id, stat)
+
+    def create_route(self, full_name: str, region_numbers: List[int]
+                     ) -> TableRoute:
+        return self._srv.create_table_route(full_name, region_numbers)
+
+    def route(self, full_name: str) -> Optional[TableRoute]:
+        return self._srv.table_route(full_name)
+
+    def delete_route(self, full_name: str) -> bool:
+        return self._srv.delete_table_route(full_name)
+
+    def allocate_table_id(self) -> int:
+        return self._srv.allocate_table_id()

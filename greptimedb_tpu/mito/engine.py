@@ -26,6 +26,7 @@ from ..errors import (
     ColumnExistsError,
     ColumnNotFoundError,
     InvalidArgumentsError,
+    RegionNotFoundError,
     TableAlreadyExistsError,
     TableNotFoundError,
 )
@@ -124,7 +125,12 @@ class MitoTable(Table):
             else {min(self.regions): None}
         written = 0
         for rnum, idx in splits.items():
-            region = self.regions[rnum]
+            region = self.regions.get(rnum)
+            if region is None:
+                raise RegionNotFoundError(
+                    f"rows target region {rnum}, which this node does not "
+                    f"host for table {self.info.name} (distributed writes "
+                    f"must go through the frontend router)")
             if idx is None:
                 part = columns
             else:
@@ -144,7 +150,11 @@ class MitoTable(Table):
             else {min(self.regions): None}
         deleted = 0
         for rnum, idx in splits.items():
-            region = self.regions[rnum]
+            region = self.regions.get(rnum)
+            if region is None:
+                raise RegionNotFoundError(
+                    f"rows target region {rnum}, which this node does not "
+                    f"host for table {self.info.name}")
             part = key_columns if idx is None else \
                 {k: [v[i] for i in idx] for k, v in key_columns.items()}
             wb = WriteBatch(region.schema)
@@ -152,6 +162,25 @@ class MitoTable(Table):
             region.write(wb)
             deleted += num_rows if idx is None else len(idx)
         return deleted
+
+    def write_region(self, region_number: int,
+                     columns: Dict[str, Sequence],
+                     op: str = "put") -> int:
+        """Distributed write path: rows pre-split by the frontend land on
+        one specific region (reference: datanode handles per-region
+        inserts, src/datanode/src/instance/grpc.rs:124-160)."""
+        region = self.regions.get(region_number)
+        if region is None:
+            raise RegionNotFoundError(
+                f"region {region_number} not hosted for table "
+                f"{self.info.name}")
+        wb = WriteBatch(region.schema)
+        if op == "put":
+            wb.put(columns)
+        else:
+            wb.delete(columns)
+        region.write(wb)
+        return len(next(iter(columns.values()))) if columns else 0
 
     # ---- reads ----
     def scan_raw(self, projection: Optional[Sequence[str]] = None,
@@ -268,6 +297,17 @@ class MitoEngine(TableEngine):
             elif len(region_numbers) > 1:
                 raise InvalidArgumentsError(
                     "multi-region table requires a partition rule")
+            if request.assigned_region_numbers is not None:
+                # distributed: this datanode materializes (and records in
+                # its local manifest) only its assigned regions; the full
+                # set lives in the frontend's table route
+                bad = set(request.assigned_region_numbers) - \
+                    set(region_numbers)
+                if bad:
+                    raise InvalidArgumentsError(
+                        f"assigned regions {sorted(bad)} not in the "
+                        f"table's region set {region_numbers}")
+                region_numbers = list(request.assigned_region_numbers)
             schema = request.schema
             meta = TableMeta(
                 schema=schema,

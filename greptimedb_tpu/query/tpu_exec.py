@@ -576,11 +576,13 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     if plan is None:
         return None
     try:
-        frames = []
-        for region in table.regions.values():
-            part = _execute_region(region, table, plan)
-            if part is not None and len(part):
-                frames.append(part)
+        if hasattr(table, "execute_tpu_plan"):
+            # distributed: aggregate pushdown — datanodes reduce their
+            # regions, the frontend folds moment frames (_finalize)
+            frames = [f for f in table.execute_tpu_plan(plan)
+                      if f is not None and len(f)]
+        else:
+            frames = region_moment_frames(table, plan)
     except UnsupportedError:
         return None
     if not frames:
@@ -596,6 +598,17 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
         return pd.DataFrame([row])
     merged = pd.concat(frames, ignore_index=True)
     return _finalize(merged, plan)
+
+
+def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
+    """Per-region moment frames for a table's local regions (shared by the
+    single-node fast path and the datanode side of aggregate pushdown)."""
+    frames = []
+    for region in table.regions.values():
+        part = _execute_region(region, table, plan)
+        if part is not None and len(part):
+            frames.append(part)
+    return frames
 
 
 def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:

@@ -27,6 +27,9 @@ class CreateTableRequest:
     table_options: Dict[str, Any] = field(default_factory=dict)
     partitions: Optional[object] = None      # sql.ast.Partitions
     table_id: Optional[int] = None           # pre-allocated (distributed)
+    # distributed: this datanode materializes only these regions (the
+    # full region set stays in table metadata for routing/splitting)
+    assigned_region_numbers: Optional[List[int]] = None
 
 
 @dataclass

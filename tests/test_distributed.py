@@ -1,0 +1,361 @@
+"""Distributed plane tests: meta service, routes, failure detection,
+DistTable DDL/insert/query with aggregate pushdown.
+
+Mirrors the reference's in-process multi-node topology
+(`MockDistributedInstance`: frontend + N datanode instances + meta over a
+MemStore — src/frontend/src/tests.rs:60,264-330, meta-srv/src/mocks.rs)
+and the phi-detector statistics tests (failure_detector.rs:180-546).
+"""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.client import LocalDatanodeClient
+from greptimedb_tpu.datanode import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.frontend.distributed import DistInstance, DistTable
+from greptimedb_tpu.meta import (
+    DatanodeStat, MemKv, MetaClient, MetaSrv, NoAliveDatanodeError, Peer,
+    PhiAccrualFailureDetector)
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.sql import parse_sql
+
+
+# ---------------------------------------------------------------------------
+# failure detector (reference failure_detector.rs tests)
+# ---------------------------------------------------------------------------
+
+class TestPhiDetector:
+    def test_regular_heartbeats_low_phi(self):
+        d = PhiAccrualFailureDetector()
+        t = 0.0
+        for _ in range(50):
+            d.heartbeat(t)
+            t += 1000.0
+        assert d.phi(t + 500) < 1.0
+        assert d.is_available(t + 1000)
+
+    def test_phi_grows_with_silence(self):
+        rng = np.random.default_rng(1)
+        d = PhiAccrualFailureDetector(acceptable_heartbeat_pause_ms=0.0)
+        t = 0.0
+        for _ in range(50):
+            d.heartbeat(t)
+            t += float(rng.normal(1000.0, 300.0))
+        p1 = d.phi(t + 1500)
+        p2 = d.phi(t + 2500)
+        p3 = d.phi(t + 4000)
+        assert p1 < p2 < p3
+        assert not d.is_available(t + 60000)
+
+    def test_irregular_interval_tolerance(self):
+        rng = np.random.default_rng(3)
+        d = PhiAccrualFailureDetector()
+        t = 0.0
+        for _ in range(200):
+            d.heartbeat(t)
+            t += float(rng.normal(1000.0, 200.0))
+        # a pause within the acceptable envelope stays available
+        assert d.is_available(t + 3000)
+
+    def test_no_heartbeat_yet(self):
+        d = PhiAccrualFailureDetector()
+        assert d.phi(12345.0) == 0.0
+        assert d.is_available(12345.0)
+
+
+# ---------------------------------------------------------------------------
+# meta service
+# ---------------------------------------------------------------------------
+
+class TestMetaSrv:
+    def test_register_and_lease(self):
+        srv = MetaSrv(datanode_lease_secs=10)
+        srv.register_datanode(Peer(1, "dn1"))
+        srv.register_datanode(Peer(2, "dn2"))
+        now = time.time()
+        assert {p.id for p in srv.alive_datanodes(now)} == {1, 2}
+        # lease expiry
+        assert srv.alive_datanodes(now + 100) == []
+
+    def test_route_placement_load_based(self):
+        srv = MetaSrv(selector="load_based")
+        for i in (1, 2):
+            srv.register_datanode(Peer(i))
+            srv.handle_heartbeat(i)
+        srv.handle_heartbeat(1, DatanodeStat(region_count=5))
+        srv.handle_heartbeat(2, DatanodeStat(region_count=0))
+        route = srv.create_table_route("c.s.t", [0, 1, 2])
+        # node 2 (least loaded) gets the first region
+        assert route.region_routes[0].leader.id == 2
+        assert len(route.region_routes) == 3
+        # spread across both nodes round-robin
+        assert {r.leader.id for r in route.region_routes} == {1, 2}
+
+    def test_route_persistence_and_duplicate(self):
+        kv = MemKv()
+        srv = MetaSrv(kv)
+        srv.register_datanode(Peer(1))
+        srv.handle_heartbeat(1)
+        route = srv.create_table_route("c.s.t", [0])
+        assert srv.table_route("c.s.t").table_id == route.table_id
+        with pytest.raises(Exception):
+            srv.create_table_route("c.s.t", [0])
+        assert srv.delete_table_route("c.s.t")
+        assert srv.table_route("c.s.t") is None
+
+    def test_no_alive_datanodes(self):
+        srv = MetaSrv()
+        with pytest.raises(NoAliveDatanodeError):
+            srv.create_table_route("c.s.t", [0])
+
+    def test_table_id_sequence(self):
+        srv = MetaSrv()
+        a = srv.allocate_table_id()
+        b = srv.allocate_table_id()
+        assert b == a + 1 and a >= 1024
+
+    def test_mailbox_rides_heartbeat(self):
+        srv = MetaSrv()
+        srv.register_datanode(Peer(1))
+        srv.send_mailbox(1, {"type": "flush_table", "t": "x"})
+        resp = srv.handle_heartbeat(1)
+        assert resp.mailbox == [{"type": "flush_table", "t": "x"}]
+        assert srv.handle_heartbeat(1).mailbox == []
+
+    def test_failed_datanode_detection(self):
+        srv = MetaSrv(phi_threshold=8.0)
+        srv.register_datanode(Peer(1))
+        t = time.time()
+        for i in range(30):
+            srv.handle_heartbeat(1, now=t + i)
+        # an hour of silence → suspected
+        assert [p.id for p in srv.failed_datanodes(t + 3600)] == [1]
+        assert srv.alive_datanodes(t + 3600) == []
+
+
+# ---------------------------------------------------------------------------
+# distributed DDL / insert / query
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """Frontend + 2 in-process datanodes + meta over MemKv."""
+    datanodes = {}
+    clients = {}
+    for i in (1, 2):
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / f"dn{i}"), node_id=i,
+            register_numbers_table=False))
+        dn.start()
+        datanodes[i] = dn
+        clients[i] = LocalDatanodeClient(dn)
+    srv = MetaSrv(MemKv())
+    meta = MetaClient(srv)
+    for i, dn in datanodes.items():
+        srv.register_datanode(Peer(i, f"dn{i}"))
+        dn.start_heartbeat(meta, interval_s=3600)   # one immediate beat
+    fe = DistInstance(meta, clients)
+    yield fe, datanodes, srv
+    for dn in datanodes.values():
+        dn.shutdown()
+
+
+DDL = """
+CREATE TABLE dist (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE,
+                   PRIMARY KEY(host))
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h5'),
+  PARTITION r1 VALUES LESS THAN (MAXVALUE))
+"""
+
+
+class TestDistributedDDL:
+    def test_create_places_regions_on_both_nodes(self, cluster):
+        fe, datanodes, srv = cluster
+        fe.do_query(DDL)
+        route = srv.table_route("greptime.public.dist")
+        assert route is not None
+        owners = {r.leader.id for r in route.region_routes}
+        assert owners == {1, 2}
+        # each datanode hosts exactly its assigned region
+        for i, dn in datanodes.items():
+            t = dn.catalog.table("greptime", "public", "dist")
+            assert t is not None
+            assert set(t.regions) == set(route.regions_on(i))
+
+    def test_drop_removes_everywhere(self, cluster):
+        fe, datanodes, srv = cluster
+        fe.do_query(DDL)
+        fe.do_query("DROP TABLE dist")
+        assert srv.table_route("greptime.public.dist") is None
+        for dn in datanodes.values():
+            assert dn.catalog.table("greptime", "public", "dist") is None
+
+    def test_create_failure_rolls_back_route(self, cluster):
+        fe, datanodes, srv = cluster
+        # sabotage one datanode's DDL
+        bad = fe.clients[2]
+        orig = bad.ddl_create_table
+        bad.ddl_create_table = lambda req: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        with pytest.raises(RuntimeError):
+            fe.do_query(DDL)
+        assert srv.table_route("greptime.public.dist") is None
+        bad.ddl_create_table = orig
+        fe.do_query(DDL)          # now succeeds
+
+
+class TestDistributedData:
+    def _seed(self, fe, n_hosts=8, rows_per=20):
+        fe.do_query(DDL)
+        vals = []
+        for h in range(n_hosts):
+            for i in range(rows_per):
+                vals.append(f"('h{h}', {i * 1000}, {float(h * 100 + i)})")
+        fe.do_query("INSERT INTO dist VALUES " + ",".join(vals))
+
+    def test_insert_splits_by_rule(self, cluster):
+        fe, datanodes, srv = cluster
+        self._seed(fe)
+        route = srv.table_route("greptime.public.dist")
+        # region 0: hosts h0..h4, region 1: h5..h7 — on their owners
+        counts = {}
+        for i, dn in datanodes.items():
+            t = dn.catalog.table("greptime", "public", "dist")
+            for rn, region in t.regions.items():
+                data = region.snapshot().read_merged()
+                counts[rn] = data.num_rows
+        assert counts[0] == 5 * 20 and counts[1] == 3 * 20
+
+    def test_aggregate_pushdown_query(self, cluster):
+        fe, datanodes, srv = cluster
+        self._seed(fe)
+        out = fe.do_query("SELECT host, avg(cpu) AS a, count(*) AS c "
+                          "FROM dist GROUP BY host ORDER BY host")[-1]
+        rows = out.batches[0].to_pylist()
+        assert len(rows) == 8
+        for h, r in enumerate(rows):
+            assert r["host"] == f"h{h}" and r["c"] == 20
+            assert math.isclose(r["a"], h * 100 + 9.5, rel_tol=1e-6)
+
+    def test_pushdown_goes_through_clients(self, cluster):
+        fe, datanodes, srv = cluster
+        self._seed(fe)
+        calls = []
+        for c in fe.clients.values():
+            orig = c.region_moments
+            c.region_moments = (lambda *a, _o=orig: (calls.append(1),
+                                                     _o(*a))[1])
+        out = fe.do_query("SELECT count(*) AS c FROM dist")[-1]
+        assert out.batches[0].to_pylist()[0]["c"] == 160
+        assert len(calls) == 2, "pushdown did not fan out to both clients"
+
+    def test_cross_region_first_last(self, cluster):
+        fe, *_ = cluster
+        fe.do_query(DDL)
+        fe.do_query("INSERT INTO dist VALUES ('h1', 100, 111.0), "
+                    "('h9', 50, 999.0), ('h9', 300, 7.0)")
+        out = fe.do_query("SELECT first(cpu) AS f, last(cpu) AS l "
+                          "FROM dist")[-1]
+        row = out.batches[0].to_pylist()[0]
+        assert row["f"] == 999.0 and row["l"] == 7.0
+
+    def test_fallback_scan_path(self, cluster):
+        fe, *_ = cluster
+        self._seed(fe)
+        out = fe.do_query("SELECT host, ts, cpu FROM dist "
+                          "WHERE host = 'h6' ORDER BY ts LIMIT 3")[-1]
+        rows = out.batches[0].to_pylist()
+        assert [r["cpu"] for r in rows] == [600.0, 601.0, 602.0]
+
+    def test_delete_routes_to_owner(self, cluster):
+        fe, *_ = cluster
+        fe.do_query(DDL)
+        fe.do_query("INSERT INTO dist VALUES ('h1', 100, 1.0), "
+                    "('h7', 100, 2.0)")
+        fe.do_query("DELETE FROM dist WHERE host = 'h7'")
+        out = fe.do_query("SELECT count(*) AS c FROM dist")[-1]
+        assert out.batches[0].to_pylist()[0]["c"] == 1
+
+    def test_promql_over_dist_table(self, cluster):
+        fe, *_ = cluster
+        fe.do_query(DDL)
+        vals = []
+        for h in ("h1", "h8"):
+            for i in range(30):
+                vals.append(f"('{h}', {i * 10_000}, {i * 2.0})")
+        fe.do_query("INSERT INTO dist VALUES " + ",".join(vals))
+        from greptimedb_tpu.promql.engine import PromqlEngine
+        eng = PromqlEngine(fe.catalog)
+        out = eng.query_to_prom_json("rate(dist[1m])", 120_000, 240_000,
+                                     60_000, QueryContext())
+        by_host = {r["metric"]["host"]: r for r in out["result"]}
+        assert set(by_host) == {"h1", "h8"}
+        for r in by_host.values():
+            for _, v in r["values"]:
+                assert abs(float(v) - 0.2) < 1e-6
+
+    def test_restart_datanode_recovers_regions(self, cluster, tmp_path):
+        fe, datanodes, srv = cluster
+        self._seed(fe)
+        dn1 = datanodes[1]
+        dn1.shutdown()
+        dn1b = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "dn1"), node_id=1,
+            register_numbers_table=False))
+        dn1b.start()
+        datanodes[1] = dn1b
+        fe.clients[1].datanode = dn1b
+        out = fe.do_query("SELECT count(*) AS c FROM dist")[-1]
+        assert out.batches[0].to_pylist()[0]["c"] == 160
+
+
+class TestReviewRegressions:
+    def test_if_not_exists_reattaches_after_frontend_restart(self, cluster):
+        fe, datanodes, srv = cluster
+        fe.do_query(DDL)
+        fe.do_query("INSERT INTO dist VALUES ('h1', 1, 1.0)")
+        # a fresh frontend (lost catalog) over the same meta + datanodes
+        fe2 = DistInstance(fe.meta, fe.clients)
+        fe2.do_query(DDL.replace("CREATE TABLE dist",
+                                 "CREATE TABLE IF NOT EXISTS dist"))
+        out = fe2.do_query("SELECT count(*) AS c FROM dist")[-1]
+        assert out.batches[0].to_pylist()[0]["c"] == 1
+        # plain CREATE still errors
+        with pytest.raises(Exception):
+            fe2.do_query(DDL)
+
+    def test_insert_resolves_via_route_after_restart(self, cluster):
+        fe, *_ = cluster
+        fe.do_query(DDL)
+        fe2 = DistInstance(fe.meta, fe.clients)
+        fe2.do_query("INSERT INTO dist VALUES ('h1', 1, 1.0)")
+        out = fe2.do_query("SELECT count(*) AS c FROM dist")[-1]
+        assert out.batches[0].to_pylist()[0]["c"] == 1
+
+    def test_drop_if_exists_noop(self, cluster):
+        fe, *_ = cluster
+        fe.do_query("DROP TABLE IF EXISTS nope")    # must not raise
+
+    def test_datanode_local_insert_rejects_foreign_region(self, cluster):
+        from greptimedb_tpu.errors import RegionNotFoundError
+        fe, datanodes, srv = cluster
+        fe.do_query(DDL)
+        route = srv.table_route("greptime.public.dist")
+        # find a host value owned by the OTHER node for each datanode
+        for i, dn in datanodes.items():
+            t = dn.catalog.table("greptime", "public", "dist")
+            foreign = [rr.region_number for rr in route.region_routes
+                       if rr.leader.id != i]
+            host = "h0" if 0 in foreign else "h9"
+            with pytest.raises(RegionNotFoundError):
+                t.insert({"host": [host], "ts": [1], "cpu": [1.0]})
+
+    def test_heartbeat_registers_unknown_peer(self):
+        srv = MetaSrv()
+        srv.handle_heartbeat(7)
+        assert [p.id for p in srv.peers()] == [7]
+        assert [p.id for p in srv.alive_datanodes()] == [7]
