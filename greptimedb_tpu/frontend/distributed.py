@@ -301,7 +301,25 @@ class DistInstance:
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt, ctx)
+        self._hydrate_query_tables(stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
+
+    def _hydrate_query_tables(self, stmt, ctx: QueryContext) -> None:
+        """A fresh frontend has an empty local catalog; before planning a
+        query, rebuild DistTables for every referenced table from the meta
+        routes (reference: FrontendCatalogManager resolves through the
+        meta KV on demand, src/frontend/src/catalog.rs)."""
+        def walk(node):
+            if isinstance(node, ast.Query):
+                for ref in [node.from_] + [j.table for j in node.joins]:
+                    if ref is None:
+                        continue
+                    if ref.subquery is not None:
+                        walk(ref.subquery)
+                    elif ref.name is not None:
+                        catalog, schema_name, name = ctx.resolve(ref.name)
+                        self._resolve_table(catalog, schema_name, name)
+        walk(stmt)
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):
         from ..query.output import Output

@@ -1,0 +1,268 @@
+"""Arrow Flight data plane: router↔worker and client↔server transport.
+
+Reference behavior: src/servers/src/grpc/flight.rs:40-120 — the gRPC
+service exposes Arrow Flight `do_get` carrying an encoded request ticket
+and streams record batches back; src/client/src/database.rs:209-260 is the
+matching client. Here the same plane is built directly on
+`pyarrow.flight` (Flight *is* gRPC + Arrow IPC):
+
+- `FlightDatanodeServer` wraps a `DatanodeInstance` and exposes the
+  `DatanodeClient` surface over the wire: DDL actions, `do_put` region
+  writes, `do_get` scans / pushed-down aggregate moments. This is the
+  multi-host version of the in-process router↔worker calls
+  (client/__init__.py).
+- `FlightFrontendServer` wraps a frontend (standalone or distributed) and
+  serves user SQL over `do_get` + gRPC-style row inserts with
+  auto-create/alter over `do_put` (reference:
+  src/frontend/src/instance.rs:292-342).
+
+Tickets, descriptors and action bodies are JSON; data rides Arrow IPC.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from ..datatypes.record_batch import RecordBatch
+from ..datatypes.schema import Schema
+from ..errors import GreptimeError
+from ..table.requests import CreateTableRequest
+from ..sql.ast import PartitionEntry, Partitions
+
+_EMPTY_SCHEMA = pa.schema([])
+
+
+# ---------------------------------------------------------------------------
+# request codecs (JSON-safe)
+# ---------------------------------------------------------------------------
+
+def create_request_to_dict(req: CreateTableRequest) -> dict:
+    parts = None
+    if req.partitions is not None:
+        parts = {"columns": list(req.partitions.columns),
+                 "entries": [{"name": e.name, "values": list(e.values)}
+                             for e in req.partitions.entries]}
+    return {
+        "table_name": req.table_name,
+        "schema": req.schema.to_dict(),
+        "catalog_name": req.catalog_name,
+        "schema_name": req.schema_name,
+        "desc": req.desc,
+        "primary_key_indices": list(req.primary_key_indices),
+        "create_if_not_exists": req.create_if_not_exists,
+        "region_numbers": list(req.region_numbers),
+        "table_options": dict(req.table_options),
+        "partitions": parts,
+        "table_id": req.table_id,
+        "assigned_region_numbers": req.assigned_region_numbers,
+    }
+
+
+def create_request_from_dict(d: dict) -> CreateTableRequest:
+    parts = None
+    if d.get("partitions") is not None:
+        p = d["partitions"]
+        parts = Partitions(
+            columns=list(p["columns"]),
+            entries=[PartitionEntry(e["name"], list(e["values"]))
+                     for e in p["entries"]])
+    return CreateTableRequest(
+        table_name=d["table_name"],
+        schema=Schema.from_dict(d["schema"]),
+        catalog_name=d["catalog_name"],
+        schema_name=d["schema_name"],
+        desc=d.get("desc"),
+        primary_key_indices=list(d["primary_key_indices"]),
+        create_if_not_exists=d["create_if_not_exists"],
+        region_numbers=list(d["region_numbers"]),
+        table_options=dict(d["table_options"]),
+        partitions=parts,
+        table_id=d.get("table_id"),
+        assigned_region_numbers=d.get("assigned_region_numbers"),
+    )
+
+
+def _arrow_to_columns(table: pa.Table) -> Dict[str, list]:
+    return {name: table.column(i).to_pylist()
+            for i, name in enumerate(table.schema.names)}
+
+
+def _frames_stream(frames) -> flight.GeneratorStream:
+    """One moment frame = one IPC batch, so per-region frame boundaries
+    survive the wire and the frontend fold sees the same units as the
+    in-process path."""
+    if not frames:
+        return flight.GeneratorStream(_EMPTY_SCHEMA, iter(()))
+    schema0 = pa.Schema.from_pandas(frames[0], preserve_index=False)
+
+    def gen():
+        for f in frames:
+            t = pa.Table.from_pandas(f, schema=schema0,
+                                     preserve_index=False)
+            yield t.combine_chunks().to_batches(
+                max_chunksize=max(1, len(f)))[0]
+    return flight.GeneratorStream(schema0, gen())
+
+
+def _batches_stream(batches, fallback_schema: Optional[Schema] = None
+                    ) -> flight.GeneratorStream:
+    if not batches:
+        schema = fallback_schema.to_arrow() if fallback_schema is not None \
+            else _EMPTY_SCHEMA
+        return flight.GeneratorStream(schema, iter(()))
+    schema = batches[0].schema.to_arrow()
+    return flight.GeneratorStream(
+        schema, (b.to_arrow() for b in batches))
+
+
+_AFFECTED_SCHEMA = pa.schema([("affected_rows", pa.int64())])
+
+
+def _affected_stream(n: int) -> flight.GeneratorStream:
+    batch = pa.RecordBatch.from_arrays([pa.array([n], pa.int64())],
+                                       schema=_AFFECTED_SCHEMA)
+    return flight.GeneratorStream(_AFFECTED_SCHEMA, iter([batch]))
+
+
+# ---------------------------------------------------------------------------
+# datanode server (worker side of the distributed data plane)
+# ---------------------------------------------------------------------------
+
+class FlightDatanodeServer(flight.FlightServerBase):
+    """Serves one datanode's region data plane over Arrow Flight."""
+
+    def __init__(self, datanode, location: str = "grpc://127.0.0.1:0"):
+        super().__init__(location)
+        from ..client import LocalDatanodeClient
+        self.datanode = datanode
+        self.local = LocalDatanodeClient(datanode)
+        self._location = location
+
+    @property
+    def address(self) -> str:
+        return f"grpc://127.0.0.1:{self.port}"
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True,
+                             name=f"flight-dn{self.datanode.opts.node_id}")
+        t.start()
+        return t
+
+    # ---- control plane: DDL / flush / describe ----
+    def do_action(self, context, action):
+        body = json.loads(action.body.to_pybytes() or b"{}")
+        kind = action.type
+        try:
+            if kind == "ddl_create_table":
+                self.local.ddl_create_table(
+                    create_request_from_dict(body["request"]))
+                resp = {"ok": True}
+            elif kind == "ddl_drop_table":
+                ok = self.local.ddl_drop_table(
+                    body["catalog"], body["schema"], body["table"])
+                resp = {"ok": bool(ok)}
+            elif kind == "flush_table":
+                self.local.flush_table(body["catalog"], body["schema"],
+                                       body["table"])
+                resp = {"ok": True}
+            elif kind == "describe_table":
+                described = self.local.describe_table(
+                    body["catalog"], body["schema"], body["table"])
+                if described is None:
+                    resp = {"ok": True, "info": None}
+                else:
+                    info, _rule = described
+                    resp = {"ok": True, "info": info.to_dict()}
+            elif kind == "ping":
+                resp = {"ok": True, "node_id": self.datanode.opts.node_id}
+            else:
+                raise GreptimeError(f"unknown action {kind!r}")
+        except GreptimeError as e:
+            resp = {"ok": False, "error": str(e),
+                    "error_type": type(e).__name__}
+        yield flight.Result(json.dumps(resp).encode())
+
+    # ---- write plane ----
+    def do_put(self, context, descriptor, reader, writer):
+        cmd = json.loads(descriptor.command)
+        if cmd.get("type") != "write_region":
+            raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
+        columns = _arrow_to_columns(reader.read_all())
+        n = self.local.write_region(
+            cmd["catalog"], cmd["schema"], cmd["table"],
+            cmd["region_number"], columns, op=cmd.get("op", "put"))
+        writer.write(pa.py_buffer(
+            json.dumps({"affected_rows": n}).encode()))
+
+    # ---- read plane ----
+    def do_get(self, context, ticket):
+        cmd = json.loads(ticket.ticket)
+        kind = cmd.get("type")
+        if kind == "scan":
+            batches = self.local.scan_batches(
+                cmd["catalog"], cmd["schema"], cmd["table"],
+                projection=cmd.get("projection"),
+                time_range=tuple(cmd["time_range"])
+                if cmd.get("time_range") else None)
+            t = self.datanode.catalog.table(
+                cmd["catalog"], cmd["schema"], cmd["table"])
+            fallback = None
+            if t is not None:
+                fallback = t.schema if cmd.get("projection") is None \
+                    else t.schema.project(cmd["projection"])
+            return _batches_stream(batches, fallback)
+        if kind == "region_moments":
+            from ..query.plan_codec import plan_from_dict
+            frames = self.local.region_moments(
+                cmd["catalog"], cmd["schema"], cmd["table"],
+                plan_from_dict(cmd["plan"]))
+            return _frames_stream(frames)
+        raise GreptimeError(f"unsupported ticket {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# frontend server (user-facing SQL-over-Flight, the reference's
+# GreptimeService + FlightService pair)
+# ---------------------------------------------------------------------------
+
+class FlightFrontendServer(flight.FlightServerBase):
+    def __init__(self, frontend, location: str = "grpc://127.0.0.1:0"):
+        super().__init__(location)
+        self.frontend = frontend
+
+    @property
+    def address(self) -> str:
+        return f"grpc://127.0.0.1:{self.port}"
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True,
+                             name="flight-frontend")
+        t.start()
+        return t
+
+    def do_get(self, context, ticket):
+        cmd = json.loads(ticket.ticket)
+        if cmd.get("type") != "sql":
+            raise GreptimeError(f"unsupported ticket {cmd.get('type')!r}")
+        outputs = self.frontend.do_query(cmd["sql"])
+        last = outputs[-1]
+        if last.is_batches:
+            return _batches_stream(last.batches)
+        return _affected_stream(last.affected_rows or 0)
+
+    def do_put(self, context, descriptor, reader, writer):
+        cmd = json.loads(descriptor.command)
+        if cmd.get("type") != "row_insert":
+            raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
+        columns = _arrow_to_columns(reader.read_all())
+        n = self.frontend.handle_row_insert(
+            cmd["table"], columns,
+            tag_columns=cmd.get("tag_columns", ()),
+            timestamp_column=cmd.get("timestamp_column", "greptime_timestamp"))
+        writer.write(pa.py_buffer(
+            json.dumps({"affected_rows": n}).encode()))
