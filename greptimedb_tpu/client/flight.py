@@ -57,9 +57,12 @@ class _FlightBase:
             self._conn = None
 
     def _action(self, kind: str, body: dict) -> dict:
-        results = list(self.conn.do_action(
-            flight.Action(kind, json.dumps(body).encode())))
-        resp = json.loads(results[0].body.to_pybytes())
+        try:
+            results = list(self.conn.do_action(
+                flight.Action(kind, json.dumps(body).encode())))
+            resp = json.loads(results[0].body.to_pybytes())
+        except flight.FlightError as e:
+            raise _to_greptime_error(e) from None
         if not resp.get("ok", False):
             err = resp.get("error", "unknown flight error")
             if resp.get("error_type") == "TableNotFoundError":
@@ -97,7 +100,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
 
     def ddl_drop_table(self, catalog: str, schema: str, name: str) -> bool:
         return bool(self._action("ddl_drop_table", {
-            "catalog": catalog, "schema": schema, "table": name})["ok"])
+            "catalog": catalog, "schema": schema, "table": name})["dropped"])
 
     def write_region(self, catalog: str, schema: str, table: str,
                      region_number: int, columns: Dict[str, Sequence],
@@ -181,7 +184,8 @@ class Database(_FlightBase):
             table = reader.read_all()
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
-        if table.schema.names == ["affected_rows"]:
+        meta = table.schema.metadata or {}
+        if meta.get(b"gdb.kind") == b"affected_rows":
             return int(table.column(0)[0].as_py()) if table.num_rows else 0
         return [RecordBatch.from_arrow(b)
                 for b in table.combine_chunks().to_batches()]

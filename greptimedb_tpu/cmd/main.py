@@ -70,25 +70,24 @@ def build_servers(opts: StandaloneOptions):
     provider = NoopUserProvider()
     if opts.user_provider:
         provider = StaticUserProvider.from_option(opts.user_provider)
+    def split_addr(addr):
+        host, _, port = addr.partition(":")
+        return host or "127.0.0.1", int(port or 0)
+
     servers = [HttpServer(fe, provider, opts.http_addr)]
     if opts.enable_mysql:
-        try:
-            from ..servers.mysql import MysqlServer
-            servers.append(MysqlServer(fe, provider, opts.mysql_addr))
-        except ImportError:
-            pass
+        from ..servers.mysql import MysqlServer
+        host, port = split_addr(opts.mysql_addr)
+        servers.append(MysqlServer(fe, host=host, port=port,
+                                   user_provider=provider))
     if opts.enable_postgres:
-        try:
-            from ..servers.postgres import PostgresServer
-            servers.append(PostgresServer(fe, provider, opts.postgres_addr))
-        except ImportError:
-            pass
+        from ..servers.postgres import PostgresServer
+        host, port = split_addr(opts.postgres_addr)
+        servers.append(PostgresServer(fe, host=host, port=port,
+                                      user_provider=provider))
     if opts.enable_grpc:
-        try:
-            from ..servers.grpc import GrpcServer
-            servers.append(GrpcServer(fe, provider, opts.grpc_addr))
-        except ImportError:
-            pass
+        from ..servers.grpc import GrpcServer
+        servers.append(GrpcServer(fe, provider, opts.grpc_addr))
     return fe, servers
 
 
@@ -146,9 +145,13 @@ def main(argv=None) -> int:
 
 
 def _cli_attach(args) -> None:
-    """Interactive SQL REPL over the gRPC client."""
-    from ..client import Database
-    db = Database(args.grpc_addr)
+    """Interactive SQL REPL over the Flight/gRPC client."""
+    from ..client.flight import Database
+    from ..datatypes.record_batch import pretty_print
+    addr = args.grpc_addr
+    if "://" not in addr:
+        addr = f"grpc://{addr}"
+    db = Database(addr)
     print("greptimedb_tpu REPL — end statements with ';', \\q to quit")
     buf = []
     while True:
@@ -164,8 +167,11 @@ def _cli_attach(args) -> None:
             buf = []
             try:
                 out = db.sql(sql)
-                print(out.pretty())
-            except Exception as e:
+                if isinstance(out, int):
+                    print(f"Affected Rows: {out}")
+                else:
+                    print(pretty_print(out))
+            except Exception as e:  # noqa: BLE001
                 print(f"error: {e}")
 
 

@@ -16,6 +16,7 @@ reference ships only projection/filter/limit scans (table.rs:109-156).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import pandas as pd
@@ -138,6 +139,34 @@ class DistTable(Table):
                                self.info.schema_name, self.info.name)
 
 
+class _RouteHydratingCatalog(MemoryCatalogManager):
+    """Frontend catalog that falls back to the meta routes on a miss
+    (reference: FrontendCatalogManager resolves through the meta KV on
+    demand, src/frontend/src/catalog.rs). Hydration happens at table-
+    resolution depth, so every statement shape — SELECT, INSERT..SELECT,
+    TQL, DESCRIBE — sees remote tables on a fresh frontend."""
+
+    def __init__(self, instance: "DistInstance"):
+        super().__init__()
+        self._instance = instance
+        self._miss_guard = threading.local()
+
+    def table(self, catalog: str, schema: str, name: str):
+        t = super().table(catalog, schema, name)
+        if t is not None or getattr(self._miss_guard, "busy", False):
+            return t
+        self._miss_guard.busy = True
+        try:
+            route = self._instance.meta.route(
+                f"{catalog}.{schema}.{name}")
+            if route is None:
+                return None
+            return self._instance._hydrate_table(route, catalog, schema,
+                                                 name)
+        finally:
+            self._miss_guard.busy = False
+
+
 class DistInstance:
     """Distributed frontend instance (reference DistInstance).
 
@@ -148,7 +177,7 @@ class DistInstance:
                  clients: Dict[int, DatanodeClient]):
         self.meta = meta
         self.clients = clients
-        self.catalog = MemoryCatalogManager()
+        self.catalog = _RouteHydratingCatalog(self)
         self.query_engine = QueryEngine(self.catalog)
 
     # ---- DDL ----
@@ -301,25 +330,7 @@ class DistInstance:
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt, ctx)
-        self._hydrate_query_tables(stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
-
-    def _hydrate_query_tables(self, stmt, ctx: QueryContext) -> None:
-        """A fresh frontend has an empty local catalog; before planning a
-        query, rebuild DistTables for every referenced table from the meta
-        routes (reference: FrontendCatalogManager resolves through the
-        meta KV on demand, src/frontend/src/catalog.rs)."""
-        def walk(node):
-            if isinstance(node, ast.Query):
-                for ref in [node.from_] + [j.table for j in node.joins]:
-                    if ref is None:
-                        continue
-                    if ref.subquery is not None:
-                        walk(ref.subquery)
-                    elif ref.name is not None:
-                        catalog, schema_name, name = ctx.resolve(ref.name)
-                        self._resolve_table(catalog, schema_name, name)
-        walk(stmt)
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):
         from ..query.output import Output

@@ -15,8 +15,16 @@ from ..errors import AuthError
 
 
 class UserProvider:
+    #: wire protocols ask the client for credentials only when true
+    requires_password = True
+
     def authenticate(self, username: str, password: str) -> bool:
         raise NotImplementedError
+
+    def plain_password(self, username: str) -> Optional[str]:
+        """Plaintext lookup for challenge-response schemes
+        (mysql_native_password / postgres md5); None = unknown user."""
+        return None
 
     def auth_http_basic(self, header: Optional[str]) -> str:
         """Validate an Authorization: Basic header; returns the username."""
@@ -66,8 +74,13 @@ class StaticUserProvider(UserProvider):
             return False
         return hmac.compare_digest(expected.encode(), password.encode())
 
+    def plain_password(self, username: str) -> Optional[str]:
+        return self.users.get(username)
+
 
 class NoopUserProvider(UserProvider):
+    requires_password = False
+
     def authenticate(self, username: str, password: str) -> bool:
         return True
 

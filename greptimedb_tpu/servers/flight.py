@@ -37,6 +37,16 @@ from ..sql.ast import PartitionEntry, Partitions
 _EMPTY_SCHEMA = pa.schema([])
 
 
+def _advertised_address(location: str, port: int) -> str:
+    """Dialable address for peers: the bound host with the real port
+    (port 0 in the location means OS-assigned)."""
+    host = location.split("://", 1)[-1].rsplit(":", 1)[0] or "127.0.0.1"
+    if host == "0.0.0.0":
+        import socket
+        host = socket.gethostbyname(socket.gethostname())
+    return f"grpc://{host}:{port}"
+
+
 # ---------------------------------------------------------------------------
 # request codecs (JSON-safe)
 # ---------------------------------------------------------------------------
@@ -120,7 +130,8 @@ def _batches_stream(batches, fallback_schema: Optional[Schema] = None
         schema, (b.to_arrow() for b in batches))
 
 
-_AFFECTED_SCHEMA = pa.schema([("affected_rows", pa.int64())])
+_AFFECTED_SCHEMA = pa.schema([("affected_rows", pa.int64())],
+                             metadata={b"gdb.kind": b"affected_rows"})
 
 
 def _affected_stream(n: int) -> flight.GeneratorStream:
@@ -145,7 +156,7 @@ class FlightDatanodeServer(flight.FlightServerBase):
 
     @property
     def address(self) -> str:
-        return f"grpc://127.0.0.1:{self.port}"
+        return _advertised_address(self._location, self.port)
 
     def serve_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve, daemon=True,
@@ -163,9 +174,9 @@ class FlightDatanodeServer(flight.FlightServerBase):
                     create_request_from_dict(body["request"]))
                 resp = {"ok": True}
             elif kind == "ddl_drop_table":
-                ok = self.local.ddl_drop_table(
+                dropped = self.local.ddl_drop_table(
                     body["catalog"], body["schema"], body["table"])
-                resp = {"ok": bool(ok)}
+                resp = {"ok": True, "dropped": bool(dropped)}
             elif kind == "flush_table":
                 self.local.flush_table(body["catalog"], body["schema"],
                                        body["table"])
@@ -234,10 +245,11 @@ class FlightFrontendServer(flight.FlightServerBase):
     def __init__(self, frontend, location: str = "grpc://127.0.0.1:0"):
         super().__init__(location)
         self.frontend = frontend
+        self._location = location
 
     @property
     def address(self) -> str:
-        return f"grpc://127.0.0.1:{self.port}"
+        return _advertised_address(self._location, self.port)
 
     def serve_in_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve, daemon=True,

@@ -1,0 +1,457 @@
+"""PostgreSQL wire-protocol (v3) server.
+
+Reference behavior: src/servers/src/postgres/ — pgwire-based startup/auth
+handling (auth_handler.rs:250) and simple + extended query support
+(handler.rs:648). Implemented directly on the v3 message format: startup /
+SSLRequest negotiation, cleartext-password auth against the shared
+`UserProvider`, simple query ('Q'), and the extended Parse/Bind/Describe/
+Execute/Sync flow with text-format parameters. Every SQL string funnels
+into the same frontend `do_query` as the other protocols.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import ssl as ssl_mod
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import GreptimeError
+from ..session import Channel, QueryContext
+
+logger = logging.getLogger(__name__)
+
+PROTOCOL_V3 = 196608
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+OID_BOOL, OID_INT8, OID_TEXT, OID_FLOAT8, OID_TIMESTAMP = 16, 20, 25, 701, 1114
+
+
+def _pg_oid(dtype) -> int:
+    if dtype.is_timestamp:
+        return OID_TIMESTAMP
+    if dtype.is_string:
+        return OID_TEXT
+    if dtype.is_float:
+        return OID_FLOAT8
+    if dtype.is_boolean:
+        return OID_BOOL
+    return OID_INT8
+
+
+def _pg_text(v, dtype) -> Optional[bytes]:
+    if v is None:
+        return None
+    if dtype is not None and dtype.is_timestamp:
+        from ..common.time import Timestamp
+        return Timestamp(v, dtype.time_unit).to_datetime().strftime(
+            "%Y-%m-%d %H:%M:%S.%f").encode()
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    return str(v).encode()
+
+
+class _MessageIO:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def _read_n(self, n: int) -> Optional[bytes]:
+        chunks = []
+        while n > 0:
+            chunk = self.sock.recv(n)
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def read_startup(self) -> Optional[Tuple[int, bytes]]:
+        head = self._read_n(4)
+        if head is None:
+            return None
+        length = struct.unpack("!I", head)[0]
+        body = self._read_n(length - 4)
+        if body is None or len(body) < 4:
+            return None
+        code = struct.unpack_from("!I", body, 0)[0]
+        return code, body[4:]
+
+    def read_message(self) -> Optional[Tuple[int, bytes]]:
+        head = self._read_n(5)
+        if head is None:
+            return None
+        tag = head[0]
+        length = struct.unpack_from("!I", head, 1)[0]
+        body = self._read_n(length - 4)
+        return tag, body if body is not None else b""
+
+    def send(self, tag: bytes, body: bytes = b"") -> None:
+        self.sock.sendall(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def send_raw(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+
+class _PgPortal:
+    __slots__ = ("sql",)
+
+    def __init__(self, sql: str):
+        self.sql = sql
+
+
+class _PgConnection:
+    def __init__(self, server: "PostgresServer", sock: socket.socket,
+                 conn_id: int):
+        self.server = server
+        self.sock = sock
+        self.io = _MessageIO(sock)
+        self.conn_id = conn_id
+        self.ctx = QueryContext(channel=Channel.POSTGRES)
+        self.stmts: Dict[str, str] = {}       # name -> sql with $N params
+        self.portals: Dict[str, _PgPortal] = {}
+
+    # ---- message helpers ----
+    def send_error(self, message: str, code: str = "XX000",
+                   severity: str = "ERROR") -> None:
+        fields = (b"S" + severity.encode() + b"\x00"
+                  + b"C" + code.encode() + b"\x00"
+                  + b"M" + message.encode() + b"\x00" + b"\x00")
+        self.io.send(b"E", fields)
+
+    def send_ready(self) -> None:
+        self.io.send(b"Z", b"I")
+
+    def send_row_description(self, schema) -> None:
+        body = struct.pack("!H", len(schema.column_schemas))
+        for col in schema.column_schemas:
+            body += (col.name.encode() + b"\x00"
+                     + struct.pack("!IHIhih", 0, 0, _pg_oid(col.dtype),
+                                   -1, -1, 0))
+        self.io.send(b"T", body)
+
+    def send_rows(self, batches) -> int:
+        n = 0
+        for b in batches:
+            dtypes = [c.dtype for c in b.schema.column_schemas]
+            for row in b.rows():
+                body = struct.pack("!H", len(row))
+                for v, dt in zip(row, dtypes):
+                    txt = _pg_text(v, dt)
+                    if txt is None:
+                        body += struct.pack("!i", -1)
+                    else:
+                        body += struct.pack("!i", len(txt)) + txt
+                self.io.send(b"D", body)
+                n += 1
+        return n
+
+    def send_complete(self, sql: str, output) -> None:
+        word = sql.lstrip().split(None, 1)
+        word = word[0].upper() if word else ""
+        if output.is_batches:
+            tag = f"SELECT {output.num_rows}"
+        elif word == "INSERT":
+            tag = f"INSERT 0 {output.affected_rows or 0}"
+        elif word == "DELETE":
+            tag = f"DELETE {output.affected_rows or 0}"
+        else:
+            tag = word or "OK"
+        self.io.send(b"C", tag.encode() + b"\x00")
+
+    # ---- startup/auth ----
+    def startup(self) -> bool:
+        while True:
+            msg = self.io.read_startup()
+            if msg is None:
+                return False
+            code, body = msg
+            if code == SSL_REQUEST:
+                if self.server.ssl_context is not None:
+                    self.io.send_raw(b"S")
+                    self.sock = self.server.ssl_context.wrap_socket(
+                        self.sock, server_side=True)
+                    self.io.sock = self.sock
+                else:
+                    self.io.send_raw(b"N")
+                continue
+            if code == CANCEL_REQUEST:
+                return False
+            if code != PROTOCOL_V3:
+                self.send_error(f"unsupported protocol {code}", "0A000",
+                                "FATAL")
+                return False
+            break
+        params: Dict[str, str] = {}
+        parts = body.split(b"\x00")
+        for k, v in zip(parts[::2], parts[1::2]):
+            if k:
+                params[k.decode()] = v.decode()
+        user = params.get("user", "greptime")
+        if params.get("database"):
+            self.ctx.set_current_schema(params["database"])
+
+        provider = self.server.user_provider
+        if provider is not None and provider.requires_password:
+            self.io.send(b"R", struct.pack("!I", 3))   # cleartext password
+            msg = self.io.read_message()
+            if msg is None or msg[0] != ord("p"):
+                return False
+            password = msg[1].rstrip(b"\x00").decode()
+            if not provider.authenticate(user, password):
+                self.send_error(f'password authentication failed for '
+                                f'user "{user}"', "28P01", "FATAL")
+                return False
+        self.ctx.username = user
+        self.io.send(b"R", struct.pack("!I", 0))       # AuthenticationOk
+        for k, v in (("server_version", "16.0"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO, MDY"),
+                     ("TimeZone", "UTC"),
+                     ("integer_datetimes", "on")):
+            self.io.send(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
+        self.io.send(b"K", struct.pack("!II", self.conn_id, 0))
+        self.send_ready()
+        return True
+
+    # ---- query execution ----
+    def _execute_sql(self, sql: str, *, describe_only: bool = False):
+        outputs = self.server.instance.do_query(sql, self.ctx)
+        return outputs[-1]
+
+    def handle_simple_query(self, sql: str) -> None:
+        sql = sql.rstrip("\x00")
+        if not sql.strip():
+            self.io.send(b"I")
+            self.send_ready()
+            return
+        try:
+            out = self._execute_sql(sql)
+            if out.is_batches:
+                batches = out.batches
+                if batches:
+                    self.send_row_description(batches[0].schema)
+                    self.send_rows(batches)
+                else:
+                    self.io.send(b"T", struct.pack("!H", 0))
+            self.send_complete(sql, out)
+        except GreptimeError as e:
+            self.send_error(str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("postgres query failed: %s", sql)
+            self.send_error(str(e))
+        self.send_ready()
+
+    # ---- extended protocol ----
+    def handle_parse(self, body: bytes) -> None:
+        end = body.index(b"\x00")
+        name = body[:end].decode()
+        end2 = body.index(b"\x00", end + 1)
+        sql = body[end + 1:end2].decode()
+        self.stmts[name] = sql
+        self.io.send(b"1")                              # ParseComplete
+
+    def handle_bind(self, body: bytes) -> None:
+        pos = body.index(b"\x00")
+        portal = body[:pos].decode()
+        end = body.index(b"\x00", pos + 1)
+        stmt_name = body[pos + 1:end].decode()
+        pos = end + 1
+        nfmt = struct.unpack_from("!H", body, pos)[0]
+        pos += 2 + 2 * nfmt
+        nparams = struct.unpack_from("!H", body, pos)[0]
+        pos += 2
+        params: List[Optional[str]] = []
+        for _ in range(nparams):
+            plen = struct.unpack_from("!i", body, pos)[0]
+            pos += 4
+            if plen == -1:
+                params.append(None)
+            else:
+                params.append(body[pos:pos + plen].decode())
+                pos += plen
+        sql = self.stmts.get(stmt_name, "")
+        self.portals[portal] = _PgPortal(_substitute_pg_params(sql, params))
+        self.io.send(b"2")                              # BindComplete
+
+    def handle_describe(self, body: bytes) -> None:
+        import re
+        kind = chr(body[0])
+        name = body[1:].rstrip(b"\x00").decode()
+        if kind == "S":
+            sql = self.stmts.get(name, "")
+            nparams = len(set(re.findall(r"\$(\d+)", sql)))
+            # all parameters described as text; values coerce at parse time
+            self.io.send(b"t", struct.pack("!H", nparams)
+                         + struct.pack("!I", OID_TEXT) * nparams)
+        # row description needs planning; it is sent with the Execute
+        # response instead (clients accept 'T' arriving there)
+        self.io.send(b"n")                              # NoData
+
+    def handle_execute(self, body: bytes) -> None:
+        name = body[:body.index(b"\x00")].decode()
+        portal = self.portals.get(name)
+        if portal is None:
+            self.send_error(f"portal {name!r} does not exist", "34000")
+            return
+        sql = portal.sql
+        try:
+            out = self._execute_sql(sql)
+            if out.is_batches:
+                batches = out.batches
+                if batches:
+                    self.send_row_description(batches[0].schema)
+                    self.send_rows(batches)
+                else:
+                    self.io.send(b"T", struct.pack("!H", 0))
+            self.send_complete(sql, out)
+        except GreptimeError as e:
+            self.send_error(str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("postgres execute failed: %s", sql)
+            self.send_error(str(e))
+
+    def handle_close(self, body: bytes) -> None:
+        kind = chr(body[0])
+        name = body[1:].rstrip(b"\x00").decode()
+        if kind == "S":
+            self.stmts.pop(name, None)
+        else:
+            self.portals.pop(name, None)
+        self.io.send(b"3")                              # CloseComplete
+
+    # ---- main loop ----
+    def run(self) -> None:
+        try:
+            if not self.startup():
+                return
+            while True:
+                msg = self.io.read_message()
+                if msg is None:
+                    return
+                tag, body = msg
+                ch = chr(tag)
+                if ch == "X":                           # Terminate
+                    return
+                if ch == "Q":
+                    self.handle_simple_query(body.decode())
+                elif ch == "P":
+                    self.handle_parse(body)
+                elif ch == "B":
+                    self.handle_bind(body)
+                elif ch == "D":
+                    self.handle_describe(body)
+                elif ch == "E":
+                    self.handle_execute(body)
+                elif ch == "C":
+                    self.handle_close(body)
+                elif ch == "S":                         # Sync
+                    self.send_ready()
+                elif ch == "H":                         # Flush
+                    pass
+                else:
+                    self.send_error(f"unsupported message {ch!r}", "0A000")
+                    self.send_ready()
+        except (ConnectionError, OSError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("postgres connection %d crashed", self.conn_id)
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def _substitute_pg_params(sql: str, params: List[Optional[str]]) -> str:
+    """Text-format $N substitution (reference pgwire handles typed params;
+    values arrive as text and our parser coerces by column type)."""
+    out = []
+    i = 0
+    in_str = False
+    while i < len(sql):
+        ch = sql[i]
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+            i += 1
+        elif ch == "$" and not in_str and i + 1 < len(sql) \
+                and sql[i + 1].isdigit():
+            j = i + 1
+            while j < len(sql) and sql[j].isdigit():
+                j += 1
+            idx = int(sql[i + 1:j]) - 1
+            if 0 <= idx < len(params):
+                v = params[idx]
+                if v is None:
+                    out.append("NULL")
+                elif _is_number(v):
+                    out.append(v)
+                else:
+                    out.append("'" + v.replace("'", "''") + "'")
+                i = j
+            else:
+                out.append(ch)
+                i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _is_number(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+class PostgresServer:
+    """Threaded PostgreSQL protocol listener over a frontend instance."""
+
+    def __init__(self, instance, host: str = "127.0.0.1", port: int = 0,
+                 user_provider=None,
+                 ssl_context: Optional[ssl_mod.SSLContext] = None):
+        self.instance = instance
+        self.user_provider = user_provider
+        self.ssl_context = ssl_context
+        self._next_conn_id = 1
+        self._lock = threading.Lock()
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                with server_self._lock:
+                    conn_id = server_self._next_conn_id
+                    server_self._next_conn_id += 1
+                _PgConnection(server_self, self.request, conn_id).run()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, port), Handler)
+        self.port = self._tcp.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def serve_in_background(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="postgres-server")
+        self._thread.start()
+        return self._thread
+
+    # CLI lifecycle alias (cmd/main.py starts all servers uniformly)
+    start = serve_in_background
+
+    @property
+    def host(self) -> str:
+        return self._tcp.server_address[0]
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
