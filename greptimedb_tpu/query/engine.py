@@ -14,6 +14,7 @@ import numpy as np
 import pandas as pd
 
 from ..catalog import CatalogManager
+from ..common.time import TimeUnit
 from ..datatypes import data_type as dt
 from ..datatypes.record_batch import RecordBatch
 from ..datatypes.schema import ColumnSchema, Schema, SemanticType
@@ -21,13 +22,14 @@ from ..errors import (
     PlanError, TableNotFoundError, UnsupportedError)
 from ..session import QueryContext
 from ..sql.ast import (
-    Column, DescribeTable, Explain, Query, ShowCreateTable, ShowDatabases,
-    ShowTables, ShowVariable, Star, Statement, TableRef)
+    Column, DescribeTable, Explain, FunctionCall, Query, ShowCreateTable,
+    ShowDatabases, ShowTables, ShowVariable, Star, Statement, TableRef)
 from ..table.table import Table
 from .expr import Evaluator, expr_name, like_to_regex
 from .functions import AGGREGATE_FUNCTIONS
 from .output import Output
-from .planner import Analysis, analyze, _group_slot
+from .planner import (Analysis, analyze, convert_time_literals,
+                      _group_slot)
 from . import show as show_impl
 from . import tpu_exec
 
@@ -122,6 +124,10 @@ class QueryEngine:
         if table is None:
             df = pd.DataFrame(index=[0])
             return self._run_on_frame(df, a, query, None)
+
+        # literal→timestamp coercion needs the table schema, so it runs
+        # post-resolution (reference: TypeConversionRule, optimizer.rs:33)
+        query.where = convert_time_literals(query.where, table.schema)
 
         # TPU fast path
         result = tpu_exec.try_execute(table, a, query)
@@ -247,6 +253,7 @@ class QueryEngine:
         out_cols: Dict[str, Any] = {}
         out_names: List[str] = []
         source_cols: Dict[str, Optional[str]] = {}
+        dtype_overrides: Dict[str, dt.ConcreteDataType] = {}
         for item in (a.projections if aggregated or a.is_aggregate
                      else query.projections):
             if isinstance(item.expr, Star):
@@ -261,6 +268,9 @@ class QueryEngine:
             if aggregated and isinstance(item.expr, Column) and \
                     item.expr.name.startswith("__key__"):
                 name = item.alias or item.expr.name[len("__key__"):]
+            override = _result_dtype_override(item.expr, a, table)
+            if override is not None:
+                dtype_overrides[name] = override
             v = ev.eval(item.expr)
             out_cols[name] = v if isinstance(v, pd.Series) else \
                 pd.Series([v] * len(df), index=df.index)
@@ -311,7 +321,7 @@ class QueryEngine:
         if query.limit is not None:
             proj = proj.iloc[:query.limit]
 
-        schema = _infer_schema(proj, table, source_cols)
+        schema = _infer_schema(proj, table, source_cols, dtype_overrides)
         return Output.record_batches([_df_to_batch(proj, schema)], schema)
 
 
@@ -330,9 +340,15 @@ def _batches_to_df(batches: Optional[List[RecordBatch]]) -> pd.DataFrame:
 
 
 def _infer_schema(df: pd.DataFrame, table: Optional[Table],
-                  source_cols: Dict[str, Optional[str]]) -> Schema:
+                  source_cols: Dict[str, Optional[str]],
+                  dtype_overrides: Optional[Dict[str, object]] = None
+                  ) -> Schema:
     cols = []
     for name in df.columns:
+        if dtype_overrides and name in dtype_overrides:
+            cols.append(ColumnSchema(name, dtype_overrides[name],
+                                     nullable=True))
+            continue
         src = source_cols.get(name)
         if table is not None and src is not None and \
                 table.schema.contains(src):
@@ -372,8 +388,56 @@ def _df_to_batch(df: pd.DataFrame, schema: Schema) -> RecordBatch:
         elif s.dtype.kind == "M":
             cols[cs.name] = (s.astype(np.int64) // 1_000_000).tolist()
         elif s.dtype.kind == "f":
-            # SQL convention (as in pandas-backed systems): NaN is NULL
-            cols[cs.name] = [None if v != v else v for v in s.tolist()]
+            if cs.dtype.np_dtype.kind in "iu" or cs.dtype.is_timestamp:
+                # declared integral (int aggregate / time bucket) but the
+                # accumulator ran in float: cast back, NaN -> NULL
+                cols[cs.name] = [None if v != v else int(round(v))
+                                 for v in s.tolist()]
+            else:
+                # SQL convention (as in pandas-backed systems): NaN is NULL
+                cols[cs.name] = [None if v != v else v for v in s.tolist()]
         else:
             cols[cs.name] = s.tolist()
     return RecordBatch.from_pydict(schema, cols)
+
+
+_INT_TYPE_NAMES = {"Int8", "Int16", "Int32", "Int64",
+                   "UInt8", "UInt16", "UInt32", "UInt64"}
+
+
+def _result_dtype_override(expr, a: Analysis, table: Optional[Table]):
+    """Result types that must not decay to float64 (reference: DataFusion
+    keeps integer sums as Int64, min/max/first/last as the source type,
+    and date_bin/date_trunc results as timestamps)."""
+    if isinstance(expr, Column) and expr.name.startswith("__key__"):
+        target = expr.name[len("__key__"):]
+        for g in a.group_exprs:
+            if expr_name(g) == target:
+                expr = g
+                break
+    if isinstance(expr, Column) and table is not None:
+        for call in a.agg_calls:
+            if call.slot != expr.name:
+                continue
+            if call.op == "count":
+                return dt.INT64
+            if call.op in ("sum", "min", "max", "first", "last") and \
+                    isinstance(call.arg, Column) and \
+                    table.schema.contains(call.arg.name):
+                src = table.schema.column_schema(call.arg.name).dtype
+                if src.is_timestamp:
+                    return src
+                if src.name in _INT_TYPE_NAMES:
+                    return dt.INT64 if call.op == "sum" else src
+            return None
+        return None
+    if isinstance(expr, FunctionCall) and \
+            expr.name.lower() in ("date_bin", "date_trunc"):
+        for argx in expr.args:
+            if isinstance(argx, Column) and table is not None and \
+                    table.schema.contains(argx.name):
+                src = table.schema.column_schema(argx.name).dtype
+                if src.is_timestamp and \
+                        src.time_unit == TimeUnit.MILLISECOND:
+                    return src
+    return None

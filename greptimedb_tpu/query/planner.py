@@ -233,3 +233,60 @@ def analyze(query: Query) -> Analysis:
 
 def _group_slot(name: str) -> str:
     return f"__key__{name}"
+
+
+def convert_time_literals(e: Optional[Expr], schema) -> Optional[Expr]:
+    """String/second-precision literals compared against timestamp columns
+    are coerced to the column's native unit (reference: TypeConversionRule
+    analyzer, src/query/src/optimizer.rs:33 — DataFusion literals become
+    timestamps before planning)."""
+    if e is None or schema is None:
+        return e
+
+    def ts_unit(col: Expr):
+        if isinstance(col, Column) and schema.contains(col.name):
+            dtype = schema.column_schema(col.name).dtype
+            if dtype.is_timestamp:
+                return dtype.time_unit
+        return None
+
+    def coerce(lit: Expr, unit):
+        if isinstance(lit, Literal) and isinstance(lit.value, str):
+            from ..common.time import Timestamp
+            try:
+                return Literal(Timestamp.from_str(lit.value, unit).value)
+            except (ValueError, TypeError):
+                return lit
+        return lit
+
+    def walk(node: Expr) -> Expr:
+        if isinstance(node, BinaryOp):
+            if node.op in ("=", "!=", "<>", "<", "<=", ">", ">="):
+                unit = ts_unit(node.left)
+                if unit is not None:
+                    return dataclasses.replace(
+                        node, right=coerce(node.right, unit))
+                unit = ts_unit(node.right)
+                if unit is not None:
+                    return dataclasses.replace(
+                        node, left=coerce(node.left, unit))
+                return node
+            return dataclasses.replace(node, left=walk(node.left),
+                                       right=walk(node.right))
+        if isinstance(node, UnaryOp):
+            return dataclasses.replace(node, operand=walk(node.operand))
+        if isinstance(node, Between):
+            unit = ts_unit(node.expr)
+            if unit is not None:
+                return dataclasses.replace(node, low=coerce(node.low, unit),
+                                           high=coerce(node.high, unit))
+            return node
+        if isinstance(node, InList):
+            unit = ts_unit(node.expr)
+            if unit is not None:
+                return dataclasses.replace(
+                    node, items=[coerce(i, unit) for i in node.items])
+            return node
+        return node
+
+    return walk(e)

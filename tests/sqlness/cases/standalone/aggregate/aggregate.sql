@@ -1,0 +1,19 @@
+CREATE TABLE nums (host STRING, ts TIMESTAMP TIME INDEX, n BIGINT, PRIMARY KEY(host));
+
+INSERT INTO nums VALUES ('a', 1, 1), ('a', 2, 2), ('a', 3, 3), ('b', 4, 10), ('b', 5, 20), ('b', 6, NULL);
+
+SELECT sum(n) FROM nums;
+
+SELECT min(n), max(n) FROM nums;
+
+SELECT count(n), count(*) FROM nums;
+
+SELECT host, sum(n) FROM nums GROUP BY host ORDER BY host;
+
+SELECT host, avg(n) FROM nums GROUP BY host ORDER BY host;
+
+SELECT sum(n) FROM nums WHERE host = 'a';
+
+SELECT DISTINCT host FROM nums ORDER BY host;
+
+DROP TABLE nums;

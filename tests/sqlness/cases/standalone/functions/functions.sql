@@ -1,0 +1,15 @@
+CREATE TABLE fx (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO fx VALUES ('a', 0, 1.0), ('a', 60000, 2.0), ('a', 120000, 4.0), ('a', 180000, 8.0);
+
+SELECT date_bin(INTERVAL '2 minutes', ts) AS bucket, sum(v) FROM fx GROUP BY bucket ORDER BY bucket;
+
+SELECT ts, date_trunc('minute', ts) FROM fx ORDER BY ts LIMIT 2;
+
+SELECT argmax(v) FROM fx;
+
+SELECT percentile(v, 50) FROM fx;
+
+SELECT abs(-2.5), sqrt(16.0), pow(2.0, 10.0);
+
+DROP TABLE fx;

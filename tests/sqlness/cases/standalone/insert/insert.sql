@@ -1,0 +1,17 @@
+CREATE TABLE monitor (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, memory DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO monitor (host, ts, cpu, memory) VALUES ('host1', 1000, 1.5, 100);
+
+INSERT INTO monitor (host, ts, cpu) VALUES ('host2', 2000, 2.5);
+
+INSERT INTO monitor VALUES ('host3', 3000, 3.5, 300), ('host4', 4000, 4.5, 400);
+
+INSERT INTO monitor (ts, cpu) VALUES (5000, 5.5);
+
+SELECT * FROM monitor ORDER BY ts;
+
+INSERT INTO monitor (host, ts, nope) VALUES ('x', 1, 1);
+
+INSERT INTO monitor (host, ts, cpu) VALUES ('h', 1);
+
+DROP TABLE monitor;

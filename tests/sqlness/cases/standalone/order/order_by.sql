@@ -1,0 +1,15 @@
+CREATE TABLE ob (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO ob VALUES ('c', 1, 3.0), ('a', 2, 1.0), ('b', 3, 2.0), ('d', 4, NULL);
+
+SELECT host, v FROM ob ORDER BY v;
+
+SELECT host, v FROM ob ORDER BY v DESC;
+
+SELECT host, v FROM ob ORDER BY host DESC LIMIT 2;
+
+SELECT host, v FROM ob ORDER BY v LIMIT 2 OFFSET 1;
+
+SELECT host FROM ob ORDER BY nonexistent;
+
+DROP TABLE ob;

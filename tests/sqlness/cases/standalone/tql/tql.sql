@@ -1,0 +1,13 @@
+CREATE TABLE http_requests (host STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO http_requests VALUES
+    ('web1', 0, 1.0), ('web1', 5000, 2.0), ('web1', 10000, 3.0),
+    ('web2', 0, 10.0), ('web2', 5000, 20.0), ('web2', 10000, 30.0);
+
+TQL EVAL (0, 10, '5s') http_requests;
+
+TQL EVAL (0, 10, '5s') sum(http_requests);
+
+TQL EVAL (10, 10, '5s') avg_over_time(http_requests[10s]);
+
+DROP TABLE http_requests;

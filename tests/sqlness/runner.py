@@ -1,0 +1,175 @@
+"""sqlness-style golden-file SQL harness.
+
+Reference behavior: tests/runner/src/{main,env,util}.rs + tests/cases/ —
+`.sql` files run against a started server; outputs are diffed against
+committed `.result` files. This is the reference's primary end-to-end
+regression rig (SURVEY §4); this port executes each case file against a
+fresh in-process standalone frontend and renders results in the same
+shape (`Affected Rows: N` / ASCII tables / `Error: ...`).
+
+Usage:
+    python tests/sqlness/runner.py            # run all cases, diff
+    python tests/sqlness/runner.py --update   # (re)generate .result files
+    python tests/sqlness/runner.py name ...   # filter by substring
+
+Pytest integration lives in tests/test_sqlness.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import sys
+import tempfile
+from pathlib import Path
+from typing import List, Optional
+
+CASES_DIR = Path(__file__).parent / "cases"
+
+
+def split_statements(text: str) -> List[str]:
+    """Split a .sql file into ';'-terminated statements, respecting
+    single-quoted strings and line comments."""
+    statements, buf = [], []
+    in_str = False
+    in_comment = False
+    for ch in text:
+        if in_comment:
+            buf.append(ch)
+            if ch == "\n":
+                in_comment = False
+            continue
+        if ch == "'" :
+            in_str = not in_str
+            buf.append(ch)
+            continue
+        if not in_str and ch == "-" and buf and buf[-1] == "-":
+            in_comment = True
+            buf.append(ch)
+            continue
+        if ch == ";" and not in_str:
+            stmt = "".join(buf).strip()
+            if stmt:
+                statements.append(stmt + ";")
+            buf = []
+            continue
+        buf.append(ch)
+    tail = "".join(buf).strip()
+    if tail:
+        statements.append(tail)
+    return statements
+
+
+def _strip_comment_lines(stmt: str) -> str:
+    lines = [ln for ln in stmt.splitlines()
+             if not ln.lstrip().startswith("--")]
+    return "\n".join(lines).strip()
+
+
+def render_output(out) -> str:
+    from greptimedb_tpu.datatypes.record_batch import pretty_print
+    if out.is_batches:
+        if not out.batches or all(b.num_rows == 0 for b in out.batches):
+            names = out.batches[0].schema.names() if out.batches else []
+            if names:
+                return pretty_print(out.batches)
+            return "(empty)"
+        return pretty_print(out.batches)
+    return f"Affected Rows: {out.affected_rows or 0}"
+
+
+def run_case(sql_text: str, frontend) -> str:
+    """Execute a case file's statements; return the .result content."""
+    from greptimedb_tpu.errors import GreptimeError
+    from greptimedb_tpu.session import QueryContext
+
+    ctx = QueryContext()
+    blocks: List[str] = []
+    for stmt in split_statements(sql_text):
+        body = _strip_comment_lines(stmt)
+        if not body:
+            continue
+        blocks.append(stmt)
+        try:
+            outputs = frontend.do_query(body, ctx)
+            blocks.append(render_output(outputs[-1]))
+        except GreptimeError as e:
+            blocks.append(f"Error: {e}")
+        except Exception as e:  # noqa: BLE001 — parser/planner crashes
+            blocks.append(f"Error: {type(e).__name__}: {e}")
+    return "\n\n".join(blocks) + "\n"
+
+
+def make_frontend(data_home: str):
+    from greptimedb_tpu.datanode.instance import (
+        DatanodeInstance, DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    dn = DatanodeInstance(DatanodeOptions(data_home=data_home,
+                                          register_numbers_table=True))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    return fe
+
+
+def case_files(filters: List[str]) -> List[Path]:
+    files = sorted(CASES_DIR.rglob("*.sql"))
+    if filters:
+        files = [f for f in files
+                 if any(flt in str(f) for flt in filters)]
+    return files
+
+
+def run_one(sql_path: Path, update: bool) -> Optional[str]:
+    result_path = sql_path.with_suffix(".result")
+    with tempfile.TemporaryDirectory() as home:
+        fe = make_frontend(home)
+        try:
+            got = run_case(sql_path.read_text(), fe)
+        finally:
+            fe.shutdown()
+    if update:
+        result_path.write_text(got)
+        return None
+    if not result_path.exists():
+        return f"{sql_path}: missing .result (run with --update)"
+    want = result_path.read_text()
+    if got != want:
+        diff = "\n".join(difflib.unified_diff(
+            want.splitlines(), got.splitlines(),
+            fromfile=str(result_path), tofile="actual", lineterm=""))
+        return f"{sql_path}:\n{diff}"
+    return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="sqlness golden harness")
+    parser.add_argument("--update", action="store_true",
+                        help="regenerate .result files")
+    parser.add_argument("filters", nargs="*",
+                        help="substring filters on case paths")
+    args = parser.parse_args(argv)
+
+    failures = []
+    files = case_files(args.filters)
+    if not files:
+        print("no cases matched", file=sys.stderr)
+        return 2
+    for f in files:
+        err = run_one(f, args.update)
+        status = "UPDATED" if args.update else ("FAIL" if err else "PASS")
+        print(f"[{status}] {f.relative_to(CASES_DIR)}")
+        if err:
+            failures.append(err)
+    if failures:
+        print("\n" + "\n\n".join(failures))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Pytest face of the sqlness golden harness (tests/sqlness/runner.py).
+
+Each `.sql` case runs against a fresh standalone frontend and its output
+must byte-match the committed `.result` golden — the reference's primary
+end-to-end regression rig (tests/runner/, SURVEY §4). Regenerate goldens
+with `python tests/sqlness/runner.py --update`.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent / "sqlness"))
+import runner  # noqa: E402
+
+
+CASES = runner.case_files([])
+
+
+@pytest.mark.parametrize(
+    "sql_path", CASES,
+    ids=[str(p.relative_to(runner.CASES_DIR))[:-4] for p in CASES])
+def test_sqlness_case(sql_path):
+    err = runner.run_one(sql_path, update=False)
+    assert err is None, f"\n{err}"
+
+
+def test_cases_exist():
+    assert len(CASES) >= 13, "sqlness case suite went missing"
+
+
+class TestStatementSplitter:
+    def test_quotes_and_comments(self):
+        stmts = runner.split_statements(
+            "SELECT 'a;b' FROM t; -- trailing; comment\n"
+            "INSERT INTO t VALUES (1);")
+        assert len(stmts) == 2
+        assert stmts[0] == "SELECT 'a;b' FROM t;"
+
+    def test_unterminated_tail(self):
+        stmts = runner.split_statements("SELECT 1")
+        assert stmts == ["SELECT 1"]
