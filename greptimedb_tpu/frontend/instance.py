@@ -35,10 +35,16 @@ class FrontendInstance:
         self.statement_executor = StatementExecutor(
             self.catalog, datanode.engines, self.query_engine)
         self._tql_engine = None
+        self.script_engine = None
 
     def start(self) -> None:
         if not self.datanode._started:
             self.datanode.start()
+        # recompile + re-register persisted coprocessors (reference:
+        # scripts system table, src/script/src/table.rs:51)
+        from ..script import ScriptEngine
+        self.script_engine = ScriptEngine(self)
+        self.script_engine.load_scripts()
 
     def shutdown(self) -> None:
         self.datanode.shutdown()

@@ -246,4 +246,14 @@ class Evaluator:
             if isinstance(out, np.ndarray) and len(self.df):
                 return pd.Series(out, index=self.df.index)
             return out
+        from .functions import UDF_REGISTRY
+        if name in UDF_REGISTRY:
+            args = [self.eval(a) for a in e.args]
+            np_args = [a.to_numpy() if isinstance(a, pd.Series) else a
+                       for a in args]
+            out = UDF_REGISTRY[name](*np_args)
+            if isinstance(out, np.ndarray) and len(self.df) and \
+                    len(out) == len(self.df):
+                return pd.Series(out, index=self.df.index)
+            return out
         raise UnsupportedError(f"unknown function {name!r}")

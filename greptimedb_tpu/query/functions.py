@@ -274,3 +274,20 @@ AGGREGATE_FUNCTIONS: Dict[str, Callable] = {
 # aggregates the TPU sorted kernel executes natively (ops/kernels.py AGG_OPS)
 TPU_AGGREGATES = {"count", "sum", "avg", "min", "max", "stddev", "variance",
                   "first", "last"}
+
+
+# ---------------------------------------------------------------------------
+# user-defined functions (coprocessors registered by the script engine;
+# reference: src/script/src/python/engine.rs:44-80 registers each compiled
+# coprocessor as a UDF in the query engine)
+# ---------------------------------------------------------------------------
+
+UDF_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_udf(name: str, fn: Callable) -> None:
+    UDF_REGISTRY[name.lower()] = fn
+
+
+def unregister_udf(name: str) -> None:
+    UDF_REGISTRY.pop(name.lower(), None)

@@ -1,0 +1,16 @@
+"""Python coprocessor / UDF engine.
+
+Reference behavior: src/script/src/python/ — the `@copr(args, returns,
+sql=...)` decorator marks a Python function as a coprocessor
+(ffi_types/copr.rs:40-120, decorator parse ffi_types/copr/parse.rs);
+vectors bridge zero-copy into the script (ffi_types/vector.rs); scripts
+persist in a `scripts` system table (table.rs:51) and register as UDFs
+into the query engine (python/engine.rs:44-80). The reference needs a
+RustPython/PyO3 VM to host Python; this framework *is* Python, so the
+engine compiles scripts natively and hands them numpy/JAX vectors.
+"""
+
+from .copr import copr, coprocessor, Coprocessor
+from .engine import ScriptEngine
+
+__all__ = ["copr", "coprocessor", "Coprocessor", "ScriptEngine"]

@@ -82,6 +82,8 @@ class HttpServer:
         r.add_get("/status", self.handle_status)
         r.add_post("/v1/admin/flush", self.handle_flush)
         r.add_post("/v1/admin/compact", self.handle_compact)
+        r.add_post("/v1/scripts", self.handle_scripts)
+        r.add_post("/v1/run-script", self.handle_run_script)
         r.add_route("*", "/api/v1/query", self.handle_prom_api_query)
         r.add_route("*", "/api/v1/query_range", self.handle_prom_api_range)
         r.add_route("*", "/api/v1/labels", self.handle_prom_api_labels)
@@ -171,6 +173,59 @@ class HttpServer:
         out = await loop.run_in_executor(
             None, lambda: self.frontend.execute_tql(
                 Tql("eval", start, end, step, None, query), ctx))
+        return web.json_response({
+            "code": 0,
+            "output": [output_to_json(out)],
+            "execution_time_ms": int((time.perf_counter() - t0) * 1e3),
+        })
+
+    # ---- coprocessor scripts (reference: /v1/scripts + /v1/run-script,
+    # src/servers/src/http.rs:434-578 script routes) ----
+    def _script_engine(self):
+        engine = getattr(self.frontend, "script_engine", None)
+        if engine is None:
+            from ..script import ScriptEngine
+            engine = ScriptEngine(self.frontend)
+            self.frontend.script_engine = engine
+        return engine
+
+    async def handle_scripts(self, request):
+        ctx = self._ctx(request)
+        name = request.query.get("name")
+        if request.query.get("db"):
+            ctx.set_current_schema(request.query["db"])
+        if not name:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "missing 'name' parameter"}, status=400)
+        script = (await request.read()).decode()
+        loop = asyncio.get_running_loop()
+        engine = self._script_engine()
+        await loop.run_in_executor(
+            None, lambda: engine.insert_script(name, script, ctx))
+        return web.json_response({"code": 0})
+
+    async def handle_run_script(self, request):
+        t0 = time.perf_counter()
+        ctx = self._ctx(request)
+        name = request.query.get("name")
+        if request.query.get("db"):
+            ctx.set_current_schema(request.query["db"])
+        loop = asyncio.get_running_loop()
+        engine = self._script_engine()
+        if name:
+            out = await loop.run_in_executor(
+                None, lambda: engine.run(name, ctx=ctx))
+        else:
+            script = (await request.read()).decode()
+            if not script:
+                return web.json_response(
+                    {"code": int(StatusCode.INVALID_ARGUMENTS),
+                     "error": "missing 'name' parameter or script body"},
+                    status=400)
+            out = await loop.run_in_executor(
+                None, lambda: engine.run(script, ctx=ctx,
+                                         is_script_text=True))
         return web.json_response({
             "code": 0,
             "output": [output_to_json(out)],
