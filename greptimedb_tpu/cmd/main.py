@@ -27,6 +27,9 @@ class StandaloneOptions:
     enable_postgres: bool = True
     enable_grpc: bool = True
     log_level: str = "info"
+    #: [storage] table from the TOML: type=File|S3, bucket, endpoint,
+    #: cache_path... (reference: ObjectStoreConfig, datanode.rs:126-204)
+    storage: dict = field(default_factory=dict)
 
 
 def load_options(args) -> StandaloneOptions:
@@ -35,8 +38,8 @@ def load_options(args) -> StandaloneOptions:
         import tomllib
         with open(args.config_file, "rb") as f:
             doc = tomllib.load(f)
-        opts.data_home = doc.get("storage", {}).get("data_home",
-                                                    opts.data_home)
+        opts.storage = doc.get("storage", {})
+        opts.data_home = opts.storage.get("data_home", opts.data_home)
         http = doc.get("http", {})
         opts.http_addr = http.get("addr", opts.http_addr)
         mysql = doc.get("mysql", {})
@@ -64,7 +67,12 @@ def build_servers(opts: StandaloneOptions):
     from ..servers.auth import NoopUserProvider, StaticUserProvider
     from ..servers.http import HttpServer
 
-    dn = DatanodeInstance(DatanodeOptions(data_home=opts.data_home))
+    store = None
+    if opts.storage and str(opts.storage.get("type", "File")) != "File":
+        from ..storage.object_store import build_object_store
+        store = build_object_store(opts.storage, opts.data_home)
+    dn = DatanodeInstance(DatanodeOptions(data_home=opts.data_home),
+                          store=store)
     fe = FrontendInstance(dn)
     fe.start()
     provider = NoopUserProvider()

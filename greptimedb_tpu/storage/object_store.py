@@ -105,3 +105,31 @@ class FsObjectStore(ObjectStore):
 
 def new_fs_object_store(root: str) -> FsObjectStore:
     return FsObjectStore(root)
+
+
+def build_object_store(storage: dict, data_home: str) -> "ObjectStore":
+    """Construct the configured backend (reference: datanode builds its
+    object store from ObjectStoreConfig — Fs/S3/Oss — and optionally wraps
+    the LRU disk cache, src/datanode/src/instance.rs:334-359)."""
+    kind = str(storage.get("type", "File")).lower()
+    if kind in ("file", "fs"):
+        store: ObjectStore = FsObjectStore(
+            storage.get("data_home", data_home))
+    elif kind == "s3":
+        from .s3 import S3Config, S3ObjectStore
+        store = S3ObjectStore(S3Config(
+            bucket=storage["bucket"],
+            root=storage.get("root", ""),
+            endpoint=storage.get("endpoint"),
+            region=storage.get("region", "us-east-1"),
+            access_key_id=storage.get("access_key_id", ""),
+            secret_access_key=storage.get("secret_access_key", "")))
+    else:
+        raise ValueError(f"unknown storage type {storage.get('type')!r}")
+    cache = storage.get("cache_path")
+    if cache:
+        from .cache import LruCacheLayer
+        store = LruCacheLayer(
+            store, cache, int(storage.get("cache_capacity",
+                                          512 * 1024 * 1024)))
+    return store

@@ -1,0 +1,220 @@
+"""S3 object store + LRU disk cache tests.
+
+Mirrors the reference's storage-matrix integration tests
+(tests-integration/src/test_util.rs StorageType::{S3, S3WithCache}) using
+an in-process mock S3 endpoint, and the cache-policy unit tests
+(src/object-store/src/cache_policy.rs).
+"""
+
+import http.server
+import threading
+import urllib.parse
+
+import pytest
+
+from greptimedb_tpu.storage.cache import LruCacheLayer
+from greptimedb_tpu.storage.object_store import (
+    FsObjectStore, build_object_store)
+from greptimedb_tpu.storage.s3 import S3Config, S3Error, S3ObjectStore
+
+
+class MockS3Handler(http.server.BaseHTTPRequestHandler):
+    """Minimal S3 REST semantics over an in-memory dict."""
+
+    store = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _key(self):
+        return urllib.parse.unquote(self.path.split("?")[0].lstrip("/"))
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self.store[self._key()] = self.rfile.read(length)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        if "list-type" in query:
+            bucket = parsed.path.lstrip("/")
+            prefix = query.get("prefix", [""])[0]
+            keys = sorted(k[len(bucket) + 1:] for k in self.store
+                          if k.startswith(f"{bucket}/{prefix}"))
+            body = "<ListBucketResult>"
+            for k in keys:
+                body += f"<Contents><Key>{k}</Key></Contents>"
+            body += "<IsTruncated>false</IsTruncated></ListBucketResult>"
+            payload = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        data = self.store.get(self._key())
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_HEAD(self):
+        self.send_response(200 if self._key() in self.store else 404)
+        self.end_headers()
+
+    def do_DELETE(self):
+        self.store.pop(self._key(), None)
+        self.send_response(204)
+        self.end_headers()
+
+
+@pytest.fixture()
+def mock_s3():
+    MockS3Handler.store = {}
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             MockS3Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.fixture()
+def s3(mock_s3):
+    return S3ObjectStore(S3Config(
+        bucket="testbucket", root="greptime", endpoint=mock_s3,
+        access_key_id="ak", secret_access_key="sk"))
+
+
+class TestS3ObjectStore:
+    def test_write_read_roundtrip(self, s3):
+        s3.write("a/b.txt", b"hello")
+        assert s3.read("a/b.txt") == b"hello"
+
+    def test_read_missing_raises(self, s3):
+        with pytest.raises(FileNotFoundError):
+            s3.read("nope")
+
+    def test_exists_delete(self, s3):
+        s3.write("x", b"1")
+        assert s3.exists("x")
+        s3.delete("x")
+        assert not s3.exists("x")
+        s3.delete("x")                       # idempotent
+
+    def test_list_prefix(self, s3):
+        s3.write("d/1", b"a")
+        s3.write("d/2", b"b")
+        s3.write("e/3", b"c")
+        assert s3.list("d/") == ["d/1", "d/2"]
+
+    def test_delete_dir(self, s3):
+        s3.write("dir/a", b"1")
+        s3.write("dir/b", b"2")
+        s3.delete_dir("dir")
+        assert s3.list("dir/") == []
+
+    def test_sigv4_header_shape(self, s3):
+        import datetime
+        headers = s3._sign("GET", "/b/k", "", "payloadhash",
+                           datetime.datetime(2026, 1, 1))
+        auth = headers["authorization"]
+        assert auth.startswith("AWS4-HMAC-SHA256 Credential=ak/20260101/")
+        assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+
+
+class TestLruCacheLayer:
+    def test_hit_miss_counting(self, s3, tmp_path):
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"))
+        cached.write("k", b"v")
+        assert cached.read("k") == b"v"      # miss → pull through
+        assert cached.read("k") == b"v"      # hit
+        assert cached.misses == 1
+        assert cached.hits == 1
+
+    def test_eviction_by_capacity(self, s3, tmp_path):
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"),
+                               capacity_bytes=25)
+        for i in range(5):
+            cached.write(f"k{i}", bytes(10))
+            cached.read(f"k{i}")
+        # capacity 25 → at most 2 ten-byte entries survive
+        assert len(cached._entries) <= 2
+        # evicted keys still readable (from inner)
+        assert cached.read("k0") == bytes(10)
+
+    def test_write_invalidates(self, s3, tmp_path):
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"))
+        cached.write("k", b"old")
+        assert cached.read("k") == b"old"
+        cached.write("k", b"new")
+        assert cached.read("k") == b"new"
+
+    def test_recover_on_start(self, s3, tmp_path):
+        cache_dir = str(tmp_path / "cache")
+        c1 = LruCacheLayer(s3, cache_dir)
+        c1.write("persisted", b"data")
+        c1.read("persisted")
+        # fresh layer over the same dir recovers the index
+        c2 = LruCacheLayer(s3, cache_dir)
+        assert "persisted" in c2._entries
+        assert c2.read("persisted") == b"data"
+        assert c2.hits == 1
+
+    def test_local_path_pulls_through(self, s3, tmp_path):
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"))
+        cached.write("blob", b"xyz")
+        path = cached.local_path("blob")
+        assert path is not None
+        with open(path, "rb") as f:
+            assert f.read() == b"xyz"
+
+    def test_local_path_missing(self, s3, tmp_path):
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"))
+        assert cached.local_path("ghost") is None
+
+
+class TestStorageEngineOnS3:
+    def test_region_flush_scan_on_s3(self, s3, mock_s3, tmp_path):
+        """The full storage engine runs against S3 + cache (reference:
+        StorageType::S3WithCache matrix)."""
+        from greptimedb_tpu.datanode.instance import (
+            DatanodeInstance, DatanodeOptions)
+        from greptimedb_tpu.frontend.instance import FrontendInstance
+        cached = LruCacheLayer(s3, str(tmp_path / "cache"))
+        dn = DatanodeInstance(
+            DatanodeOptions(data_home=str(tmp_path / "wal"),
+                            register_numbers_table=False),
+            store=cached)
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        fe.do_query("CREATE TABLE s3t (host STRING, ts TIMESTAMP"
+                    " TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+        fe.do_query("INSERT INTO s3t VALUES ('a', 1000, 1.5),"
+                    " ('b', 2000, 2.5)")
+        t = fe.catalog.table("greptime", "public", "s3t")
+        t.flush()
+        # SSTs + manifest live in the mock bucket now
+        assert any("parquet" in k for k in MockS3Handler.store)
+        out = fe.do_query("SELECT sum(v) FROM s3t")[-1]
+        assert next(out.batches[0].rows())[0] == 4.0
+        fe.shutdown()
+
+    def test_build_object_store_factory(self, mock_s3, tmp_path):
+        fs = build_object_store({"type": "File"}, str(tmp_path / "fs"))
+        assert isinstance(fs, FsObjectStore)
+        s3b = build_object_store(
+            {"type": "S3", "bucket": "b", "endpoint": mock_s3,
+             "cache_path": str(tmp_path / "c")}, "")
+        assert isinstance(s3b, LruCacheLayer)
+        s3b.write("k", b"v")
+        assert s3b.read("k") == b"v"
+        with pytest.raises(ValueError):
+            build_object_store({"type": "Tape"}, "")
