@@ -46,12 +46,20 @@ class DatanodeInstance:
         self.engines = {self.mito.name: self.mito}
         self.catalog = LocalCatalogManager(self.store, self.engines)
         self.query_engine = QueryEngine(self.catalog)
+        # durable DDL (reference: procedure manager + loader registration,
+        # src/datanode/src/instance.rs:210-236)
+        from ..mito.procedure import register_loaders
+        from ..procedure import ProcedureManager
+        self.procedure_manager = ProcedureManager(self.store)
+        register_loaders(self.procedure_manager, self.mito, self.catalog)
         self._started = False
         self._heartbeat_task = None
 
     def start(self) -> None:
-        """Catalog replay → table open → region WAL replay."""
+        """Catalog replay → table open → region WAL replay → resume
+        in-flight procedures."""
         self.catalog.start()
+        self.procedure_manager.recover()
         if self.opts.register_numbers_table and \
                 self.catalog.table(DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME,
                                    "numbers") is None:

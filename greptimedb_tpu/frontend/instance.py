@@ -33,7 +33,8 @@ class FrontendInstance:
         self.catalog = datanode.catalog
         self.query_engine = datanode.query_engine
         self.statement_executor = StatementExecutor(
-            self.catalog, datanode.engines, self.query_engine)
+            self.catalog, datanode.engines, self.query_engine,
+            procedure_manager=datanode.procedure_manager)
         self._tql_engine = None
         self.script_engine = None
 

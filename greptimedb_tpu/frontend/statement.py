@@ -125,10 +125,14 @@ def delete_matching_rows(table, stmt: ast.Delete) -> Output:
 
 class StatementExecutor:
     def __init__(self, catalog: CatalogManager,
-                 engines: Dict[str, TableEngine], query_engine):
+                 engines: Dict[str, TableEngine], query_engine,
+                 procedure_manager=None):
         self.catalog = catalog
         self.engines = engines
         self.query_engine = query_engine
+        # when present, DDL runs as durable procedures (reference:
+        # table-procedure + mito DDL procedures)
+        self.procedure_manager = procedure_manager
 
     def engine_for(self, name: str) -> TableEngine:
         engine = self.engines.get(name)
@@ -150,11 +154,17 @@ class StatementExecutor:
                 f"table {table_name!r} already exists")
         schema, pk_indices = build_schema_from_create(stmt)
         engine = self.engine_for(stmt.engine)
-        table = engine.create_table(CreateTableRequest(
+        request = CreateTableRequest(
             table_name, schema, catalog_name=catalog,
             schema_name=schema_name, primary_key_indices=pk_indices,
             create_if_not_exists=stmt.if_not_exists,
-            table_options=dict(stmt.options), partitions=stmt.partitions))
+            table_options=dict(stmt.options), partitions=stmt.partitions)
+        if self.procedure_manager is not None:
+            from ..mito.procedure import CreateTableProcedure
+            self.procedure_manager.submit(CreateTableProcedure(
+                request, engine, self.catalog)).wait()
+            return Output.rows(0)
+        table = engine.create_table(request)
         self.catalog.register_table(catalog, schema_name, table_name, table)
         return Output.rows(0)
 
@@ -175,7 +185,13 @@ class StatementExecutor:
                 return Output.rows(0)
             raise TableNotFoundError(f"table {table_name!r} not found")
         engine = self.engine_for(table.info.meta.engine)
-        engine.drop_table(DropTableRequest(table_name, catalog, schema_name))
+        request = DropTableRequest(table_name, catalog, schema_name)
+        if self.procedure_manager is not None:
+            from ..mito.procedure import DropTableProcedure
+            self.procedure_manager.submit(DropTableProcedure(
+                request, engine, self.catalog)).wait()
+            return Output.rows(0)
+        engine.drop_table(request)
         self.catalog.deregister_table(catalog, schema_name, table_name)
         return Output.rows(0)
 
@@ -219,6 +235,11 @@ class StatementExecutor:
                 schema_name=schema_name, new_table_name=op.new_name)
         else:
             raise UnsupportedError(f"ALTER operation {type(op).__name__}")
+        if self.procedure_manager is not None:
+            from ..mito.procedure import AlterTableProcedure
+            self.procedure_manager.submit(AlterTableProcedure(
+                req, engine, self.catalog)).wait()
+            return Output.rows(0)
         engine.alter_table(req)
         if isinstance(op, ast.RenameTable):
             self.catalog.rename_table(catalog, schema_name, table_name,

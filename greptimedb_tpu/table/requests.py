@@ -86,3 +86,52 @@ class DeleteRequest:
     key_columns: Dict[str, Sequence]
     catalog_name: str = DEFAULT_CATALOG_NAME
     schema_name: str = DEFAULT_SCHEMA_NAME
+
+
+def create_request_to_dict(req: CreateTableRequest) -> dict:
+    """JSON-safe codec shared by the Flight DDL plane and the durable
+    procedure store (both ship CreateTableRequest across a boundary)."""
+    parts = None
+    if req.partitions is not None:
+        parts = {"columns": list(req.partitions.columns),
+                 "entries": [{"name": e.name, "values": list(e.values)}
+                             for e in req.partitions.entries]}
+    return {
+        "table_name": req.table_name,
+        "schema": req.schema.to_dict(),
+        "catalog_name": req.catalog_name,
+        "schema_name": req.schema_name,
+        "desc": req.desc,
+        "primary_key_indices": list(req.primary_key_indices),
+        "create_if_not_exists": req.create_if_not_exists,
+        "region_numbers": list(req.region_numbers),
+        "table_options": dict(req.table_options),
+        "partitions": parts,
+        "table_id": req.table_id,
+        "assigned_region_numbers": req.assigned_region_numbers,
+    }
+
+
+def create_request_from_dict(d: dict) -> CreateTableRequest:
+    from ..sql.ast import PartitionEntry, Partitions
+    parts = None
+    if d.get("partitions") is not None:
+        p = d["partitions"]
+        parts = Partitions(
+            columns=list(p["columns"]),
+            entries=[PartitionEntry(e["name"], list(e["values"]))
+                     for e in p["entries"]])
+    return CreateTableRequest(
+        table_name=d["table_name"],
+        schema=Schema.from_dict(d["schema"]),
+        catalog_name=d["catalog_name"],
+        schema_name=d["schema_name"],
+        desc=d.get("desc"),
+        primary_key_indices=list(d["primary_key_indices"]),
+        create_if_not_exists=d["create_if_not_exists"],
+        region_numbers=list(d["region_numbers"]),
+        table_options=dict(d["table_options"]),
+        partitions=parts,
+        table_id=d.get("table_id"),
+        assigned_region_numbers=d.get("assigned_region_numbers"),
+    )
