@@ -43,7 +43,10 @@ class DatanodeInstance:
         self.storage = StorageEngine(config, store=store)
         self.store = self.storage.store
         self.mito = MitoEngine(self.storage)
-        self.engines = {self.mito.name: self.mito}
+        from ..file_table import ImmutableFileTableEngine
+        self.file_engine = ImmutableFileTableEngine(self.store)
+        self.engines = {self.mito.name: self.mito,
+                        self.file_engine.name: self.file_engine}
         self.catalog = LocalCatalogManager(self.store, self.engines)
         self.query_engine = QueryEngine(self.catalog)
         # durable DDL (reference: procedure manager + loader registration,

@@ -153,7 +153,20 @@ class StatementExecutor:
             raise TableAlreadyExistsError(
                 f"table {table_name!r} already exists")
         schema, pk_indices = build_schema_from_create(stmt)
-        engine = self.engine_for(stmt.engine)
+        # CREATE EXTERNAL TABLE routes to the file engine (reference:
+        # file-table-engine; immutable, single-step — no procedure)
+        engine_name = "file" if stmt.external else stmt.engine
+        engine = self.engine_for(engine_name)
+        if stmt.external:
+            table = engine.create_table(CreateTableRequest(
+                table_name, schema, catalog_name=catalog,
+                schema_name=schema_name,
+                primary_key_indices=pk_indices,
+                create_if_not_exists=stmt.if_not_exists,
+                table_options=dict(stmt.options)))
+            self.catalog.register_table(catalog, schema_name, table_name,
+                                        table)
+            return Output.rows(0)
         request = CreateTableRequest(
             table_name, schema, catalog_name=catalog,
             schema_name=schema_name, primary_key_indices=pk_indices,

@@ -1,0 +1,112 @@
+"""file-table-engine tests: immutable external CSV/JSON/Parquet tables.
+
+Mirrors the reference's immutable-engine tests
+(src/file-table-engine/src/engine/immutable.rs: create/open/drop/scan,
+insert rejection) plus the SQL surface (CREATE EXTERNAL TABLE).
+"""
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import InvalidArgumentsError, UnsupportedError
+from greptimedb_tpu.frontend.instance import FrontendInstance
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path / "d"),
+                                          register_numbers_table=False))
+    dn.start()
+    f = FrontendInstance(dn)
+    f.start()
+    yield f
+    f.shutdown()
+
+
+def _write_parquet(fe, key="ext/data.parquet"):
+    table = pa.table({
+        "ts": pa.array([1000, 2000, 3000], pa.timestamp("ms")),
+        "host": ["a", "b", "a"],
+        "v": [1.5, 2.5, 3.5]})
+    import io
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    fe.datanode.store.write(key, buf.getvalue())
+    return key
+
+
+def _write_csv(fe, key="ext/data.csv"):
+    fe.datanode.store.write(key, b"ts,host,v\n1,a,1.5\n2,b,2.5\n")
+    return key
+
+
+class TestExternalTables:
+    def test_parquet_declared_schema(self, fe):
+        _write_parquet(fe)
+        fe.do_query("CREATE EXTERNAL TABLE logs (ts TIMESTAMP TIME INDEX,"
+                    " host STRING, v DOUBLE)"
+                    " WITH (location='ext/data.parquet')")
+        out = fe.do_query("SELECT host, sum(v) AS s FROM logs"
+                          " GROUP BY host ORDER BY host")[-1]
+        rows = [tuple(r) for b in out.batches for r in b.rows()]
+        assert rows == [("a", 5.0), ("b", 2.5)]
+
+    def test_csv_schema_inference(self, fe):
+        _write_csv(fe)
+        fe.do_query("CREATE EXTERNAL TABLE c WITH"
+                    " (location='ext/data.csv', format='csv')")
+        out = fe.do_query("SELECT count(*) FROM c")[-1]
+        assert next(out.batches[0].rows())[0] == 2
+
+    def test_insert_rejected(self, fe):
+        _write_csv(fe)
+        fe.do_query("CREATE EXTERNAL TABLE imm WITH"
+                    " (location='ext/data.csv', format='csv')")
+        with pytest.raises(UnsupportedError, match="insert"):
+            fe.do_query("INSERT INTO imm VALUES (3, 'c', 3.5)")
+
+    def test_survives_restart(self, fe, tmp_path):
+        _write_parquet(fe)
+        fe.do_query("CREATE EXTERNAL TABLE persisted (ts TIMESTAMP TIME"
+                    " INDEX, host STRING, v DOUBLE)"
+                    " WITH (location='ext/data.parquet')")
+        fe.shutdown()
+        dn2 = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "d"), register_numbers_table=False))
+        dn2.start()
+        fe2 = FrontendInstance(dn2)
+        fe2.start()
+        out = fe2.do_query("SELECT count(*) FROM persisted")[-1]
+        assert next(out.batches[0].rows())[0] == 3
+        fe2.shutdown()
+
+    def test_drop_keeps_data_file(self, fe):
+        key = _write_csv(fe)
+        fe.do_query("CREATE EXTERNAL TABLE dropme WITH"
+                    " (location='ext/data.csv', format='csv')")
+        fe.do_query("DROP TABLE dropme")
+        assert fe.catalog.table("greptime", "public", "dropme") is None
+        assert fe.datanode.store.exists(key)     # data is not ours
+
+    def test_missing_location_errors(self, fe):
+        with pytest.raises(InvalidArgumentsError, match="location"):
+            fe.do_query("CREATE EXTERNAL TABLE nowhere (ts TIMESTAMP"
+                        " TIME INDEX, v DOUBLE) WITH (format='csv')")
+
+    def test_missing_declared_column_errors(self, fe):
+        _write_csv(fe)
+        fe.do_query("CREATE EXTERNAL TABLE misdeclared (ts TIMESTAMP"
+                    " TIME INDEX, nope DOUBLE)"
+                    " WITH (location='ext/data.csv', format='csv')")
+        with pytest.raises(InvalidArgumentsError, match="nope"):
+            fe.do_query("SELECT * FROM misdeclared")
+
+    def test_show_tables_includes_external(self, fe):
+        _write_csv(fe)
+        fe.do_query("CREATE EXTERNAL TABLE shown WITH"
+                    " (location='ext/data.csv', format='csv')")
+        out = fe.do_query("SHOW TABLES")[-1]
+        names = [r[0] for b in out.batches for r in b.rows()]
+        assert "shown" in names
