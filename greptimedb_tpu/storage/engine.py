@@ -24,6 +24,7 @@ class EngineConfig:
     data_home: str
     flush_size_bytes: int = 64 * 1024 * 1024
     wal_sync_on_write: bool = False
+    wal_backend: str = "auto"           # auto | native | python
     disable_wal: bool = False           # benchmarks / ephemeral regions
     checkpoint_margin: int = 10
     row_group_size: int = 65536
@@ -82,7 +83,9 @@ class StorageEngine:
             purger=self.purger,
             ttl_ms=self.config.ttl_ms,
             max_l0_files=self.config.max_l0_files,
-            compaction_time_window_ms=self.config.compaction_time_window_ms)
+            compaction_time_window_ms=self.config.compaction_time_window_ms,
+            wal_opts={"sync_on_write": self.config.wal_sync_on_write,
+                      "backend": self.config.wal_backend})
         if self.config.disable_wal:
             kwargs["wal"] = NoopWal()
         if opts:

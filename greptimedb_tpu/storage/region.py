@@ -191,7 +191,8 @@ class Region:
                  ttl_ms: Optional[int] = None,
                  compaction_time_window_ms: Optional[int] = None,
                  max_l0_files: int = 4,
-                 stall_bytes: Optional[int] = None):
+                 stall_bytes: Optional[int] = None,
+                 wal_opts: Optional[dict] = None):
         self.descriptor = descriptor
         self.name = descriptor.name
         # unique per in-process region object: cache keys must not collide
@@ -217,7 +218,13 @@ class Region:
         # than superseded — incremental scan caches must rebuild then
         self.retraction_epoch = 0
         self._writer_lock = threading.RLock()
-        self.wal = wal if wal is not None else Wal(descriptor.wal_dir)
+        if wal is not None:
+            self.wal = wal
+        else:
+            # native group-commit WAL when the toolchain allows, Python
+            # twin otherwise (same on-disk format either way)
+            from .native_wal import make_wal
+            self.wal = make_wal(descriptor.wal_dir, **(wal_opts or {}))
         self.manifest = RegionManifest(
             store, f"{descriptor.region_dir}/manifest",
             checkpoint_margin=checkpoint_margin)
