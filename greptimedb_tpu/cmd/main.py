@@ -30,6 +30,10 @@ class StandaloneOptions:
     #: [storage] table from the TOML: type=File|S3, bucket, endpoint,
     #: cache_path... (reference: ObjectStoreConfig, datanode.rs:126-204)
     storage: dict = field(default_factory=dict)
+    #: [tls] table: mode=disable|prefer|require + cert/key paths
+    #: (reference: TlsOption, servers/src/tls.rs)
+    tls: dict = field(default_factory=dict)
+    log_dir: Optional[str] = None
 
 
 def load_options(args) -> StandaloneOptions:
@@ -51,7 +55,10 @@ def load_options(args) -> StandaloneOptions:
         grpc = doc.get("grpc", {})
         opts.grpc_addr = grpc.get("addr", opts.grpc_addr)
         opts.enable_grpc = grpc.get("enable", True)
-        opts.log_level = doc.get("logging", {}).get("level", opts.log_level)
+        logging_doc = doc.get("logging", {})
+        opts.log_level = logging_doc.get("level", opts.log_level)
+        opts.log_dir = logging_doc.get("dir", opts.log_dir)
+        opts.tls = doc.get("tls", {})
     for name in ("data_home", "http_addr", "mysql_addr", "postgres_addr",
                  "grpc_addr", "user_provider"):
         v = getattr(args, name, None)
@@ -83,16 +90,22 @@ def build_servers(opts: StandaloneOptions):
         return host or "127.0.0.1", int(port or 0)
 
     servers = [HttpServer(fe, provider, opts.http_addr)]
+    ssl_context = None
+    if opts.tls:
+        from ..servers.tls import TlsOption
+        ssl_context = TlsOption.from_config(opts.tls).setup()
     if opts.enable_mysql:
         from ..servers.mysql import MysqlServer
         host, port = split_addr(opts.mysql_addr)
         servers.append(MysqlServer(fe, host=host, port=port,
-                                   user_provider=provider))
+                                   user_provider=provider,
+                                   ssl_context=ssl_context))
     if opts.enable_postgres:
         from ..servers.postgres import PostgresServer
         host, port = split_addr(opts.postgres_addr)
         servers.append(PostgresServer(fe, host=host, port=port,
-                                      user_provider=provider))
+                                      user_provider=provider,
+                                      ssl_context=ssl_context))
     if opts.enable_grpc:
         from ..servers.grpc import GrpcServer
         servers.append(GrpcServer(fe, provider, opts.grpc_addr))
@@ -101,9 +114,9 @@ def build_servers(opts: StandaloneOptions):
 
 def standalone_start(args) -> None:
     opts = load_options(args)
-    logging.basicConfig(
-        level=getattr(logging, opts.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from ..common.telemetry import init_logging, install_panic_hook
+    init_logging(opts.log_level, opts.log_dir)
+    install_panic_hook()
     fe, servers = build_servers(opts)
     for s in servers:
         s.start()

@@ -1,0 +1,68 @@
+"""Named runtimes: shared thread pools + repeated tasks.
+
+Reference behavior: src/common/runtime — named tokio runtimes with
+`spawn_bg/spawn_read/spawn_write` globals (global.rs) and `RepeatedTask`
+(repeated_task.rs). Python twin: three shared ThreadPoolExecutors sized
+for their roles; background storage jobs, scan fan-out, and protocol
+write handling each land on their own pool so a flood of one cannot
+starve the others.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Callable, Optional
+
+from ..storage.scheduler import RepeatedTask  # canonical impl, re-export
+
+__all__ = ["RepeatedTask", "spawn_bg", "spawn_read", "spawn_write",
+           "bg_runtime", "read_runtime", "write_runtime",
+           "shutdown_runtimes"]
+
+_lock = threading.Lock()
+_pools = {}
+
+_SIZES = {"bg": 4, "read": 8, "write": 8}
+
+
+def _pool(name: str) -> concurrent.futures.ThreadPoolExecutor:
+    with _lock:
+        pool = _pools.get(name)
+        if pool is None:
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=_SIZES[name],
+                thread_name_prefix=f"gdb-{name}")
+            _pools[name] = pool
+        return pool
+
+
+def bg_runtime() -> concurrent.futures.ThreadPoolExecutor:
+    return _pool("bg")
+
+
+def read_runtime() -> concurrent.futures.ThreadPoolExecutor:
+    return _pool("read")
+
+
+def write_runtime() -> concurrent.futures.ThreadPoolExecutor:
+    return _pool("write")
+
+
+def spawn_bg(fn: Callable, *args, **kwargs):
+    return bg_runtime().submit(fn, *args, **kwargs)
+
+
+def spawn_read(fn: Callable, *args, **kwargs):
+    return read_runtime().submit(fn, *args, **kwargs)
+
+
+def spawn_write(fn: Callable, *args, **kwargs):
+    return write_runtime().submit(fn, *args, **kwargs)
+
+
+def shutdown_runtimes(wait: bool = True) -> None:
+    with _lock:
+        pools, _pools_copy = dict(_pools), _pools.clear()
+    for pool in pools.values():
+        pool.shutdown(wait=wait)

@@ -1,0 +1,165 @@
+"""Telemetry: logging init, tracing spans, timer metrics.
+
+Reference behavior: src/common/telemetry — tracing-subscriber logging
+with rolling files + env filter (logging.rs:83-150), `timer!` macros
+feeding the metrics recorder (metric.rs, macros.rs), and a panic hook.
+Python twin:
+
+- `init_logging(level, dir)` — console + size-rotated file handlers.
+- `span(name, **attrs)` — nested tracing spans carried in a thread-local
+  (trace_id/span_id/parent), logged on exit with duration; the active
+  trace context rides log records via a logging.Filter.
+- `timer(name)` — histogram observation (prometheus_client, the same
+  registry the /metrics endpoint exports).
+- `install_panic_hook()` — top-level excepthook that logs crashes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import logging.handlers
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# logging init (reference: logging.rs init w/ rolling appenders)
+# ---------------------------------------------------------------------------
+
+_FORMAT = ("%(asctime)s %(levelname)s %(name)s "
+           "[%(trace_id)s/%(span_id)s] %(message)s")
+
+
+class _TraceContextFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        span = current_span()
+        record.trace_id = span["trace_id"] if span else "-"
+        record.span_id = span["span_id"] if span else "-"
+        return True
+
+
+def init_logging(level: str = "info", log_dir: Optional[str] = None,
+                 max_bytes: int = 64 * 1024 * 1024,
+                 backups: int = 4) -> None:
+    root = logging.getLogger()
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handlers = [logging.StreamHandler()]
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        handlers.append(logging.handlers.RotatingFileHandler(
+            os.path.join(log_dir, "greptimedb.log"),
+            maxBytes=max_bytes, backupCount=backups))
+    for h in handlers:
+        h.setFormatter(logging.Formatter(_FORMAT))
+        h.addFilter(_TraceContextFilter())
+        root.addHandler(h)
+
+
+def install_panic_hook() -> None:
+    """Log uncaught exceptions before dying (reference: panic_hook.rs)."""
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        logging.getLogger("panic").critical(
+            "uncaught exception", exc_info=(exc_type, exc, tb))
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+
+# ---------------------------------------------------------------------------
+# tracing spans
+# ---------------------------------------------------------------------------
+
+def current_span() -> Optional[Dict]:
+    stack = getattr(_tls, "spans", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[Dict]:
+    """Nested span: inherits trace_id from the parent, logs duration on
+    exit at DEBUG (the in-process analog of the Jaeger pipeline)."""
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    parent = stack[-1] if stack else None
+    s = {
+        "name": name,
+        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex[:16],
+        "span_id": uuid.uuid4().hex[:8],
+        "parent_id": parent["span_id"] if parent else None,
+        "attrs": attrs,
+        "start": time.perf_counter(),
+    }
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        stack.pop()
+        elapsed_ms = (time.perf_counter() - s["start"]) * 1e3
+        logger.debug("span %s finished in %.2fms attrs=%s", name,
+                     elapsed_ms, attrs)
+        _observe(f"span_{name}", elapsed_ms / 1e3)
+
+
+# ---------------------------------------------------------------------------
+# timer metrics (prometheus registry shared with /metrics)
+# ---------------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_histograms: Dict[str, object] = {}
+_counters: Dict[str, object] = {}
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _observe(name: str, seconds: float) -> None:
+    try:
+        from prometheus_client import Histogram
+    except ImportError:  # pragma: no cover
+        return
+    key = _sanitize(name)
+    with _metrics_lock:
+        h = _histograms.get(key)
+        if h is None:
+            h = Histogram(f"greptime_{key}_seconds", f"timer {name}")
+            _histograms[key] = h
+    h.observe(seconds)
+
+
+def increment_counter(name: str, value: int = 1) -> None:
+    try:
+        from prometheus_client import Counter
+    except ImportError:  # pragma: no cover
+        return
+    key = _sanitize(name)
+    with _metrics_lock:
+        c = _counters.get(key)
+        if c is None:
+            c = Counter(f"greptime_{key}_total", f"counter {name}")
+            _counters[key] = c
+    c.inc(value)
+
+
+@contextlib.contextmanager
+def timer(name: str) -> Iterator[None]:
+    """reference `timer!` macro: records elapsed seconds on exit."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _observe(name, time.perf_counter() - t0)

@@ -37,6 +37,8 @@ class FrontendInstance:
             procedure_manager=datanode.procedure_manager)
         self._tql_engine = None
         self.script_engine = None
+        from ..common.plugins import Plugins
+        self.plugins = Plugins()
 
     def start(self) -> None:
         if not self.datanode._started:
@@ -54,8 +56,27 @@ class FrontendInstance:
     def do_query(self, sql: str, ctx: Optional[QueryContext] = None
                  ) -> List[Output]:
         ctx = ctx or QueryContext()
+        interceptor = self._interceptor()
+        if interceptor is not None:
+            sql = interceptor.pre_parsing(sql, ctx)
         stmts = parse_statements(sql)
-        return [self.execute_stmt(s, ctx) for s in stmts]
+        if interceptor is not None:
+            stmts = interceptor.post_parsing(stmts, ctx)
+        outputs = []
+        for s in stmts:
+            if interceptor is not None:
+                interceptor.pre_execute(s, ctx)
+            out = self.execute_stmt(s, ctx)
+            if interceptor is not None:
+                out = interceptor.post_execute(out, ctx)
+            outputs.append(out)
+        return outputs
+
+    def _interceptor(self):
+        """Plugin chain hook (reference: SqlQueryInterceptor consulted by
+        every protocol frontend, src/servers/src/interceptor.rs:26)."""
+        from ..servers.interceptor import SqlQueryInterceptor
+        return self.plugins.get(SqlQueryInterceptor)
 
     def execute_stmt(self, stmt: ast.Statement, ctx: QueryContext) -> Output:
         ex = self.statement_executor

@@ -84,6 +84,7 @@ class HttpServer:
         r.add_post("/v1/admin/compact", self.handle_compact)
         r.add_post("/v1/scripts", self.handle_scripts)
         r.add_post("/v1/run-script", self.handle_run_script)
+        r.add_get("/v1/prof/mem", self.handle_mem_prof)
         r.add_route("*", "/api/v1/query", self.handle_prom_api_query)
         r.add_route("*", "/api/v1/query_range", self.handle_prom_api_range)
         r.add_route("*", "/api/v1/labels", self.handle_prom_api_labels)
@@ -363,6 +364,23 @@ class HttpServer:
                 if val is not None:
                     s.samples.append((float(val), int(row[ts_name])))
         return list(by_series.values())
+
+    async def handle_mem_prof(self, request):
+        """Heap profile dump (reference: jemalloc /v1/prof/mem,
+        src/common/mem-prof; here a tracemalloc top-N snapshot). The
+        first call enables tracing — subsequent calls diff against it."""
+        import tracemalloc
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return web.Response(
+                text="tracemalloc started; call again for a snapshot\n")
+        snapshot = tracemalloc.take_snapshot()
+        top = snapshot.statistics("lineno")[:50]
+        lines = [f"{stat.size / 1024:.1f} KiB in {stat.count} blocks: "
+                 f"{stat.traceback}" for stat in top]
+        total = sum(s.size for s in snapshot.statistics("filename"))
+        lines.insert(0, f"total traced: {total / 1048576:.2f} MiB")
+        return web.Response(text="\n".join(lines) + "\n")
 
     async def handle_metrics(self, request):
         try:

@@ -1,0 +1,191 @@
+"""Auxiliary subsystem tests: telemetry, runtime, plugins, interceptors,
+TLS, mem-prof.
+
+Reference counterparts: common-telemetry (logging/tracing/timer),
+common-runtime (named pools, RepeatedTask), common-base Plugins,
+servers interceptor.rs, servers tls.rs, common-mem-prof.
+"""
+
+import logging
+import socket
+import ssl
+import struct
+import time
+
+import pytest
+
+from greptimedb_tpu.common.plugins import Plugins
+from greptimedb_tpu.common.runtime import (
+    RepeatedTask, spawn_bg, spawn_read, spawn_write)
+from greptimedb_tpu.common.telemetry import (
+    current_span, span, timer)
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.frontend.instance import FrontendInstance
+from greptimedb_tpu.servers.interceptor import (
+    InterceptorChain, SqlQueryInterceptor)
+from greptimedb_tpu.servers.tls import TlsOption, make_self_signed
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path / "d"),
+                                          register_numbers_table=False))
+    dn.start()
+    f = FrontendInstance(dn)
+    f.start()
+    yield f
+    f.shutdown()
+
+
+class TestRuntime:
+    def test_named_pools(self):
+        assert spawn_bg(lambda: 1 + 1).result() == 2
+        assert spawn_read(lambda: "r").result() == "r"
+        assert spawn_write(lambda: "w").result() == "w"
+
+    def test_repeated_task(self):
+        hits = []
+        t = RepeatedTask(0.01, lambda: hits.append(1), name="tick")
+        t.start()
+        time.sleep(0.08)
+        t.stop()
+        n = len(hits)
+        assert n >= 2
+        time.sleep(0.05)
+        assert len(hits) == n            # stopped means stopped
+
+
+class TestTelemetry:
+    def test_nested_spans_share_trace(self):
+        with span("outer") as outer:
+            assert current_span() is outer
+            with span("inner", table="t") as inner:
+                assert inner["trace_id"] == outer["trace_id"]
+                assert inner["parent_id"] == outer["span_id"]
+            assert current_span() is outer
+        assert current_span() is None
+
+    def test_timer_records(self):
+        with timer("unit_test_timer"):
+            time.sleep(0.002)
+        from greptimedb_tpu.common.telemetry import _histograms
+        assert "unit_test_timer" in _histograms
+
+
+class TestPlugins:
+    def test_insert_get(self):
+        p = Plugins()
+
+        class Thing:
+            pass
+
+        t = Thing()
+        p.insert(t)
+        assert p.get(Thing) is t
+        assert Thing in p
+
+    def test_subclass_lookup(self):
+        p = Plugins()
+        chain = InterceptorChain()
+        p.insert(chain)
+        assert p.get(SqlQueryInterceptor) is chain
+
+
+class TestInterceptors:
+    def test_rewrite_and_audit(self, fe):
+        audit = []
+
+        class Audit(SqlQueryInterceptor):
+            def pre_parsing(self, sql, ctx):
+                audit.append(sql)
+                return sql.replace("__TABLE__", "real_table")
+
+            def pre_execute(self, stmt, ctx):
+                audit.append(type(stmt).__name__)
+
+        fe.plugins.insert(InterceptorChain([Audit()]))
+        fe.do_query("CREATE TABLE real_table (ts TIMESTAMP TIME INDEX,"
+                    " v DOUBLE)")
+        fe.do_query("SELECT count(*) FROM __TABLE__")
+        assert "SELECT count(*) FROM __TABLE__" in audit
+        assert "Query" in audit
+
+    def test_rejecting_interceptor(self, fe):
+        class DenyDrops(SqlQueryInterceptor):
+            def pre_execute(self, stmt, ctx):
+                from greptimedb_tpu.sql import ast
+                if isinstance(stmt, ast.DropTable):
+                    raise PermissionError("drops are disabled")
+
+        fe.plugins.insert(InterceptorChain([DenyDrops()]))
+        fe.do_query("CREATE TABLE keepme (ts TIMESTAMP TIME INDEX,"
+                    " v DOUBLE)")
+        with pytest.raises(PermissionError):
+            fe.do_query("DROP TABLE keepme")
+        assert fe.catalog.table("greptime", "public", "keepme") is not None
+
+
+class TestTls:
+    def test_disable_mode(self):
+        assert TlsOption("disable").setup() is None
+
+    def test_require_needs_paths(self):
+        with pytest.raises(ValueError):
+            TlsOption("require").setup()
+
+    def test_postgres_tls_upgrade(self, fe, tmp_path):
+        """PG SSLRequest → 'S' → TLS handshake → normal query flow
+        (reference: tls.rs + postgres startup)."""
+        from greptimedb_tpu.servers.postgres import PostgresServer
+        cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+        make_self_signed(cert, key)
+        ctx = TlsOption("require", cert, key).setup()
+        srv = PostgresServer(fe, ssl_context=ctx)
+        srv.serve_in_background()
+        raw = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        raw.sendall(struct.pack("!II", 8, 80877103))     # SSLRequest
+        assert raw.recv(1) == b"S"
+        client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        tls_sock = client_ctx.wrap_socket(raw)
+        body = struct.pack("!I", 196608) + b"user\x00u\x00\x00"
+        tls_sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        # AuthenticationOk arrives over the encrypted channel
+        head = tls_sock.recv(5)
+        assert head[0:1] == b"R"
+        tls_sock.close()
+        srv.shutdown()
+
+    def test_mysql_no_ssl_advertised_without_context(self, fe):
+        from greptimedb_tpu.servers.mysql import CLIENT_SSL, MysqlServer
+        srv = MysqlServer(fe)
+        srv.serve_in_background()
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        header = sock.recv(4)
+        length = int.from_bytes(header[:3], "little")
+        greeting = sock.recv(length)
+        end = greeting.index(b"\x00", 1)
+        caps_lo = struct.unpack_from(
+            "<H", greeting, end + 1 + 4 + 8 + 1)[0]
+        assert not (caps_lo & CLIENT_SSL)
+        sock.close()
+        srv.shutdown()
+
+
+class TestMemProf:
+    def test_mem_prof_route(self, fe):
+        import urllib.request
+        from greptimedb_tpu.servers.auth import NoopUserProvider
+        from greptimedb_tpu.servers.http import HttpServer
+        srv = HttpServer(fe, NoopUserProvider(), "127.0.0.1:0")
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}/v1/prof/mem"
+        first = urllib.request.urlopen(base).read().decode()
+        assert "tracemalloc" in first or "total traced" in first
+        second = urllib.request.urlopen(base).read().decode()
+        assert "total traced" in second
+        srv.shutdown()
+        import tracemalloc
+        tracemalloc.stop()
