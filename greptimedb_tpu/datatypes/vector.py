@@ -224,3 +224,19 @@ class Vector:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Vector<{self.dtype.name}>[{len(self)}]"
+
+
+def compat_column(col_schema, n: int):
+    """(data, validity) for a column absent from an old run/SST: filled
+    from the column's DEFAULT constraint, else nulls (reference: schema
+    read-compat matrices, src/storage/src/schema/compat.rs:611 — readers
+    adapt old files to the current schema by synthesizing added columns).
+    Raises for a non-nullable column with no default: the file is
+    genuinely incompatible."""
+    vec = col_schema.create_default_vector(n)
+    if vec is None:
+        from ..errors import StorageError
+        raise StorageError(
+            f"column {col_schema.name!r} is non-nullable with no default; "
+            f"cannot read data written before it was added")
+    return vec.data, vec.validity

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
-from ..datatypes.vector import null_column
+from ..datatypes.vector import compat_column, null_column
 from ..errors import StorageError
 from .memtable import Memtable, MemtableSnapshot, MemtableVersion
 from .manifest import RegionManifest
@@ -108,8 +108,8 @@ class RegionSnapshot:
                     data, valid = snap.fields[name]
                     fields[name] = (data[sel], valid[sel] if valid is not None else None)
                 else:  # column added after this memtable was created
-                    fields[name] = null_column(
-                        schema.column_schema(name).dtype, int(sel.sum()))
+                    fields[name] = compat_column(
+                        schema.column_schema(name), int(sel.sum()))
             runs.append((snap.series_ids[sel], snap.ts[sel], snap.seq[sel],
                          snap.op_types[sel], fields))
         # SSTs (row-group pruned)

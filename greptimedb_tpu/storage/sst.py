@@ -24,7 +24,7 @@ import pyarrow.parquet as pq
 
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
-from ..datatypes.vector import null_column
+from ..datatypes.vector import compat_column, null_column
 from .object_store import ObjectStore
 
 SERIES_COL = "__series_id"
@@ -206,11 +206,23 @@ class AccessLayer:
         op = np.asarray(table.column(OP_COL))
         fields = {}
         for name in field_names:
+            cs = self.schema.column_schema(name)
             if name in missing:
-                fields[name] = null_column(
-                    self.schema.column_schema(name).dtype, table.num_rows)
+                # added after this SST was written: default-fill
+                fields[name] = compat_column(cs, table.num_rows)
                 continue
-            vec = Vector.from_arrow(table.column(name))
+            col = table.column(name)
+            want = cs.dtype.pa_type
+            if want is not None and col.type != want:
+                # dropped + re-added under a different type (the reference
+                # disambiguates by column id, compat.rs): cast when the
+                # values convert, otherwise treat as a fresh column
+                try:
+                    col = col.cast(want)
+                except pa.ArrowInvalid:
+                    fields[name] = compat_column(cs, table.num_rows)
+                    continue
+            vec = Vector.from_arrow(col)
             fields[name] = (vec.data, vec.validity)
         return SstData(sids.astype(np.int32), ts.astype(np.int64),
                        seq.astype(np.int64), op.astype(np.int8),
