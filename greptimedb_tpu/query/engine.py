@@ -109,9 +109,9 @@ class QueryEngine:
 
     # ---- SELECT ----
     def execute_query(self, query: Query, ctx: QueryContext) -> Output:
-        if query.joins:
-            raise UnsupportedError("JOIN is not supported yet")
         a = analyze(query)
+        if query.joins:
+            return self._execute_join(query, a, ctx)
 
         table: Optional[Table] = None
         if query.from_ is not None:
@@ -149,6 +149,92 @@ class QueryEngine:
         batches = table.scan_batches(projection=needed)
         df = _batches_to_df(batches)
         return self._run_on_frame(df, a, query, table)
+
+    # ---- joins (CPU fallback; reference delegates to DataFusion's
+    # hash joins, src/query/src/datafusion.rs) ----
+    def _execute_join(self, query: Query, a: Analysis,
+                      ctx: QueryContext) -> Output:
+        from ..sql.ast import BinaryOp as B
+
+        sources = [query.from_] + [j.table for j in query.joins]
+        frames: List[pd.DataFrame] = []
+        aliases: List[str] = []
+        for ref in sources:
+            if ref.subquery is not None:
+                inner = self.execute_query(ref.subquery, ctx)
+                df = _batches_to_df(inner.batches)
+                alias = ref.alias or f"_sub{len(aliases)}"
+            else:
+                table = self.resolve_table(ref, ctx)
+                df = _batches_to_df(table.scan_batches())
+                alias = ref.alias or ref.name.table
+            frames.append(df.rename(
+                columns={c: f"{alias}.{c}" for c in df.columns}))
+            aliases.append(alias)
+
+        def resolve_label(col: Column, columns) -> str:
+            if col.table is not None:
+                cand = f"{col.table}.{col.name}"
+                if cand in columns:
+                    return cand
+                raise PlanError(f"column {cand!r} not found in join")
+            matches = [c for c in columns if c.endswith(f".{col.name}")]
+            if len(matches) == 1:
+                return matches[0]
+            if not matches:
+                raise PlanError(f"column {col.name!r} not found in join")
+            raise PlanError(f"column {col.name!r} is ambiguous: {matches}")
+
+        joined = frames[0]
+        for j, right in zip(query.joins, frames[1:]):
+            if j.kind == "cross" or j.on is None:
+                if j.kind != "cross" and j.on is None:
+                    raise PlanError(f"{j.kind} JOIN requires ON")
+                joined = joined.merge(right, how="cross")
+                continue
+            left_on, right_on, residual = [], [], []
+            for c in _conjunct_list(j.on):
+                ok = (isinstance(c, B) and c.op == "=" and
+                      isinstance(c.left, Column) and
+                      isinstance(c.right, Column))
+                if ok:
+                    l, r = c.left, c.right
+                    try:
+                        ll = resolve_label(l, joined.columns)
+                        rl = resolve_label(r, right.columns)
+                    except PlanError:
+                        ll = resolve_label(r, joined.columns)
+                        rl = resolve_label(l, right.columns)
+                    left_on.append(ll)
+                    right_on.append(rl)
+                else:
+                    residual.append(c)
+            if not left_on:
+                raise UnsupportedError(
+                    "JOIN ON must contain at least one equality between "
+                    "the joined tables")
+            if residual and j.kind != "inner":
+                raise UnsupportedError(
+                    "non-equi conditions are only supported on INNER JOIN")
+            joined = joined.merge(right, how=j.kind, left_on=left_on,
+                                  right_on=right_on)
+            for c in residual:
+                ev = Evaluator(joined)
+                mask = ev.eval(_qualify_columns(c, joined.columns))
+                if isinstance(mask, pd.Series):
+                    joined = joined[mask.fillna(False).astype(bool)]
+                elif not mask:
+                    joined = joined.iloc[0:0]
+
+        # plain names for columns unique across sources (SELECT host, ...)
+        plain_counts: Dict[str, int] = {}
+        for c in joined.columns:
+            plain = c.split(".", 1)[1] if "." in c else c
+            plain_counts[plain] = plain_counts.get(plain, 0) + 1
+        renames = {c: c.split(".", 1)[1] for c in joined.columns
+                   if "." in c and plain_counts[c.split(".", 1)[1]] == 1}
+        joined = joined.rename(columns=renames)
+        return self._run_on_frame(joined, a, query, None)
 
     def _needs_all(self, a: Analysis, query: Query) -> bool:
         return any(isinstance(p.expr, Star) for p in query.projections)
@@ -268,6 +354,12 @@ class QueryEngine:
             if aggregated and isinstance(item.expr, Column) and \
                     item.expr.name.startswith("__key__"):
                 name = item.alias or item.expr.name[len("__key__"):]
+            if name in out_cols:
+                # self-join shape: SELECT l.host, r.host — qualify the
+                # collision (pandas frames cannot carry duplicate labels)
+                qualified = str(item.expr)
+                name = qualified if qualified not in out_cols \
+                    else f"{name}_{len(out_names)}"
             override = _result_dtype_override(item.expr, a, table)
             if override is not None:
                 dtype_overrides[name] = override
@@ -323,6 +415,51 @@ class QueryEngine:
 
         schema = _infer_schema(proj, table, source_cols, dtype_overrides)
         return Output.record_batches([_df_to_batch(proj, schema)], schema)
+
+
+def _conjunct_list(e):
+    from ..sql.ast import BinaryOp
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return _conjunct_list(e.left) + _conjunct_list(e.right)
+    return [e]
+
+
+def _qualify_columns(e, columns):
+    """Rewrite unqualified Columns to the (unique) qualified join label so
+    residual ON conditions evaluate against the merged frame."""
+    import dataclasses
+
+    from ..sql.ast import Between, BinaryOp, FunctionCall, InList, UnaryOp
+    if isinstance(e, Column):
+        if e.table is not None:
+            return Column(f"{e.table}.{e.name}") \
+                if f"{e.table}.{e.name}" in columns else e
+        matches = [c for c in columns if c.endswith(f".{e.name}")]
+        if len(matches) == 1:
+            return Column(matches[0])
+        if len(matches) > 1:
+            raise PlanError(f"column {e.name!r} is ambiguous: {matches}")
+        return e
+    if isinstance(e, BinaryOp):
+        return dataclasses.replace(
+            e, left=_qualify_columns(e.left, columns),
+            right=_qualify_columns(e.right, columns))
+    if isinstance(e, UnaryOp):
+        return dataclasses.replace(
+            e, operand=_qualify_columns(e.operand, columns))
+    if isinstance(e, FunctionCall):
+        return dataclasses.replace(
+            e, args=[_qualify_columns(x, columns) for x in e.args])
+    if isinstance(e, Between):
+        return dataclasses.replace(
+            e, expr=_qualify_columns(e.expr, columns),
+            low=_qualify_columns(e.low, columns),
+            high=_qualify_columns(e.high, columns))
+    if isinstance(e, InList):
+        return dataclasses.replace(
+            e, expr=_qualify_columns(e.expr, columns),
+            items=[_qualify_columns(x, columns) for x in e.items])
+    return e
 
 
 # ---------------------------------------------------------------------------

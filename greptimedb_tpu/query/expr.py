@@ -78,6 +78,9 @@ class Evaluator:
             return e.value
         if isinstance(e, Column):
             key = e.name
+            if e.table and f"{e.table}.{e.name}" in self.df.columns:
+                # joined frames carry alias-qualified columns
+                return self.df[f"{e.table}.{e.name}"]
             if key not in self.df.columns:
                 # case-insensitive fallback (MySQL compat)
                 lowered = {c.lower(): c for c in self.df.columns}

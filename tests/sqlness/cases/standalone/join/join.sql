@@ -1,0 +1,19 @@
+CREATE TABLE m (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1000, 1.0), ('b', 2000, 2.0), ('c', 3000, 3.0);
+
+CREATE TABLE info (host STRING, ts TIMESTAMP TIME INDEX, dc STRING, PRIMARY KEY(host));
+
+INSERT INTO info VALUES ('a', 1, 'east'), ('b', 1, 'west'), ('d', 1, 'eu');
+
+SELECT m.host, cpu, dc FROM m JOIN info ON m.host = info.host ORDER BY m.host;
+
+SELECT m.host, dc FROM m LEFT JOIN info ON m.host = info.host ORDER BY m.host;
+
+SELECT dc, sum(cpu) FROM m JOIN info ON m.host = info.host GROUP BY dc ORDER BY dc;
+
+SELECT count(*) FROM m CROSS JOIN info;
+
+DROP TABLE m;
+
+DROP TABLE info;

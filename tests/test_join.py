@@ -1,0 +1,96 @@
+"""JOIN execution tests (CPU fallback path).
+
+Reference: joins are DataFusion territory (src/query/src/datafusion.rs);
+coverage mirrors typical sqlness join cases — inner/left/right/cross,
+multi-key ON, qualified + aliased columns, join + aggregate.
+"""
+
+import pytest
+
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import PlanError, UnsupportedError
+from greptimedb_tpu.frontend.instance import FrontendInstance
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path / "d"),
+                                          register_numbers_table=False))
+    dn.start()
+    f = FrontendInstance(dn)
+    f.start()
+    f.do_query("CREATE TABLE metrics (host STRING, ts TIMESTAMP TIME"
+               " INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+    f.do_query("INSERT INTO metrics VALUES ('a', 1000, 1.0),"
+               " ('b', 2000, 2.0), ('c', 3000, 3.0)")
+    f.do_query("CREATE TABLE meta (host STRING, ts TIMESTAMP TIME INDEX,"
+               " dc STRING, PRIMARY KEY(host))")
+    f.do_query("INSERT INTO meta VALUES ('a', 1, 'us-east'),"
+               " ('b', 1, 'us-west'), ('d', 1, 'eu-1')")
+    yield f
+    f.shutdown()
+
+
+def _rows(fe, sql):
+    out = fe.do_query(sql)[-1]
+    return [tuple(r) for b in out.batches for r in b.rows()]
+
+
+class TestJoins:
+    def test_inner_join(self, fe):
+        rows = _rows(fe, "SELECT metrics.host, cpu, dc FROM metrics"
+                         " JOIN meta ON metrics.host = meta.host"
+                         " ORDER BY metrics.host")
+        assert rows == [("a", 1.0, "us-east"), ("b", 2.0, "us-west")]
+
+    def test_left_join_keeps_unmatched(self, fe):
+        rows = _rows(fe, "SELECT metrics.host, dc FROM metrics"
+                         " LEFT JOIN meta ON metrics.host = meta.host"
+                         " ORDER BY metrics.host")
+        assert rows == [("a", "us-east"), ("b", "us-west"), ("c", None)]
+
+    def test_right_join(self, fe):
+        rows = _rows(fe, "SELECT meta.host, cpu FROM metrics"
+                         " RIGHT JOIN meta ON metrics.host = meta.host"
+                         " ORDER BY meta.host")
+        assert rows == [("a", 1.0), ("b", 2.0), ("d", None)]
+
+    def test_cross_join(self, fe):
+        rows = _rows(fe, "SELECT count(*) FROM metrics CROSS JOIN meta")
+        assert rows == [(9,)]
+
+    def test_aliased_self_join(self, fe):
+        rows = _rows(fe, "SELECT l.host, r.host FROM metrics l"
+                         " JOIN metrics r ON l.host = r.host"
+                         " ORDER BY l.host")
+        assert rows == [("a", "a"), ("b", "b"), ("c", "c")]
+
+    def test_join_with_where_and_aggregate(self, fe):
+        rows = _rows(fe, "SELECT dc, sum(cpu) AS s FROM metrics"
+                         " JOIN meta ON metrics.host = meta.host"
+                         " WHERE cpu > 0.5 GROUP BY dc ORDER BY dc")
+        assert rows == [("us-east", 1.0), ("us-west", 2.0)]
+
+    def test_non_equi_inner_residual(self, fe):
+        rows = _rows(fe, "SELECT metrics.host FROM metrics JOIN meta"
+                         " ON metrics.host = meta.host AND cpu > 1.5")
+        assert rows == [("b",)]
+
+    def test_join_requires_equality(self, fe):
+        with pytest.raises(UnsupportedError, match="equality"):
+            fe.do_query("SELECT 1 FROM metrics JOIN meta"
+                        " ON metrics.cpu > 1")
+
+    def test_ambiguous_projection_rejected(self, fe):
+        from greptimedb_tpu.errors import ColumnNotFoundError
+        with pytest.raises(ColumnNotFoundError):
+            # 'host' exists on both sides of a self-join: unresolvable
+            fe.do_query("SELECT host FROM metrics l"
+                        " JOIN metrics r ON l.host = r.host")
+
+    def test_join_subquery(self, fe):
+        rows = _rows(fe, "SELECT m.host, t.c FROM metrics m JOIN"
+                         " (SELECT host, count(*) AS c FROM meta"
+                         "  GROUP BY host) t ON m.host = t.host"
+                         " ORDER BY m.host")
+        assert rows == [("a", 1), ("b", 1)]
