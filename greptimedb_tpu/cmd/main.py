@@ -137,6 +137,107 @@ def standalone_start(args) -> None:
     fe.shutdown()
 
 
+def _block_until_signal(on_shutdown) -> None:
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    on_shutdown()
+
+
+def metasrv_start(args) -> None:
+    """Run the metadata server role (reference: greptime metasrv start;
+    etcd is replaced by a file-backed KV snapshot)."""
+    from ..common.telemetry import init_logging
+    from ..meta import MetaSrv
+    from ..meta.flight import FlightMetaServer
+    from ..meta.kv import FileKv, MemKv
+
+    init_logging(args.log_level or "info")
+    kv = FileKv(args.store) if args.store else MemKv()
+    srv = MetaSrv(kv)
+    server = FlightMetaServer(srv, f"grpc://{args.bind_addr}")
+    server.serve_in_background()
+    logging.info("metasrv ready on %s", server.address)
+    _block_until_signal(server.shutdown)
+
+
+def datanode_start(args) -> None:
+    """Run a region-hosting worker: Flight data plane + meta heartbeats
+    (reference: greptime datanode start)."""
+    from ..common.telemetry import init_logging
+    from ..datanode import DatanodeInstance, DatanodeOptions
+    from ..meta import Peer
+    from ..meta.flight import FlightMetaClient
+    from ..servers.flight import FlightDatanodeServer
+
+    init_logging(args.log_level or "info")
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=args.data_home or "./greptimedb_data",
+        node_id=args.node_id, register_numbers_table=False))
+    dn.start()
+    server = FlightDatanodeServer(dn, f"grpc://{args.rpc_addr}")
+    server.serve_in_background()
+    meta = FlightMetaClient(f"grpc://{args.metasrv_addr}")
+    meta.register(Peer(args.node_id, server.address))
+    dn.start_heartbeat(meta, interval_s=args.heartbeat_interval)
+    logging.info("datanode %d ready on %s (meta %s)", args.node_id,
+                 server.address, args.metasrv_addr)
+
+    def shutdown():
+        server.shutdown()
+        dn.shutdown()
+        meta.close()
+
+    _block_until_signal(shutdown)
+
+
+def frontend_start(args) -> None:
+    """Run the stateless router role: SQL over HTTP/MySQL/Postgres/Flight
+    against datanodes resolved through the meta service (reference:
+    greptime frontend start)."""
+    from ..common.telemetry import init_logging
+    from ..frontend.distributed import DistInstance
+    from ..meta.flight import FlightMetaClient, PeerClientRegistry
+    from ..servers.flight import FlightFrontendServer
+    from ..servers.http import HttpServer
+    from ..servers.auth import NoopUserProvider
+
+    init_logging(args.log_level or "info")
+    meta = FlightMetaClient(f"grpc://{args.metasrv_addr}")
+    clients = PeerClientRegistry(meta)
+    fe = DistInstance(meta, clients)
+    servers = [HttpServer(fe, NoopUserProvider(), args.http_addr)]
+    if args.mysql_addr:
+        from ..servers.mysql import MysqlServer
+        host, _, port = args.mysql_addr.partition(":")
+        servers.append(MysqlServer(fe, host=host or "127.0.0.1",
+                                   port=int(port or 0)))
+    if args.postgres_addr:
+        from ..servers.postgres import PostgresServer
+        host, _, port = args.postgres_addr.partition(":")
+        servers.append(PostgresServer(fe, host=host or "127.0.0.1",
+                                      port=int(port or 0)))
+    if args.grpc_addr:
+        servers.append(FlightFrontendServer(fe,
+                                            f"grpc://{args.grpc_addr}"))
+    for s in servers:
+        s.serve_in_background() if hasattr(s, "serve_in_background")             else s.start()
+    logging.info("frontend ready (http %s, meta %s)", args.http_addr,
+                 args.metasrv_addr)
+
+    def shutdown():
+        for s in servers:
+            s.shutdown()
+        meta.close()
+
+    _block_until_signal(shutdown)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="greptime", description="greptimedb_tpu CLI")
@@ -153,6 +254,36 @@ def main(argv=None) -> int:
     start.add_argument("--grpc-addr")
     start.add_argument("--user-provider")
     start.set_defaults(func=standalone_start)
+
+    metasrv = sub.add_parser("metasrv")
+    msub = metasrv.add_subparsers(dest="action", required=True)
+    mstart = msub.add_parser("start")
+    mstart.add_argument("--bind-addr", default="127.0.0.1:3002")
+    mstart.add_argument("--store", help="path for the file-backed KV")
+    mstart.add_argument("--log-level")
+    mstart.set_defaults(func=metasrv_start)
+
+    datanode = sub.add_parser("datanode")
+    dsub = datanode.add_subparsers(dest="action", required=True)
+    dstart = dsub.add_parser("start")
+    dstart.add_argument("--node-id", type=int, required=True)
+    dstart.add_argument("--rpc-addr", default="127.0.0.1:0")
+    dstart.add_argument("--metasrv-addr", default="127.0.0.1:3002")
+    dstart.add_argument("--data-home")
+    dstart.add_argument("--heartbeat-interval", type=float, default=5.0)
+    dstart.add_argument("--log-level")
+    dstart.set_defaults(func=datanode_start)
+
+    frontend = sub.add_parser("frontend")
+    fsub = frontend.add_subparsers(dest="action", required=True)
+    fstart = fsub.add_parser("start")
+    fstart.add_argument("--metasrv-addr", default="127.0.0.1:3002")
+    fstart.add_argument("--http-addr", default="127.0.0.1:4000")
+    fstart.add_argument("--mysql-addr")
+    fstart.add_argument("--postgres-addr")
+    fstart.add_argument("--grpc-addr")
+    fstart.add_argument("--log-level")
+    fstart.set_defaults(func=frontend_start)
 
     cli = sub.add_parser("cli")
     csub = cli.add_subparsers(dest="action", required=True)

@@ -1,0 +1,175 @@
+"""Wire facade for the meta service: Flight/gRPC server + client.
+
+Reference behavior: src/meta-srv/src/service/ exposes the metadata
+server's heartbeat/router/store RPCs over tonic gRPC, and
+src/meta-client wraps them in a client SDK (client.rs). Here the same
+surface rides Arrow Flight actions (Flight is gRPC) with JSON bodies:
+`FlightMetaServer` wraps an in-process `MetaSrv`; `FlightMetaClient`
+implements the exact `MetaClient` interface, so datanodes heartbeat and
+frontends resolve routes across real sockets with no call-site changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import List, Optional
+
+import pyarrow.flight as flight
+
+from ..errors import GreptimeError
+from .service import (
+    DatanodeStat, HeartbeatResponse, MetaSrv, Peer, TableRoute)
+
+
+class FlightMetaServer(flight.FlightServerBase):
+    def __init__(self, srv: MetaSrv, location: str = "grpc://127.0.0.1:0"):
+        super().__init__(location)
+        self.srv = srv
+        self._location = location
+
+    @property
+    def address(self) -> str:
+        from ..servers.flight import _advertised_address
+        return _advertised_address(self._location, self.port)
+
+    def serve_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True,
+                             name="flight-metasrv")
+        t.start()
+        return t
+
+    def do_action(self, context, action):
+        body = json.loads(action.body.to_pybytes() or b"{}")
+        kind = action.type
+        try:
+            if kind == "register":
+                self.srv.register_datanode(Peer.from_dict(body["peer"]))
+                resp = {"ok": True}
+            elif kind == "heartbeat":
+                stat = DatanodeStat(**body["stat"]) \
+                    if body.get("stat") else None
+                hb = self.srv.handle_heartbeat(body["node_id"], stat)
+                resp = {"ok": True, "mailbox": hb.mailbox}
+            elif kind == "create_route":
+                route = self.srv.create_table_route(
+                    body["name"], body["region_numbers"])
+                resp = {"ok": True, "route": route.to_dict()}
+            elif kind == "route":
+                route = self.srv.table_route(body["name"])
+                resp = {"ok": True,
+                        "route": route.to_dict() if route else None}
+            elif kind == "delete_route":
+                resp = {"ok": True,
+                        "deleted": self.srv.delete_table_route(
+                            body["name"])}
+            elif kind == "allocate_table_id":
+                resp = {"ok": True, "id": self.srv.allocate_table_id()}
+            elif kind == "list_datanodes":
+                peers = self.srv.alive_datanodes() \
+                    if body.get("alive_only", True) else self.srv.peers()
+                resp = {"ok": True,
+                        "peers": [p.to_dict() for p in peers]}
+            else:
+                raise GreptimeError(f"unknown meta action {kind!r}")
+        except GreptimeError as e:
+            resp = {"ok": False, "error": str(e),
+                    "error_type": type(e).__name__}
+        yield flight.Result(json.dumps(resp).encode())
+
+
+class FlightMetaClient:
+    """MetaClient surface over a FlightMetaServer."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._conn: Optional[flight.FlightClient] = None
+
+    @property
+    def conn(self) -> flight.FlightClient:
+        if self._conn is None:
+            self._conn = flight.FlightClient(self.address)
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _action(self, kind: str, body: dict) -> dict:
+        from ..client.flight import _to_greptime_error
+        try:
+            results = list(self.conn.do_action(
+                flight.Action(kind, json.dumps(body).encode())))
+            resp = json.loads(results[0].body.to_pybytes())
+        except flight.FlightError as e:
+            raise _to_greptime_error(e) from None
+        if not resp.get("ok", False):
+            raise GreptimeError(resp.get("error", "meta error"))
+        return resp
+
+    # ---- MetaClient surface ----
+    def register(self, peer: Peer) -> None:
+        self._action("register", {"peer": peer.to_dict()})
+
+    def heartbeat(self, node_id: int,
+                  stat: Optional[DatanodeStat] = None) -> HeartbeatResponse:
+        resp = self._action("heartbeat", {
+            "node_id": node_id,
+            "stat": dataclasses.asdict(stat) if stat else None})
+        return HeartbeatResponse(mailbox=resp.get("mailbox", []))
+
+    def create_route(self, full_name: str,
+                     region_numbers: List[int]) -> TableRoute:
+        resp = self._action("create_route", {
+            "name": full_name, "region_numbers": list(region_numbers)})
+        return TableRoute.from_dict(resp["route"])
+
+    def route(self, full_name: str) -> Optional[TableRoute]:
+        resp = self._action("route", {"name": full_name})
+        return TableRoute.from_dict(resp["route"]) \
+            if resp.get("route") else None
+
+    def delete_route(self, full_name: str) -> bool:
+        return bool(self._action("delete_route",
+                                 {"name": full_name})["deleted"])
+
+    def allocate_table_id(self) -> int:
+        return int(self._action("allocate_table_id", {})["id"])
+
+    def list_datanodes(self, alive_only: bool = True) -> List[Peer]:
+        resp = self._action("list_datanodes", {"alive_only": alive_only})
+        return [Peer.from_dict(p) for p in resp["peers"]]
+
+
+class PeerClientRegistry(dict):
+    """node_id → DatanodeClient map that resolves unknown peers through
+    the meta service and dials their Flight address on demand (the
+    frontend's view of an elastic cluster)."""
+
+    def __init__(self, meta: FlightMetaClient):
+        super().__init__()
+        self.meta = meta
+        self._lock = threading.Lock()
+
+    def _resolve(self, node_id: int):
+        from ..client.flight import FlightDatanodeClient
+        for peer in self.meta.list_datanodes(alive_only=False):
+            if peer.id == node_id and peer.addr:
+                client = FlightDatanodeClient(peer.addr, node_id=node_id)
+                with self._lock:
+                    return self.setdefault(node_id, client)
+        return None
+
+    def __missing__(self, node_id: int):
+        client = self._resolve(node_id)
+        if client is None:
+            raise KeyError(node_id)
+        return client
+
+    def get(self, node_id, default=None):
+        try:
+            return self[node_id]
+        except KeyError:
+            return default

@@ -50,3 +50,63 @@ class MemKv:
             nxt = cur + 1
             self._data[key] = str(nxt).encode()
             return nxt
+
+
+class FileKv(MemKv):
+    """MemKv with a JSON snapshot on every mutation — the etcd stand-in
+    for single-meta deployments (reference deploys etcd; route/peer state
+    must survive a metasrv restart either way)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        import base64
+        import json
+        import os
+        self._path = path
+        self._b64 = base64
+        self._json = json
+        if os.path.exists(path):
+            with open(path) as f:
+                doc = json.load(f)
+            self._data = {k: base64.b64decode(v) for k, v in doc.items()}
+
+    def _persist_locked(self) -> None:
+        import os
+        import tempfile
+        doc = {k: self._b64.b64encode(v).decode()
+               for k, v in self._data.items()}
+        d = os.path.dirname(self._path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".kv-")
+        with os.fdopen(fd, "w") as f:
+            self._json.dump(doc, f)
+        os.replace(tmp, self._path)
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+            self._persist_locked()
+
+    def delete(self, key):
+        with self._lock:
+            existed = self._data.pop(key, None) is not None
+            if existed:
+                self._persist_locked()
+            return existed
+
+    def compare_and_put(self, key, expect, value):
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = value
+            self._persist_locked()
+            return True
+
+    def incr(self, key, start=0):
+        with self._lock:
+            cur = int(self._data.get(key, str(start).encode()))
+            nxt = cur + 1
+            self._data[key] = str(nxt).encode()
+            self._persist_locked()
+            return nxt
