@@ -1,0 +1,116 @@
+"""information_schema virtual tables.
+
+Reference behavior: the reference serves `information_schema` through
+the catalog's schema provider (exercised by
+tests/cases/standalone/common/system/information_schema.sql). Virtual
+tables are materialized from live catalog state at scan time:
+
+- information_schema.tables  — one row per registered table
+- information_schema.columns — one row per column of every table
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..datatypes import data_type as dt
+from ..datatypes.record_batch import RecordBatch
+from ..datatypes.schema import ColumnSchema, Schema
+from ..table.metadata import TableIdent, TableInfo, TableMeta, TableType
+from ..table.table import Table
+
+INFORMATION_SCHEMA_NAME = "information_schema"
+
+_TABLES_SCHEMA = Schema([
+    ColumnSchema("table_catalog", dt.STRING),
+    ColumnSchema("table_schema", dt.STRING),
+    ColumnSchema("table_name", dt.STRING),
+    ColumnSchema("table_type", dt.STRING),
+    ColumnSchema("table_id", dt.INT64),
+    ColumnSchema("engine", dt.STRING),
+])
+
+_COLUMNS_SCHEMA = Schema([
+    ColumnSchema("table_catalog", dt.STRING),
+    ColumnSchema("table_schema", dt.STRING),
+    ColumnSchema("table_name", dt.STRING),
+    ColumnSchema("column_name", dt.STRING),
+    ColumnSchema("data_type", dt.STRING),
+    ColumnSchema("semantic_type", dt.STRING),
+    ColumnSchema("is_nullable", dt.STRING),
+])
+
+
+class _VirtualTable(Table):
+    """Read-only table whose rows come from a builder at scan time."""
+
+    def __init__(self, name: str, schema: Schema, builder):
+        info = TableInfo(
+            ident=TableIdent(3),
+            name=name,
+            meta=TableMeta(schema=schema, engine="system"),
+            schema_name=INFORMATION_SCHEMA_NAME,
+            table_type=TableType.TEMPORARY)
+        super().__init__(info)
+        self._builder = builder
+
+    def scan_batches(self, projection: Optional[Sequence[str]] = None,
+                     time_range=None, limit: Optional[int] = None
+                     ) -> List[RecordBatch]:
+        data = self._builder()
+        if limit is not None:
+            data = {k: v[:limit] for k, v in data.items()}
+        batch = RecordBatch.from_pydict(self.schema, data)
+        if projection is not None:
+            batch = batch.project(list(projection))
+        return [batch]
+
+
+def information_schema_table(catalog_manager, catalog_name: str,
+                             table_name: str) -> Optional[Table]:
+    """Resolve `information_schema.<table>` against live catalog state."""
+    name = table_name.lower()
+    if name == "tables":
+        def build_tables():
+            rows = {k: [] for k in _TABLES_SCHEMA.names()}
+            for schema_name in catalog_manager.schema_names(catalog_name):
+                for tname in catalog_manager.table_names(catalog_name,
+                                                         schema_name):
+                    t = catalog_manager.table(catalog_name, schema_name,
+                                              tname)
+                    if t is None:
+                        continue
+                    rows["table_catalog"].append(catalog_name)
+                    rows["table_schema"].append(schema_name)
+                    rows["table_name"].append(tname)
+                    rows["table_type"].append(
+                        getattr(t.info.table_type, "value", "BASE TABLE"))
+                    rows["table_id"].append(t.info.ident.table_id)
+                    rows["engine"].append(t.info.meta.engine)
+            return rows
+        return _VirtualTable("tables", _TABLES_SCHEMA, build_tables)
+    if name == "columns":
+        def build_columns():
+            rows = {k: [] for k in _COLUMNS_SCHEMA.names()}
+            for schema_name in catalog_manager.schema_names(catalog_name):
+                for tname in catalog_manager.table_names(catalog_name,
+                                                         schema_name):
+                    t = catalog_manager.table(catalog_name, schema_name,
+                                              tname)
+                    if t is None:
+                        continue
+                    for cs in t.schema.column_schemas:
+                        rows["table_catalog"].append(catalog_name)
+                        rows["table_schema"].append(schema_name)
+                        rows["table_name"].append(tname)
+                        rows["column_name"].append(cs.name)
+                        rows["data_type"].append(cs.dtype.name)
+                        rows["semantic_type"].append(
+                            cs.semantic_type.value
+                            if hasattr(cs.semantic_type, "value")
+                            else str(cs.semantic_type))
+                        rows["is_nullable"].append(
+                            "YES" if cs.nullable else "NO")
+            return rows
+        return _VirtualTable("columns", _COLUMNS_SCHEMA, build_columns)
+    return None

@@ -65,6 +65,12 @@ class QueryEngine:
         if isinstance(ref, TableRef):
             ref = ref.name
         catalog, schema, name = ctx.resolve(ref)
+        if schema.lower() == "information_schema":
+            from ..catalog.information_schema import (
+                information_schema_table)
+            virtual = information_schema_table(self.catalog, catalog, name)
+            if virtual is not None:
+                return virtual
         table = self.catalog.table(catalog, schema, name)
         if table is None:
             raise TableNotFoundError(

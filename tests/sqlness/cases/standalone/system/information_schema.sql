@@ -1,0 +1,9 @@
+CREATE TABLE monitored (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, PRIMARY KEY(host));
+
+SELECT table_name, table_schema, engine FROM information_schema.tables WHERE table_schema = 'public' ORDER BY table_name;
+
+SELECT column_name, data_type, semantic_type FROM information_schema.columns WHERE table_name = 'monitored' ORDER BY column_name;
+
+SELECT count(*) FROM information_schema.columns WHERE table_schema = 'public' AND table_name = 'numbers';
+
+DROP TABLE monitored;
