@@ -1,0 +1,247 @@
+"""BASELINE.md measurement suite: configs 1-5 on real hardware.
+
+Run on the TPU host:  python benchmarks/suite.py [--rows-scale 1.0]
+Prints one JSON line per config; paste results into BASELINE.md.
+
+Config map (BASELINE.json):
+  1 README monitor smoke — end-to-end standalone SQL latency
+  2 TSBS single-groupby-1-1-1 @ scaled rows — device scan+agg
+  3 TSBS double-groupby-5 + high-cardinality hosts — device scan+agg
+  4 PromQL rate(cpu[5m]) + avg_over_time over 10k series / 24h
+  5 compaction + 1s→1m downsample over a multi-SST region
+
+CPU denominators are same-machine pandas columnar equivalents (the
+reference publishes no numbers; see BASELINE.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _p(name, value, unit, extra=None):
+    doc = {"config": name, "value": round(value, 2), "unit": unit}
+    if extra:
+        doc.update(extra)
+    print(json.dumps(doc), flush=True)
+
+
+# ---------------------------------------------------------------------------
+def config1_monitor(tmpdir):
+    from greptimedb_tpu.datanode.instance import (
+        DatanodeInstance, DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=f"{tmpdir}/monitor", register_numbers_table=False))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    fe.do_query("CREATE TABLE monitor (host STRING, ts TIMESTAMP TIME"
+                " INDEX, cpu DOUBLE, memory DOUBLE, PRIMARY KEY(host))")
+    rng = np.random.default_rng(1)
+    t_ins = time.perf_counter()
+    for chunk in range(10):
+        rows = ", ".join(
+            f"('host{int(h)}', {1000 + chunk * 1000 + i}, "
+            f"{float(c):.2f}, {float(m):.1f})"
+            for i, (h, c, m) in enumerate(zip(
+                rng.integers(0, 8, 1000), rng.random(1000) * 100,
+                rng.random(1000) * 4096)))
+        fe.do_query(f"INSERT INTO monitor VALUES {rows}")
+    ins_dt = time.perf_counter() - t_ins
+    q = "SELECT host, avg(cpu) FROM monitor GROUP BY host ORDER BY host"
+    fe.do_query(q)                                   # warm / compile
+    t0 = time.perf_counter()
+    iters = 20
+    for _ in range(iters):
+        out = fe.do_query(q)[-1]
+    dt = (time.perf_counter() - t0) / iters
+    assert out.batches[0].num_rows == 8
+    _p("1_monitor_smoke", dt * 1e3, "ms/query",
+       {"insert_rows_per_s": round(10_000 / ins_dt)})
+    fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+def _device_groupby(n_rows, num_groups, n_metrics, ops, iters=6):
+    import jax
+    import jax.numpy as jnp
+    from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+
+    rng = np.random.default_rng(7)
+    gids = np.sort(rng.integers(0, num_groups, n_rows)).astype(np.int32)
+    ts = rng.integers(0, 3_600_000, n_rows).astype(np.int32)
+    metrics = tuple(rng.random(n_rows, dtype=np.float32) * 100
+                    for _ in range(n_metrics))
+    mask = np.ones(n_rows, bool)
+    d = (jax.device_put(gids), jax.device_put(mask), jax.device_put(ts),
+         tuple(jax.device_put(m) for m in metrics))
+
+    @jax.jit
+    def step(gids_a, mask_a, ts_a, ms_a, shift):
+        ms_a = (ms_a[0] + shift,) + ms_a[1:]
+        return sorted_grouped_aggregate(gids_a, mask_a, ts_a, ms_a,
+                                        num_groups=num_groups, ops=ops)
+
+    out = step(*d, jnp.float32(0))
+    float(np.asarray(out[1])[0])
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = step(*d, jnp.float32(i + 1))
+    float(np.asarray(out[1])[0])
+    dt = (time.perf_counter() - t0) / iters
+
+    import pandas as pd
+    df = pd.DataFrame({"g": gids})
+    for i, m in enumerate(metrics):
+        df[f"m{i}"] = m
+    t0 = time.perf_counter()
+    df.groupby("g").agg({f"m{i}": ("mean" if op == "avg" else op)
+                         for i, op in enumerate(ops)})
+    cpu_dt = time.perf_counter() - t0
+    return n_rows / dt, n_rows / cpu_dt
+
+
+def config2_tsbs_single(scale):
+    n = int(100e6 * scale)
+    tpu, cpu = _device_groupby(n, 8 * 60, 1, ("max",))
+    _p("2_tsbs_single_groupby_1_1_1", tpu / 1e6, "Mrows/s",
+       {"rows": n, "cpu_mrows_s": round(cpu / 1e6, 2),
+        "vs_cpu": round(tpu / cpu, 1)})
+
+
+def config3_tsbs_double_highcard(scale):
+    n = int(100e6 * scale)
+    groups = 10_000 * 12                 # 10k hosts × 12 5-min buckets
+    tpu, cpu = _device_groupby(n, groups, 5, ("avg",) * 5)
+    _p("3_tsbs_double_groupby_5_highcard", tpu / 1e6, "Mrows/s",
+       {"rows": n, "groups": groups,
+        "cpu_mrows_s": round(cpu / 1e6, 2),
+        "vs_cpu": round(tpu / cpu, 1)})
+
+
+# ---------------------------------------------------------------------------
+def config4_promql(scale):
+    import jax
+    import jax.numpy as jnp
+    from greptimedb_tpu.ops.window import (
+        SeriesMatrix, range_aggregate_cumsum)
+
+    num_series = int(10_000 * max(scale, 0.1))
+    pts = 5760                            # 24h at 15s scrape
+    n = num_series * pts
+    rng = np.random.default_rng(11)
+    sids = np.repeat(np.arange(num_series, dtype=np.int32), pts)
+    ts = np.tile(np.arange(pts, dtype=np.int64) * 15_000, num_series)
+    vals = np.cumsum(rng.random(n, dtype=np.float32), dtype=np.float32)
+    matrix = SeriesMatrix.build(sids, ts, vals, num_series)
+    d_ts, d_vals, d_lens, base = matrix.device_arrays()
+    d_ts = jax.device_put(d_ts)
+    d_vals = jax.device_put(d_vals)
+    d_lens = jax.device_put(d_lens)
+    nsteps = 1440                         # 24h at 1m step
+
+    @jax.jit
+    def eval_rate(ts2d, v2d, lens, shift):
+        r, ok = range_aggregate_cumsum(
+            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
+            op="rate", nsteps=nsteps)
+        a, ok2 = range_aggregate_cumsum(
+            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
+            op="avg_over_time", nsteps=nsteps)
+        return r, a, ok & ok2
+
+    out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(0))
+    float(np.asarray(out[0])[0, 0])
+    iters = 4
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(i))
+    float(np.asarray(out[0])[0, 0])
+    dt = (time.perf_counter() - t0) / iters
+    _p("4_promql_rate_avg_24h", dt * 1e3, "ms/eval",
+       {"series": num_series, "points": n, "steps": nsteps,
+        "points_per_s_m": round(n / dt / 1e6, 1),
+        "outputs_per_s_m": round(2 * num_series * nsteps / dt / 1e6, 1)})
+
+
+# ---------------------------------------------------------------------------
+def config5_downsample(tmpdir, scale):
+    from greptimedb_tpu.datanode.instance import (
+        DatanodeInstance, DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+
+    n_rows = int(8e6 * max(scale, 0.1))
+    per_sst = n_rows // 4
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=f"{tmpdir}/ds", register_numbers_table=False))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    fe.do_query("CREATE TABLE raw (host STRING, ts TIMESTAMP TIME INDEX,"
+                " v DOUBLE, PRIMARY KEY(host))")
+    fe.do_query("CREATE TABLE agg (host STRING, ts TIMESTAMP TIME INDEX,"
+                " v DOUBLE, PRIMARY KEY(host))")
+    raw = fe.catalog.table("greptime", "public", "raw")
+    rng = np.random.default_rng(3)
+    n_hosts = 100
+    secs_per_sst = per_sst // n_hosts     # every host emits 1 point/sec
+    t_load = time.perf_counter()
+    for s in range(4):
+        base_ts = s * secs_per_sst * 1000
+        ts = np.tile(np.arange(secs_per_sst, dtype=np.int64) * 1000
+                     + base_ts, n_hosts)
+        host = np.repeat([f"h{i}" for i in range(n_hosts)], secs_per_sst)
+        cols = {"host": host.tolist(), "ts": ts.tolist(),
+                "v": rng.random(len(ts)).tolist()}
+        raw.insert(cols)
+        raw.flush()
+    n_rows = 4 * secs_per_sst * n_hosts
+    load_dt = time.perf_counter() - t_load
+
+    from greptimedb_tpu.storage.downsample import downsample_region
+    agg = fe.catalog.table("greptime", "public", "agg")
+    src_region = next(iter(raw.regions.values()))
+    dst_region = next(iter(agg.regions.values()))
+    t0 = time.perf_counter()
+    downsample_region(src_region, dst_region, stride_ms=60_000,
+                      aggs={"v": "avg"})
+    dt = time.perf_counter() - t0
+    out_rows = sum(b.num_rows for b in agg.scan_batches())
+    _p("5_downsample_1s_to_1m", n_rows / dt / 1e6, "Mrows/s",
+       {"rows_in": n_rows, "rows_out": out_rows,
+        "load_rows_per_s": round(n_rows / load_dt),
+        "downsample_s": round(dt, 2)})
+    fe.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-scale", type=float, default=1.0,
+                    help="scale factor on row counts (1.0 = full size)")
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args()
+    import tempfile
+    want = set(args.configs.split(","))
+    with tempfile.TemporaryDirectory() as tmpdir:
+        if "1" in want:
+            config1_monitor(tmpdir)
+        if "2" in want:
+            config2_tsbs_single(args.rows_scale)
+        if "3" in want:
+            config3_tsbs_double_highcard(args.rows_scale)
+        if "4" in want:
+            config4_promql(args.rows_scale)
+        if "5" in want:
+            config5_downsample(tmpdir, args.rows_scale)
+
+
+if __name__ == "__main__":
+    main()

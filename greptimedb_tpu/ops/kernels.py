@@ -365,22 +365,33 @@ def _pad_block(x, ident, n):
 
 
 def _sorted_seg_sum(x, starts, ends, bs, be, has_inner, n):
-    """Per-segment sum of x (zeros where masked) via block partials."""
+    """Per-segment sum of x (zeros where masked) via a two-level prefix
+    sum: in-block inclusive scans + a cumsum over block sums give an
+    exact-structured global prefix P, and each segment is P[end]-P[start]
+    — two O(num_groups) gathers total. (The previous edge-window design
+    gathered [num_groups, 2*block] windows, which made high-cardinality
+    group-bys O(groups*block) and gather-bound.)"""
     if jnp.issubdtype(x.dtype, jnp.integer):
         acc = jnp.promote_types(x.dtype, jnp.int32)  # exact int accumulation
     else:
         acc = jnp.promote_types(x.dtype, jnp.float32)
+    B = _SEG_BLOCK
     xp, nb = _pad_block(x.astype(acc), 0, n)
-    block_sums = xp.reshape(nb, _SEG_BLOCK).sum(axis=1)
+    inblock = jnp.cumsum(xp.reshape(nb, B), axis=1)      # inclusive scans
+    block_sums = inblock[:, -1]
     csum = jnp.concatenate([jnp.zeros(1, acc), jnp.cumsum(block_sums)])
-    inner = jnp.where(has_inner, csum[be] - csum[jnp.minimum(bs, nb)], 0)
-    edges = _edge_windows(x.astype(acc), starts, ends,
-                          jnp.where(has_inner, bs, (starts // _SEG_BLOCK) + 1),
-                          jnp.where(has_inner, be, starts // _SEG_BLOCK + 1),
-                          0, n)
-    # when no inner blocks exist the segment fits the "left" window alone:
-    # point both partial blocks at the segment itself (right window empty)
-    return inner + edges.sum(axis=1)
+
+    def prefix(idx):
+        """Exclusive global prefix at row index idx ∈ [0, nb*B]."""
+        b = idx // B
+        r = idx % B
+        base = csum[b]                      # b == nb only when r == 0
+        inb = jnp.where(
+            r > 0,
+            inblock[jnp.minimum(b, nb - 1), jnp.maximum(r - 1, 0)], 0)
+        return base + inb
+
+    return prefix(ends) - prefix(starts)
 
 
 def _floor_log2(ln, K):
