@@ -22,8 +22,9 @@ from ..errors import (
     PlanError, TableNotFoundError, UnsupportedError)
 from ..session import QueryContext
 from ..sql.ast import (
-    Column, DescribeTable, Explain, FunctionCall, Query, ShowCreateTable,
-    ShowDatabases, ShowTables, ShowVariable, Star, Statement, TableRef)
+    Column, DescribeTable, Explain, FunctionCall, Query, SetQuery,
+    ShowCreateTable, ShowDatabases, ShowTables, ShowVariable, Star,
+    Statement, TableRef)
 from ..table.table import Table
 from .expr import Evaluator, expr_name, like_to_regex
 from .functions import AGGREGATE_FUNCTIONS
@@ -46,6 +47,8 @@ class QueryEngine:
         ctx = ctx or QueryContext()
         if isinstance(stmt, Query):
             return self.execute_query(stmt, ctx)
+        if isinstance(stmt, SetQuery):
+            return self.execute_set_query(stmt, ctx)
         if isinstance(stmt, ShowDatabases):
             return show_impl.show_databases(self, stmt, ctx)
         if isinstance(stmt, ShowTables):
@@ -155,6 +158,47 @@ class QueryEngine:
         batches = table.scan_batches(projection=needed)
         df = _batches_to_df(batches)
         return self._run_on_frame(df, a, query, table)
+
+    # ---- UNION [ALL] ----
+    def execute_set_query(self, sq: SetQuery, ctx: QueryContext) -> Output:
+        left = self.execute(sq.left, ctx)
+        right = self.execute(sq.right, ctx)
+        if not (left.is_batches and right.is_batches):
+            raise PlanError("UNION operands must be queries")
+        lb, rb = left.batches, right.batches
+        lschema = lb[0].schema if lb else None
+        ldf = _batches_to_df(lb)
+        rdf = _batches_to_df(rb)
+        if len(ldf.columns) != len(rdf.columns):
+            raise PlanError(
+                f"UNION operands have {len(ldf.columns)} vs "
+                f"{len(rdf.columns)} columns")
+        rdf.columns = ldf.columns        # names come from the left side
+        df = pd.concat([ldf, rdf], ignore_index=True)
+        if not sq.all:
+            df = df.drop_duplicates()
+        if sq.order_by:
+            ev = Evaluator(df)
+            keys, ascs = [], []
+            frame = df.copy()
+            for i, (e, asc) in enumerate(sq.order_by):
+                name = expr_name(e)
+                if name not in frame.columns:
+                    v = ev.eval(e)
+                    name = f"__uord{i}"
+                    frame[name] = v
+                keys.append(name)
+                ascs.append(asc)
+            frame = frame.sort_values(keys, ascending=ascs, kind="stable")
+            df = df.loc[frame.index]
+        if sq.offset:
+            df = df.iloc[sq.offset:]
+        if sq.limit is not None:
+            df = df.iloc[:sq.limit]
+        schema = lschema if lschema is not None and all(
+            df[c].dtype == ldf[c].dtype for c in df.columns) else \
+            _infer_schema(df, None, {})
+        return Output.record_batches([_df_to_batch(df, schema)], schema)
 
     # ---- joins (CPU fallback; reference delegates to DataFusion's
     # hash joins, src/query/src/datafusion.rs) ----

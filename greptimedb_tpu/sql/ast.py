@@ -206,6 +206,17 @@ class Query(Statement):
 
 
 @dataclass
+class SetQuery(Statement):
+    """UNION [ALL] chain; order/limit/offset apply to the whole set."""
+    left: Statement                  # Query | SetQuery
+    right: "Query" = None
+    all: bool = False
+    order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclass
 class Insert(Statement):
     table: ObjectName
     columns: List[str] = field(default_factory=list)

@@ -16,8 +16,8 @@ from .ast import (
     Copy, CreateDatabase, CreateTable, Delete, DescribeTable, DropColumn,
     DropDatabase, DropTable, Explain, Expr, FunctionCall, InList, Insert,
     Interval, IsNull, Join, Literal, ObjectName, PartitionEntry, Partitions,
-    Placeholder, Query, RenameTable, SelectItem, SetVariable, ShowCreateTable,
-    ShowDatabases, ShowTables, ShowVariable, Star, Statement, Subquery,
+    Placeholder, Query, RenameTable, SelectItem, SetQuery, SetVariable,
+    ShowCreateTable, ShowDatabases, ShowTables, ShowVariable, Star, Statement, Subquery,
     TableRef, Tql, TruncateTable, UnaryOp, Use,
 )
 from .tokenizer import EOF, IDENT, NUMBER, OP, QIDENT, STRING, Token, tokenize
@@ -174,10 +174,21 @@ class Parser:
 
     # ---- SELECT ----
     def parse_query(self) -> Query:
+        q = self.parse_query_body()
+        while self.match_kw("UNION"):
+            all_ = bool(self.match_kw("ALL"))
+            self.match_kw("DISTINCT")
+            right = self.parse_query_body()
+            q = SetQuery(left=q, right=right, all=all_)
+        return self._query_tail(q)
+
+    def parse_query_body(self) -> Query:
+        """One SELECT core (or parenthesized query) without the
+        ORDER/LIMIT tail — the tail binds to the outermost set op."""
         if self.match_op("("):
             q = self.parse_query()
             self.expect_op(")")
-            return self._query_tail(q)
+            return q
         self.expect_kw("SELECT")
         distinct = self.match_kw("DISTINCT")
         self.match_kw("ALL")
@@ -201,7 +212,7 @@ class Parser:
                 q.group_by.append(self.parse_expr())
         if self.match_kw("HAVING"):
             q.having = self.parse_expr()
-        return self._query_tail(q)
+        return q
 
     def _query_tail(self, q: Query) -> Query:
         if self.match_kw("ORDER"):

@@ -1,0 +1,65 @@
+"""UNION / UNION ALL execution tests."""
+
+import pytest
+
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import PlanError
+from greptimedb_tpu.frontend.instance import FrontendInstance
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path / "d"),
+                                          register_numbers_table=False))
+    dn.start()
+    f = FrontendInstance(dn)
+    f.start()
+    f.do_query("CREATE TABLE t1 (host STRING, ts TIMESTAMP TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY(host))")
+    f.do_query("INSERT INTO t1 VALUES ('a', 1, 1.0), ('b', 2, 2.0)")
+    f.do_query("CREATE TABLE t2 (host STRING, ts TIMESTAMP TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY(host))")
+    f.do_query("INSERT INTO t2 VALUES ('b', 2, 2.0), ('c', 3, 3.0)")
+    yield f
+    f.shutdown()
+
+
+def _rows(fe, sql):
+    out = fe.do_query(sql)[-1]
+    return [tuple(r) for b in out.batches for r in b.rows()]
+
+
+class TestUnion:
+    def test_union_all(self, fe):
+        rows = _rows(fe, "SELECT host, v FROM t1 UNION ALL"
+                         " SELECT host, v FROM t2 ORDER BY host, v")
+        assert rows == [("a", 1.0), ("b", 2.0), ("b", 2.0), ("c", 3.0)]
+
+    def test_union_dedups(self, fe):
+        rows = _rows(fe, "SELECT host, v FROM t1 UNION"
+                         " SELECT host, v FROM t2 ORDER BY host")
+        assert rows == [("a", 1.0), ("b", 2.0), ("c", 3.0)]
+
+    def test_union_limit_applies_to_whole(self, fe):
+        rows = _rows(fe, "SELECT host FROM t1 UNION ALL"
+                         " SELECT host FROM t2 ORDER BY host LIMIT 3")
+        assert len(rows) == 3
+
+    def test_chained_unions(self, fe):
+        rows = _rows(fe, "SELECT 1 AS n UNION ALL SELECT 2"
+                         " UNION ALL SELECT 3 ORDER BY n")
+        assert rows == [(1,), (2,), (3,)]
+
+    def test_union_with_aggregates(self, fe):
+        rows = _rows(fe, "SELECT sum(v) AS s FROM t1 UNION ALL"
+                         " SELECT sum(v) FROM t2 ORDER BY s")
+        assert rows == [(3.0,), (5.0,)]
+
+    def test_mismatched_columns_rejected(self, fe):
+        with pytest.raises(PlanError, match="columns"):
+            fe.do_query("SELECT host, v FROM t1 UNION SELECT host FROM t2")
+
+    def test_parenthesized_union_operand(self, fe):
+        rows = _rows(fe, "(SELECT host FROM t1 ORDER BY host LIMIT 1)"
+                         " UNION ALL SELECT host FROM t2 ORDER BY host")
+        assert rows == [("a",), ("b",), ("c",)]
