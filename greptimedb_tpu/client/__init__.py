@@ -36,6 +36,9 @@ class DatanodeClient:
     def ddl_drop_table(self, catalog: str, schema: str, name: str) -> bool:
         raise NotImplementedError
 
+    def ddl_alter_table(self, request) -> None:
+        raise NotImplementedError
+
     def write_region(self, catalog: str, schema: str, table: str,
                      region_number: int, columns: Dict[str, Sequence],
                      op: str = "put") -> int:
@@ -89,6 +92,15 @@ class LocalDatanodeClient(DatanodeClient):
             DropTableRequest(name, catalog, schema))
         self.datanode.catalog.deregister_table(catalog, schema, name)
         return ok
+
+    def ddl_alter_table(self, request) -> None:
+        table = self.datanode.mito.alter_table(request)
+        cat = self.datanode.catalog
+        cat.deregister_table(request.catalog_name, request.schema_name,
+                             request.table_name)
+        cat.register_table(request.catalog_name, request.schema_name,
+                           request.new_table_name or request.table_name,
+                           table)
 
     def write_region(self, catalog: str, schema: str, table: str,
                      region_number: int, columns: Dict[str, Sequence],

@@ -98,6 +98,11 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
         self._action("ddl_create_table",
                      {"request": create_request_to_dict(request)})
 
+    def ddl_alter_table(self, request) -> None:
+        from ..table.requests import alter_request_to_dict
+        self._action("ddl_alter_table",
+                     {"request": alter_request_to_dict(request)})
+
     def ddl_drop_table(self, catalog: str, schema: str, name: str) -> bool:
         return bool(self._action("ddl_drop_table", {
             "catalog": catalog, "schema": schema, "table": name})["dropped"])

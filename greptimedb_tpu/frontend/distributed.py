@@ -309,6 +309,70 @@ class DistInstance:
             return table
         return None
 
+    # ---- protocol ingest: auto create / alter on demand ----
+    def handle_row_insert(
+        self, table_name: str, columns: Dict[str, Sequence],
+        *, tag_columns: Sequence[str] = (),
+        timestamp_column: str = "greptime_timestamp",
+        types=None, ctx: Optional[QueryContext] = None,
+    ) -> int:
+        """Distributed twin of the standalone auto-create/alter ingest
+        (reference: DistInstance implements the same handler traits,
+        src/frontend/src/instance.rs:83-97). Auto-created tables get one
+        region placed by the meta selector; missing field columns fan
+        an ALTER out to every owning datanode."""
+        from .instance import build_ingest_schema, infer_ingest_type
+        ctx = ctx or QueryContext()
+        catalog, schema_name = ctx.current_catalog, ctx.current_schema
+        table = self._resolve_table(catalog, schema_name, table_name)
+        if table is None:
+            schema, pk = build_ingest_schema(columns, tag_columns,
+                                             timestamp_column, types)
+            full = f"{catalog}.{schema_name}.{table_name}"
+            route = self.meta.create_route(full, [0])
+            for peer in route.peers():
+                self.clients[peer.id].ddl_create_table(CreateTableRequest(
+                    table_name, schema, catalog_name=catalog,
+                    schema_name=schema_name, primary_key_indices=pk,
+                    create_if_not_exists=True, table_id=route.table_id,
+                    assigned_region_numbers=route.regions_on(peer.id)))
+            info = TableInfo(
+                ident=TableIdent(route.table_id), name=table_name,
+                meta=TableMeta(schema=schema, primary_key_indices=pk,
+                               engine="mito", region_numbers=[0],
+                               next_column_id=len(schema)),
+                catalog_name=catalog, schema_name=schema_name)
+            table = DistTable(info, None, route, self.clients)
+            self.catalog.register_table(catalog, schema_name, table_name,
+                                        table)
+        else:
+            missing = [n for n in columns
+                       if not table.schema.contains(n)]
+            new_tags = [n for n in missing if n in set(tag_columns)]
+            if new_tags:
+                raise InvalidArgumentsError(
+                    f"table {table_name!r} has no tag column(s) "
+                    f"{new_tags}; tags cannot be added after create")
+            if missing:
+                from ..datatypes.schema import ColumnSchema
+                from ..table.requests import (
+                    AddColumnRequest, AlterKind, AlterTableRequest)
+                adds = [AddColumnRequest(ColumnSchema(
+                    n, infer_ingest_type(n, columns[n], types or {}, "")))
+                    for n in missing]
+                req = AlterTableRequest(
+                    table_name, AlterKind.ADD_COLUMNS,
+                    catalog_name=catalog, schema_name=schema_name,
+                    add_columns=adds)
+                for client in table._involved_clients():
+                    client.ddl_alter_table(req)
+                # refresh the frontend view from a datanode's new schema
+                self.catalog.deregister_table(catalog, schema_name,
+                                              table_name)
+                table = self._resolve_table(catalog, schema_name,
+                                            table_name)
+        return table.insert(columns)
+
     # ---- SQL ----
     def do_query(self, sql: str, ctx: Optional[QueryContext] = None):
         from ..sql import parse_statements

@@ -148,45 +148,12 @@ class FrontendInstance:
     def _infer_type(self, name: str, values: Sequence,
                     types: Dict[str, ConcreteDataType],
                     timestamp_column: str) -> ConcreteDataType:
-        if name in types:
-            return types[name]
-        if name == timestamp_column:
-            return TIMESTAMP_MILLISECOND
-        for v in values:
-            if v is None:
-                continue
-            if isinstance(v, bool):
-                from ..datatypes.data_type import BOOLEAN
-                return BOOLEAN
-            if isinstance(v, int):
-                return INT64
-            if isinstance(v, float):
-                return FLOAT64
-            if isinstance(v, str):
-                return STRING
-        return FLOAT64
+        return infer_ingest_type(name, values, types, timestamp_column)
 
     def _create_on_demand(self, catalog, schema_name, table_name, columns,
                           tag_columns, timestamp_column, types):
-        cols = []
-        tag_set = set(tag_columns)
-        for name, values in columns.items():
-            dtype = self._infer_type(name, values, types, timestamp_column)
-            if name == timestamp_column:
-                cols.append(ColumnSchema(name, dtype, nullable=False,
-                                         semantic_type=SemanticType.TIMESTAMP))
-            elif name in tag_set:
-                cols.append(ColumnSchema(name, dtype, nullable=False,
-                                         semantic_type=SemanticType.TAG))
-            else:
-                cols.append(ColumnSchema(name, dtype))
-        # stable layout: tags, timestamp, fields (reference column order)
-        cols.sort(key=lambda c: {SemanticType.TAG: 0,
-                                 SemanticType.TIMESTAMP: 1,
-                                 SemanticType.FIELD: 2}[c.semantic_type])
-        schema = Schema(cols)
-        pk = [i for i, c in enumerate(cols)
-              if c.semantic_type == SemanticType.TAG]
+        schema, pk = build_ingest_schema(columns, tag_columns,
+                                         timestamp_column, types)
         engine = self.datanode.mito
         table = engine.create_table(CreateTableRequest(
             table_name, schema, catalog_name=catalog,
@@ -221,6 +188,55 @@ class FrontendInstance:
         engine.alter_table(AlterTableRequest(
             table_name, AlterKind.ADD_COLUMNS, catalog_name=catalog,
             schema_name=schema_name, add_columns=adds))
+
+
+def infer_ingest_type(name: str, values: Sequence,
+                      types: Dict[str, ConcreteDataType],
+                      timestamp_column: str) -> ConcreteDataType:
+    """Column type inference for protocol ingest (shared by the
+    standalone and distributed auto-create paths)."""
+    if name in types:
+        return types[name]
+    if name == timestamp_column:
+        return TIMESTAMP_MILLISECOND
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            from ..datatypes.data_type import BOOLEAN
+            return BOOLEAN
+        if isinstance(v, int):
+            return INT64
+        if isinstance(v, float):
+            return FLOAT64
+        if isinstance(v, str):
+            return STRING
+    return FLOAT64
+
+
+def build_ingest_schema(columns, tag_columns, timestamp_column, types):
+    """(Schema, pk_indices) for auto-created ingest tables: stable
+    tags → timestamp → fields layout (reference column order)."""
+    cols = []
+    tag_set = set(tag_columns)
+    for name, values in columns.items():
+        dtype = infer_ingest_type(name, values, types or {},
+                                  timestamp_column)
+        if name == timestamp_column:
+            cols.append(ColumnSchema(name, dtype, nullable=False,
+                                     semantic_type=SemanticType.TIMESTAMP))
+        elif name in tag_set:
+            cols.append(ColumnSchema(name, dtype, nullable=False,
+                                     semantic_type=SemanticType.TAG))
+        else:
+            cols.append(ColumnSchema(name, dtype))
+    cols.sort(key=lambda c: {SemanticType.TAG: 0,
+                             SemanticType.TIMESTAMP: 1,
+                             SemanticType.FIELD: 2}[c.semantic_type])
+    schema = Schema(cols)
+    pk = [i for i, c in enumerate(cols)
+          if c.semantic_type == SemanticType.TAG]
+    return schema, pk
 
 
 def build_standalone(opts=None) -> FrontendInstance:

@@ -21,7 +21,8 @@ from typing import Dict, Optional
 from ..procedure import Procedure, Status
 from ..table.requests import (
     AddColumnRequest, AlterKind, AlterTableRequest, CreateTableRequest,
-    DropTableRequest, create_request_from_dict, create_request_to_dict)
+    DropTableRequest, alter_request_from_dict, alter_request_to_dict,
+    create_request_from_dict, create_request_to_dict)
 from ..datatypes.schema import ColumnSchema
 
 
@@ -162,32 +163,15 @@ class AlterTableProcedure(Procedure):
         raise ValueError(f"unknown state {self.state!r}")
 
     def dump(self) -> dict:
-        r = self.request
-        doc: Dict = {"state": self.state, "request": {
-            "table_name": r.table_name, "kind": r.kind.value,
-            "catalog_name": r.catalog_name, "schema_name": r.schema_name,
-            "drop_columns": list(r.drop_columns),
-            "new_table_name": r.new_table_name,
-            "add_columns": [
-                {"column": a.column_schema.to_dict(), "is_key": a.is_key,
-                 "location": a.location} for a in r.add_columns]}}
-        return doc
+        return {"state": self.state,
+                "request": alter_request_to_dict(self.request)}
 
     @staticmethod
     def loader(engine, catalog):
         def load(data: dict) -> "AlterTableProcedure":
-            d = data["request"]
-            req = AlterTableRequest(
-                d["table_name"], AlterKind(d["kind"]),
-                catalog_name=d["catalog_name"],
-                schema_name=d["schema_name"],
-                add_columns=[AddColumnRequest(
-                    ColumnSchema.from_dict(a["column"]), a["is_key"],
-                    a["location"]) for a in d["add_columns"]],
-                drop_columns=list(d["drop_columns"]),
-                new_table_name=d["new_table_name"])
-            return AlterTableProcedure(req, engine, catalog,
-                                       state=data["state"])
+            return AlterTableProcedure(
+                alter_request_from_dict(data["request"]), engine, catalog,
+                state=data["state"])
         return load
 
 

@@ -127,6 +127,11 @@ class FlightDatanodeServer(flight.FlightServerBase):
                 self.local.ddl_create_table(
                     create_request_from_dict(body["request"]))
                 resp = {"ok": True}
+            elif kind == "ddl_alter_table":
+                from ..table.requests import alter_request_from_dict
+                self.local.ddl_alter_table(
+                    alter_request_from_dict(body["request"]))
+                resp = {"ok": True}
             elif kind == "ddl_drop_table":
                 dropped = self.local.ddl_drop_table(
                     body["catalog"], body["schema"], body["table"])

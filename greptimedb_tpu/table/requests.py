@@ -135,3 +135,24 @@ def create_request_from_dict(d: dict) -> CreateTableRequest:
         table_id=d.get("table_id"),
         assigned_region_numbers=d.get("assigned_region_numbers"),
     )
+
+
+def alter_request_to_dict(r: AlterTableRequest) -> dict:
+    return {"table_name": r.table_name, "kind": r.kind.value,
+            "catalog_name": r.catalog_name, "schema_name": r.schema_name,
+            "drop_columns": list(r.drop_columns),
+            "new_table_name": r.new_table_name,
+            "add_columns": [
+                {"column": a.column_schema.to_dict(), "is_key": a.is_key,
+                 "location": a.location} for a in r.add_columns]}
+
+
+def alter_request_from_dict(d: dict) -> AlterTableRequest:
+    return AlterTableRequest(
+        d["table_name"], AlterKind(d["kind"]),
+        catalog_name=d["catalog_name"], schema_name=d["schema_name"],
+        add_columns=[AddColumnRequest(
+            ColumnSchema.from_dict(a["column"]), a["is_key"],
+            a["location"]) for a in d["add_columns"]],
+        drop_columns=list(d["drop_columns"]),
+        new_table_name=d["new_table_name"])

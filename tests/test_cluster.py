@@ -203,3 +203,60 @@ class TestMultiProcessCluster:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class TestDistributedIngest:
+    """Auto create/alter ingest through a distributed frontend (the
+    HTTP/Influx/OpenTSDB handler path on a cluster router)."""
+
+    @pytest.fixture()
+    def fe(self, tmp_path):
+        from greptimedb_tpu.client import LocalDatanodeClient
+        from greptimedb_tpu.meta import MetaClient
+        datanodes, clients = {}, {}
+        srv = MetaSrv(MemKv())
+        meta = MetaClient(srv)
+        for i in (1, 2):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=str(tmp_path / f"dn{i}"), node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        yield fe
+        for dn in datanodes.values():
+            dn.shutdown()
+
+    def test_auto_create_and_insert(self, fe):
+        n = fe.handle_row_insert(
+            "autodist",
+            {"host": ["a", "b"], "greptime_timestamp": [1, 2],
+             "v": [1.0, 2.0]}, tag_columns=["host"])
+        assert n == 2
+        out = fe.do_query("SELECT count(*) AS c FROM autodist")[-1]
+        assert next(out.batches[0].rows())[0] == 2
+
+    def test_auto_alter_adds_field(self, fe):
+        fe.handle_row_insert(
+            "evolving", {"host": ["a"], "greptime_timestamp": [1],
+                         "v": [1.0]}, tag_columns=["host"])
+        n = fe.handle_row_insert(
+            "evolving", {"host": ["a"], "greptime_timestamp": [2],
+                         "v": [2.0], "extra": [7.5]}, tag_columns=["host"])
+        assert n == 1
+        out = fe.do_query("SELECT sum(extra) AS s FROM evolving")[-1]
+        assert next(out.batches[0].rows())[0] == 7.5
+
+    def test_new_tag_rejected(self, fe):
+        from greptimedb_tpu.errors import InvalidArgumentsError
+        fe.handle_row_insert(
+            "tagged", {"host": ["a"], "greptime_timestamp": [1],
+                       "v": [1.0]}, tag_columns=["host"])
+        with pytest.raises(InvalidArgumentsError, match="tag"):
+            fe.handle_row_insert(
+                "tagged", {"host": ["a"], "dc": ["x"],
+                           "greptime_timestamp": [2], "v": [2.0]},
+                tag_columns=["host", "dc"])
