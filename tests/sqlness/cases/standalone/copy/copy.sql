@@ -1,0 +1,15 @@
+CREATE TABLE src (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO src VALUES ('a', 1000, 1.5), ('b', 2000, 2.5);
+
+COPY src TO '/tmp/sqlness_copy_out.parquet' WITH (format='parquet');
+
+CREATE TABLE dst (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+COPY dst FROM '/tmp/sqlness_copy_out.parquet' WITH (format='parquet');
+
+SELECT * FROM dst ORDER BY ts;
+
+DROP TABLE src;
+
+DROP TABLE dst;

@@ -1,0 +1,23 @@
+CREATE TABLE reqs (host STRING, path STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, PRIMARY KEY(host, path));
+
+INSERT INTO reqs VALUES
+    ('a', '/x', 0, 1.0), ('a', '/y', 0, 2.0),
+    ('b', '/x', 0, 4.0), ('b', '/y', 0, 8.0);
+
+TQL EVAL (0, 0, '5m') sum(reqs);
+
+TQL EVAL (0, 0, '5m') sum by (host) (reqs);
+
+TQL EVAL (0, 0, '5m') sum without (host) (reqs);
+
+TQL EVAL (0, 0, '5m') max by (path) (reqs);
+
+TQL EVAL (0, 0, '5m') topk(1, reqs);
+
+TQL EVAL (0, 0, '5m') reqs{host="a"};
+
+TQL EVAL (0, 0, '5m') reqs{host=~"a|b", path="/x"};
+
+TQL EVAL (0, 0, '5m') reqs * 2 + 1;
+
+DROP TABLE reqs;

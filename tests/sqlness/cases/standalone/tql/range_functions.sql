@@ -1,0 +1,26 @@
+CREATE TABLE counter_metric (host STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO counter_metric VALUES
+    ('web1', 0, 0.0), ('web1', 15000, 15.0), ('web1', 30000, 30.0),
+    ('web1', 45000, 45.0), ('web1', 60000, 60.0), ('web1', 75000, 75.0),
+    ('web1', 90000, 105.0), ('web1', 105000, 135.0), ('web1', 120000, 165.0);
+
+TQL EVAL (120, 120, '1m') rate(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') increase(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') delta(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') idelta(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') max_over_time(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') count_over_time(counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') quantile_over_time(0.5, counter_metric[1m]);
+
+TQL EVAL (120, 120, '1m') changes(counter_metric[2m]);
+
+TQL EVAL (120, 120, '1m') resets(counter_metric[2m]);
+
+DROP TABLE counter_metric;
