@@ -62,11 +62,14 @@ class FrontendInstance:
         stmts = parse_statements(sql)
         if interceptor is not None:
             stmts = interceptor.post_parsing(stmts, ctx)
+        from ..common.telemetry import span
         outputs = []
         for s in stmts:
             if interceptor is not None:
                 interceptor.pre_execute(s, ctx)
-            out = self.execute_stmt(s, ctx)
+            with span("execute_stmt", stmt=type(s).__name__,
+                      channel=ctx.channel.value):
+                out = self.execute_stmt(s, ctx)
             if interceptor is not None:
                 out = interceptor.post_execute(out, ctx)
             outputs.append(out)

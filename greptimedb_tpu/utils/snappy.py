@@ -1,13 +1,67 @@
-"""Pure-Python snappy block-format codec.
+"""Snappy block-format codec: native C++ with pure-Python fallback.
 
 Prometheus remote read/write bodies are snappy-compressed protobuf
-(reference: src/servers/src/prometheus.rs:286). The image has no snappy
-binding, so this implements the block format directly: decompression is
-complete; compression emits literal-only blocks (valid snappy, ~0% ratio —
-fine for tests and small responses).
+(reference: src/servers/src/prometheus.rs:286, via the snappy crate).
+The image has no snappy binding, so native/snappy.cpp implements the
+block format (greedy hash-match compression + full decompression),
+built on first use via g++ and bound through ctypes; this module keeps
+the pure-Python decoder and a literal-only encoder as the fallback.
 """
 
 from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "snappy.cpp")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libgdbsnappy.so")
+_lib = None
+_lib_failed = False
+_build_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not (os.path.exists(_LIB_PATH) and
+                    os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", _LIB_PATH + ".tmp", _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.snappy_max_compressed.restype = ctypes.c_uint64
+            lib.snappy_max_compressed.argtypes = [ctypes.c_uint64]
+            lib.snappy_compress.restype = ctypes.c_uint64
+            lib.snappy_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+            lib.snappy_uncompressed_length.restype = ctypes.c_uint64
+            lib.snappy_uncompressed_length.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64]
+            lib.snappy_uncompress.restype = ctypes.c_int64
+            lib.snappy_uncompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+                ctypes.c_uint64]
+            _lib = lib
+        except (subprocess.SubprocessError, OSError) as e:
+            _logger.warning("native snappy unavailable (%s); using the "
+                            "pure-Python codec", e)
+            _lib_failed = True
+    return _lib
 
 
 def _read_varint(data: bytes, pos: int):
@@ -41,6 +95,18 @@ def _write_varint(n: int) -> bytes:
 def decompress(data: bytes) -> bytes:
     if not data:
         return b""
+    lib = _load()
+    if lib is not None:
+        want = lib.snappy_uncompressed_length(data, len(data))
+        buf = ctypes.create_string_buffer(max(int(want), 1))
+        got = lib.snappy_uncompress(data, len(data), buf, want)
+        if got >= 0 and got == want:
+            return buf.raw[:got]
+        raise ValueError("snappy: corrupt input (native decoder)")
+    return _py_decompress(data)
+
+
+def _py_decompress(data: bytes) -> bytes:
     expected, pos = _read_varint(data, 0)
     out = bytearray()
     n = len(data)
@@ -91,6 +157,18 @@ def decompress(data: bytes) -> bytes:
 
 
 def compress(data: bytes) -> bytes:
+    """Snappy compression (native hash-match codec when available)."""
+    lib = _load()
+    if lib is not None:
+        cap = int(lib.snappy_max_compressed(len(data)))
+        buf = ctypes.create_string_buffer(cap)
+        got = lib.snappy_compress(data, len(data), buf)
+        if got > 0 or not data:
+            return buf.raw[:got]
+    return _py_compress(data)
+
+
+def _py_compress(data: bytes) -> bytes:
     """Literal-only snappy encoding (valid, uncompressed)."""
     out = bytearray(_write_varint(len(data)))
     pos = 0
