@@ -155,10 +155,12 @@ class LocalCatalogManager(MemoryCatalogManager):
     their own manifests).
     """
 
-    def __init__(self, store, engines: Dict[str, TableEngine]):
+    def __init__(self, store, engines: Dict[str, TableEngine],
+                 state_prefix: str = ""):
         super().__init__()
         self.store = store
         self.engines = engines
+        self._doc_key = state_prefix + SYSTEM_CATALOG_KEY
         self._started = False
         # registrations whose engine was unavailable at start(); preserved
         # verbatim in the system doc so a config fix can recover them
@@ -166,8 +168,8 @@ class LocalCatalogManager(MemoryCatalogManager):
 
     # ---- persistence ----
     def _load_doc(self) -> dict:
-        if self.store.exists(SYSTEM_CATALOG_KEY):
-            return json.loads(self.store.read(SYSTEM_CATALOG_KEY))
+        if self.store.exists(self._doc_key):
+            return json.loads(self.store.read(self._doc_key))
         return {"schemas": [[DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME]],
                 "tables": []}
 
@@ -181,7 +183,7 @@ class LocalCatalogManager(MemoryCatalogManager):
                       for s in self._catalogs[c]
                       for n, t in self._catalogs[c][s].items()
                       if t.info.meta.engine in self.engines]
-        self.store.write(SYSTEM_CATALOG_KEY, json.dumps(
+        self.store.write(self._doc_key, json.dumps(
             {"schemas": schemas,
              "tables": tables + list(self._orphans)}).encode())
 

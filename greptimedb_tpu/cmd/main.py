@@ -162,8 +162,26 @@ def metasrv_start(args) -> None:
     srv = MetaSrv(kv)
     server = FlightMetaServer(srv, f"grpc://{args.bind_addr}")
     server.serve_in_background()
+    # region failover runner (reference: FailureDetectRunner on the
+    # leader; the action itself is this build's upgrade over v0.2)
+    from ..common.runtime import RepeatedTask
+
+    def failover_tick():
+        moves = srv.failover_check()
+        for m in moves:
+            logging.warning("failover: region %s of %s moved %d -> %d",
+                            m["region"], m["table"], m["from"], m["to"])
+
+    runner = RepeatedTask(args.failover_interval, failover_tick,
+                          name="failover-runner")
+    runner.start()
     logging.info("metasrv ready on %s", server.address)
-    _block_until_signal(server.shutdown)
+
+    def shutdown():
+        runner.stop()
+        server.shutdown()
+
+    _block_until_signal(shutdown)
 
 
 def datanode_start(args) -> None:
@@ -260,6 +278,7 @@ def main(argv=None) -> int:
     mstart = msub.add_parser("start")
     mstart.add_argument("--bind-addr", default="127.0.0.1:3002")
     mstart.add_argument("--store", help="path for the file-backed KV")
+    mstart.add_argument("--failover-interval", type=float, default=10.0)
     mstart.add_argument("--log-level")
     mstart.set_defaults(func=metasrv_start)
 

@@ -42,18 +42,24 @@ class DatanodeInstance:
             disable_wal=opts.disable_wal)
         self.storage = StorageEngine(config, store=store)
         self.store = self.storage.store
-        self.mito = MitoEngine(self.storage)
+        # node-scoped control state: on a shared object store each
+        # datanode keeps its own registry/manifests/catalog doc while
+        # region data stays globally addressed (failover moves regions)
+        prefix = f"nodes/{opts.node_id}/" if opts.node_id else ""
+        self.state_prefix = prefix
+        self.mito = MitoEngine(self.storage, state_prefix=prefix)
         from ..file_table import ImmutableFileTableEngine
-        self.file_engine = ImmutableFileTableEngine(self.store)
+        self.file_engine = ImmutableFileTableEngine(self.store, state_prefix=prefix)
         self.engines = {self.mito.name: self.mito,
                         self.file_engine.name: self.file_engine}
-        self.catalog = LocalCatalogManager(self.store, self.engines)
+        self.catalog = LocalCatalogManager(self.store, self.engines,
+                                           state_prefix=prefix)
         self.query_engine = QueryEngine(self.catalog)
         # durable DDL (reference: procedure manager + loader registration,
         # src/datanode/src/instance.rs:210-236)
         from ..mito.procedure import register_loaders
         from ..procedure import ProcedureManager
-        self.procedure_manager = ProcedureManager(self.store)
+        self.procedure_manager = ProcedureManager(self.store, state_prefix=prefix)
         register_loaders(self.procedure_manager, self.mito, self.catalog)
         self._started = False
         self._heartbeat_task = None
@@ -97,6 +103,21 @@ class DatanodeInstance:
                                    msg["table"])
             if t is not None:
                 t.flush()
+        elif msg.get("type") == "open_regions":
+            # failover: adopt a dead peer's regions (data on the shared
+            # object store; schema shipped in the message)
+            if msg.get("table_info") is None:
+                import logging
+                logging.getLogger(__name__).error(
+                    "open_regions for %s without table info; skipping",
+                    msg.get("table"))
+                return
+            table = self.mito.adopt_regions(msg["table_info"],
+                                            msg["region_numbers"])
+            if self.catalog.table(msg["catalog"], msg["schema"],
+                                  msg["table"]) is None:
+                self.catalog.register_table(
+                    msg["catalog"], msg["schema"], msg["table"], table)
 
     def shutdown(self) -> None:
         if self._heartbeat_task is not None:

@@ -82,14 +82,15 @@ class ImmutableFileTable(Table):
 class ImmutableFileTableEngine(TableEngine):
     name = ENGINE_NAME
 
-    def __init__(self, store):
+    def __init__(self, store, state_prefix: str = ""):
         self.store = store
+        self._prefix = state_prefix
         self._tables: Dict[tuple, ImmutableFileTable] = {}
         self._lock = threading.Lock()
         self._next_id = 2_000_000          # distinct id space from mito
 
     def _manifest_key(self, catalog: str, schema: str, name: str) -> str:
-        return f"{MANIFEST_DIR}/{catalog}/{schema}/{name}.json"
+        return f"{self._prefix}{MANIFEST_DIR}/{catalog}/{schema}/{name}.json"
 
     # ---- TableEngine ----
     def create_table(self, request) -> Table:

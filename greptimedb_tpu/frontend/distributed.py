@@ -41,6 +41,11 @@ from ..table.table import Table
 logger = logging.getLogger(__name__)
 
 
+def _serialize_dist_rule(rule):
+    from ..mito.engine import _serialize_rule
+    return _serialize_rule(rule)
+
+
 class DistTable(Table):
     """Frontend-side view of a distributed table: route + clients.
 
@@ -247,8 +252,13 @@ class DistInstance:
                            engine="mito",
                            region_numbers=list(region_numbers),
                            next_column_id=len(schema),
-                           options=dict(stmt.options or {})),
+                           options=dict(stmt.options or {}),
+                           partition_rule=_serialize_dist_rule(rule)),
             catalog_name=catalog, schema_name=schema_name)
+        # schema travels with the route (TableGlobalValue) so failover
+        # can materialize regions on datanodes that never saw the DDL
+        if hasattr(self.meta, "put_table_info"):
+            self.meta.put_table_info(full, info.to_dict())
         table = DistTable(info, rule, route, self.clients)
         self.catalog.register_table(catalog, schema_name, table_name, table)
         return table
@@ -265,6 +275,8 @@ class DistInstance:
         for client in table._involved_clients():
             client.ddl_drop_table(catalog, schema_name, name)
         self.meta.delete_route(f"{catalog}.{schema_name}.{name}")
+        if hasattr(self.meta, "delete_table_info"):
+            self.meta.delete_table_info(f"{catalog}.{schema_name}.{name}")
         self.catalog.deregister_table(catalog, schema_name, name)
         return True
 

@@ -66,6 +66,16 @@ class FlightMetaServer(flight.FlightServerBase):
                             body["name"])}
             elif kind == "allocate_table_id":
                 resp = {"ok": True, "id": self.srv.allocate_table_id()}
+            elif kind == "put_table_info":
+                self.srv.put_table_info(body["name"], body["info"])
+                resp = {"ok": True}
+            elif kind == "table_info":
+                resp = {"ok": True,
+                        "info": self.srv.table_info(body["name"])}
+            elif kind == "delete_table_info":
+                resp = {"ok": True,
+                        "deleted": self.srv.delete_table_info(
+                            body["name"])}
             elif kind == "list_datanodes":
                 peers = self.srv.alive_datanodes() \
                     if body.get("alive_only", True) else self.srv.peers()
@@ -137,6 +147,16 @@ class FlightMetaClient:
 
     def allocate_table_id(self) -> int:
         return int(self._action("allocate_table_id", {})["id"])
+
+    def put_table_info(self, full_name: str, info: dict) -> None:
+        self._action("put_table_info", {"name": full_name, "info": info})
+
+    def table_info(self, full_name: str) -> Optional[dict]:
+        return self._action("table_info", {"name": full_name}).get("info")
+
+    def delete_table_info(self, full_name: str) -> bool:
+        return bool(self._action("delete_table_info",
+                                 {"name": full_name})["deleted"])
 
     def list_datanodes(self, alive_only: bool = True) -> List[Peer]:
         resp = self._action("list_datanodes", {"alive_only": alive_only})

@@ -92,6 +92,7 @@ class HeartbeatResponse:
 TABLE_ID_SEQ = "__meta/seq/table_id"
 ROUTE_PREFIX = "__meta/route/"
 PEER_PREFIX = "__meta/peer/"
+TINFO_PREFIX = "__meta/tinfo/"
 
 
 class NoAliveDatanodeError(GreptimeError):
@@ -214,6 +215,72 @@ class MetaSrv:
         return [TableRoute.from_dict(json.loads(v))
                 for _, v in self.kv.range(ROUTE_PREFIX)]
 
+    # ---- table info (reference: TableGlobalKey/Value in etcd,
+    # catalog/src/helper.rs:95-132 — schema travels with the route so
+    # failover can materialize a region on a fresh datanode) ----
+    def put_table_info(self, full_table_name: str, info: dict) -> None:
+        self.kv.put(f"{TINFO_PREFIX}{full_table_name}",
+                    json.dumps(info).encode())
+
+    def table_info(self, full_table_name: str) -> Optional[dict]:
+        raw = self.kv.get(f"{TINFO_PREFIX}{full_table_name}")
+        return json.loads(raw) if raw is not None else None
+
+    def delete_table_info(self, full_table_name: str) -> bool:
+        return self.kv.delete(f"{TINFO_PREFIX}{full_table_name}")
+
+    # ---- region failover (the action the reference leaves TODO,
+    # meta-srv/src/handler/failure_handler/runner.rs:132; design per
+    # docs/rfcs/2023-03-08-region-fault-tolerance.md: region data lives
+    # on shared object storage, so a dead node's regions reopen
+    # elsewhere at their last-flushed state) ----
+    def failover_check(self, now: Optional[float] = None) -> List[dict]:
+        """Re-place regions led by dead datanodes onto alive ones and
+        mail open_regions to the new leaders. Returns the moves."""
+        now_t = time.time() if now is None else now
+        dead = {p.id for p in self.failed_datanodes(now_t)}
+        for p in self.peers():
+            seen = self._last_seen.get(p.id)
+            if seen is None or now_t - seen > 2 * self.datanode_lease_secs:
+                dead.add(p.id)
+        if not dead:
+            return []
+        alive = [p for p in self.alive_datanodes(now_t)
+                 if p.id not in dead]
+        if not alive:
+            return []
+        load = {p.id: self._stats.get(p.id, DatanodeStat()).region_count
+                for p in alive}
+        moves: List[dict] = []
+        for route in self.all_table_routes():
+            lost = [rr for rr in route.region_routes
+                    if rr.leader.id in dead]
+            if not lost:
+                continue
+            assigned: Dict[int, List[int]] = {}
+            for rr in lost:
+                target = min(alive, key=lambda p: (load[p.id], p.id))
+                load[target.id] += 1
+                old = rr.leader
+                rr.leader = target
+                assigned.setdefault(target.id, []).append(
+                    rr.region_number)
+                moves.append({"table": route.table_name,
+                              "region": rr.region_number,
+                              "from": old.id, "to": target.id})
+            self.kv.put(f"{ROUTE_PREFIX}{route.table_name}",
+                        json.dumps(route.to_dict()).encode())
+            info = self.table_info(route.table_name)
+            catalog, schema_name, tname = route.table_name.split(".", 2)
+            for node_id, region_numbers in assigned.items():
+                self.send_mailbox(node_id, {
+                    "type": "open_regions", "catalog": catalog,
+                    "schema": schema_name, "table": tname,
+                    "table_id": route.table_id,
+                    "region_numbers": region_numbers,
+                    "table_info": info})
+        return moves
+
 
 class MetaClient:
     """Client SDK facade (reference: src/meta-client). In-process it calls
@@ -241,3 +308,12 @@ class MetaClient:
 
     def allocate_table_id(self) -> int:
         return self._srv.allocate_table_id()
+
+    def put_table_info(self, full_name: str, info: dict) -> None:
+        self._srv.put_table_info(full_name, info)
+
+    def table_info(self, full_name: str) -> Optional[dict]:
+        return self._srv.table_info(full_name)
+
+    def delete_table_info(self, full_name: str) -> bool:
+        return self._srv.delete_table_info(full_name)

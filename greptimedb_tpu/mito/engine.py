@@ -244,7 +244,11 @@ class MitoTable(Table):
 class MitoEngine(TableEngine):
     name = MITO_ENGINE
 
-    def __init__(self, storage: StorageEngine):
+    def __init__(self, storage: StorageEngine, state_prefix: str = ""):
+        # control state (registry/manifests) is node-scoped when several
+        # datanodes share one object store (failover deployments); region
+        # DATA stays globally addressed so regions can move between nodes
+        self.state_prefix = state_prefix
         self.storage = storage
         self.store = storage.store
         self._tables: Dict[tuple, MitoTable] = {}
@@ -253,7 +257,7 @@ class MitoEngine(TableEngine):
 
     # ---- engine registry (next id + table dirs) ----
     def _registry_key(self) -> str:
-        return "mito/engine.json"
+        return f"{self.state_prefix}mito/engine.json"
 
     def _load_registry(self) -> dict:
         if self.store.exists(self._registry_key()):
@@ -265,7 +269,8 @@ class MitoEngine(TableEngine):
                          json.dumps(self._registry).encode())
 
     def _manifest_key(self, catalog: str, schema: str, table_id: int) -> str:
-        return f"mito/{catalog}/{schema}/{table_id}/manifest.json"
+        return (f"{self.state_prefix}mito/{catalog}/{schema}/"
+                f"{table_id}/manifest.json")
 
     # ---- DDL ----
     def create_table(self, request: CreateTableRequest) -> MitoTable:
@@ -364,6 +369,53 @@ class MitoEngine(TableEngine):
         table = MitoTable(info, regions, rule)
         self._tables[key] = table
         return table
+
+    def adopt_regions(self, info_doc: dict, region_numbers) -> MitoTable:
+        """Failover: open the given regions of a table this node may have
+        never seen — schema arrives via the meta-stored TableGlobalValue
+        (the reference leaves the failover *action* TODO,
+        failure_handler/runner.rs:132). Region manifests + SSTs live on
+        the shared object store at their last-flushed state; the dead
+        node's unflushed WAL tail is lost by design (RFC
+        2023-03-08-region-fault-tolerance). Fencing writes from a
+        partitioned-but-alive old owner is future lease work."""
+        import dataclasses
+        info = TableInfo.from_dict(info_doc)
+        key = (info.catalog_name, info.schema_name, info.name)
+        full = ".".join(key)
+        with self._lock:
+            table = self._tables.get(key)
+            schema = info.meta.schema
+            tid = info.ident.table_id
+            ropts = region_opts_from_table_options(info.meta.options)
+            opened = {}
+            for rn in region_numbers:
+                region = self.storage.open_region(
+                    region_name(tid, rn), schema, opts=ropts)
+                if region is None:
+                    region = self.storage.create_region(
+                        region_name(tid, rn), schema, opts=ropts)
+                opened[rn] = region
+            if table is None:
+                rule = _deserialize_rule(info.meta.partition_rule)
+                meta = dataclasses.replace(
+                    info.meta, region_numbers=sorted(region_numbers))
+                local_info = dataclasses.replace(info, meta=meta)
+                table = MitoTable(local_info, opened, rule)
+                self._tables[key] = table
+                self._registry["tables"][full] = tid
+                self._registry["next_table_id"] = max(
+                    self._registry["next_table_id"], tid + 1)
+                self._save_registry()
+            else:
+                table.regions.update(opened)
+                table.info.meta.region_numbers = sorted(
+                    set(table.info.meta.region_numbers)
+                    | set(region_numbers))
+            self.store.write(
+                self._manifest_key(*key[:2], tid),
+                json.dumps(table.info.to_dict()).encode())
+            return table
 
     def alter_table(self, request: AlterTableRequest) -> MitoTable:
         key = (request.catalog_name, request.schema_name, request.table_name)

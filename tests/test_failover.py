@@ -1,0 +1,168 @@
+"""Region failover tests: dead datanode's regions reopen elsewhere.
+
+The reference detects failures (phi detector) but leaves the failover
+*action* TODO (meta-srv/src/handler/failure_handler/runner.rs:132; RFC
+2023-03-08-region-fault-tolerance). Here the action exists: with region
+data on a SHARED object store, `MetaSrv.failover_check` re-places dead
+nodes' regions on alive ones and mails `open_regions`; the adopting
+datanode materializes the table from the meta-stored TableGlobalValue at
+its last-flushed state.
+"""
+
+import time
+
+import pytest
+
+from greptimedb_tpu import DEFAULT_CATALOG_NAME as CAT
+from greptimedb_tpu import DEFAULT_SCHEMA_NAME as SCH
+from greptimedb_tpu.client import LocalDatanodeClient
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.frontend.distributed import DistInstance
+from greptimedb_tpu.meta import MetaClient, MetaSrv, Peer
+from greptimedb_tpu.meta.kv import MemKv
+from greptimedb_tpu.storage.object_store import FsObjectStore
+
+DDL = """
+CREATE TABLE ha (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,
+                 PRIMARY KEY(host))
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h5'),
+  PARTITION r1 VALUES LESS THAN (MAXVALUE))
+"""
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """2 datanodes over ONE shared object store (each keeps node-scoped
+    control state + a local WAL home)."""
+    shared = FsObjectStore(str(tmp_path / "shared_store"))
+    srv = MetaSrv(MemKv(), datanode_lease_secs=5.0)
+    meta = MetaClient(srv)
+    datanodes, clients = {}, {}
+    for i in (1, 2):
+        dn = DatanodeInstance(
+            DatanodeOptions(data_home=str(tmp_path / f"wal{i}"),
+                            node_id=i, register_numbers_table=False),
+            store=shared)
+        dn.start()
+        datanodes[i] = dn
+        clients[i] = LocalDatanodeClient(dn)
+        srv.register_datanode(Peer(i, f"dn{i}"))
+        srv.handle_heartbeat(i)
+    fe = DistInstance(meta, clients)
+    yield fe, datanodes, srv, meta, shared
+    for dn in datanodes.values():
+        dn.shutdown()
+
+
+def _beat_regularly(srv, node_id, t0, until, step=1.0):
+    t = t0
+    while t < until:
+        srv.handle_heartbeat(node_id, now=t)
+        t += step
+
+
+class TestFailover:
+    def test_regions_move_and_data_survives(self, cluster, tmp_path):
+        fe, datanodes, srv, meta, shared = cluster
+        fe.do_query(DDL)
+        rows = ", ".join(f"('h{i}', {1000+i}, {float(i)})"
+                         for i in range(10))
+        fe.do_query(f"INSERT INTO ha VALUES {rows}")
+        fe.catalog.table(CAT, SCH, "ha").flush()     # durable on shared
+
+        route = srv.table_route("greptime.public.ha")
+        owners = {rr.leader.id for rr in route.region_routes}
+        assert owners == {1, 2}
+
+        # node 2 dies: node 1 keeps beating, node 2 goes silent
+        t0 = time.time()
+        _beat_regularly(srv, 1, t0, t0 + 30)
+        _beat_regularly(srv, 2, t0, t0 + 3)
+        moves = srv.failover_check(now=t0 + 29)
+        assert moves and all(m["from"] == 2 and m["to"] == 1
+                             for m in moves)
+
+        # the mailbox rides node 1's next heartbeat
+        resp = srv.handle_heartbeat(1, now=t0 + 30)
+        for msg in resp.mailbox:
+            datanodes[1]._handle_mailbox(msg)
+
+        # all regions now on node 1; data readable at last-flushed state
+        route = srv.table_route("greptime.public.ha")
+        assert {rr.leader.id for rr in route.region_routes} == {1}
+        fe2 = DistInstance(meta, {1: LocalDatanodeClient(datanodes[1])})
+        out = fe2.do_query("SELECT count(*) AS c, sum(v) AS s FROM ha")[-1]
+        row = next(out.batches[0].rows())
+        assert row == (10, 45.0)
+
+    def test_unflushed_tail_lost_by_design(self, cluster):
+        fe, datanodes, srv, meta, _ = cluster
+        fe.do_query(DDL)
+        fe.do_query("INSERT INTO ha VALUES ('h7', 1, 1.0), ('h8', 2, 2.0)")
+        t = fe.catalog.table(CAT, SCH, "ha")
+        t.flush()
+        # this lands only in node WAL/memtable (no flush)
+        fe.do_query("INSERT INTO ha VALUES ('h9', 3, 3.0)")
+
+        t0 = time.time()
+        _beat_regularly(srv, 1, t0, t0 + 30)
+        srv.failover_check(now=t0 + 29)
+        resp = srv.handle_heartbeat(1, now=t0 + 30)
+        for msg in resp.mailbox:
+            datanodes[1]._handle_mailbox(msg)
+        fe2 = DistInstance(meta, {1: LocalDatanodeClient(datanodes[1])})
+        out = fe2.do_query("SELECT count(*) AS c FROM ha")[-1]
+        # flushed rows survive; the unflushed h9 row is gone
+        assert next(out.batches[0].rows())[0] == 2
+
+    def test_noop_when_all_alive(self, cluster):
+        fe, _, srv, _, _ = cluster
+        fe.do_query(DDL)
+        t0 = time.time()
+        _beat_regularly(srv, 1, t0, t0 + 10)
+        _beat_regularly(srv, 2, t0, t0 + 10)
+        assert srv.failover_check(now=t0 + 10) == []
+
+    def test_no_alive_targets_is_noop(self, cluster):
+        fe, _, srv, _, _ = cluster
+        fe.do_query(DDL)
+        t0 = time.time()
+        # both nodes silent
+        assert srv.failover_check(now=t0 + 3600) == []
+
+    def test_adopting_node_that_never_saw_the_table(self, tmp_path):
+        """A datanode started AFTER the DDL adopts regions purely from
+        the meta-stored table info."""
+        shared = FsObjectStore(str(tmp_path / "store"))
+        srv = MetaSrv(MemKv(), datanode_lease_secs=5.0)
+        meta = MetaClient(srv)
+        dn1 = DatanodeInstance(
+            DatanodeOptions(data_home=str(tmp_path / "wal1"), node_id=1,
+                            register_numbers_table=False), store=shared)
+        dn1.start()
+        srv.register_datanode(Peer(1, "dn1"))
+        srv.handle_heartbeat(1)
+        fe = DistInstance(meta, {1: LocalDatanodeClient(dn1)})
+        fe.do_query("CREATE TABLE solo (host STRING, ts TIMESTAMP TIME"
+                    " INDEX, v DOUBLE, PRIMARY KEY(host))")
+        fe.do_query("INSERT INTO solo VALUES ('a', 1, 1.5)")
+        fe.catalog.table(CAT, SCH, "solo").flush()
+
+        dn3 = DatanodeInstance(
+            DatanodeOptions(data_home=str(tmp_path / "wal3"), node_id=3,
+                            register_numbers_table=False), store=shared)
+        dn3.start()
+        srv.register_datanode(Peer(3, "dn3"))
+        t0 = time.time()
+        _beat_regularly(srv, 3, t0, t0 + 30)
+        moves = srv.failover_check(now=t0 + 29)
+        assert moves and moves[0]["to"] == 3
+        resp = srv.handle_heartbeat(3, now=t0 + 30)
+        for msg in resp.mailbox:
+            dn3._handle_mailbox(msg)
+        fe2 = DistInstance(meta, {3: LocalDatanodeClient(dn3)})
+        out = fe2.do_query("SELECT sum(v) AS s FROM solo")[-1]
+        assert next(out.batches[0].rows())[0] == 1.5
+        dn1.shutdown()
+        dn3.shutdown()
