@@ -1,0 +1,165 @@
+"""NYC-taxi-style ingest + query benchmark harness.
+
+Reference behavior: benchmarks/src/bin/nyc-taxi.rs:36-80 — load TLC trip
+data through the gRPC client with parallel workers (batch 4096), then
+time count / avg / group-by queries. No internet access here, so the
+trip data is synthesized with the same shape (vendor, passenger_count,
+distance, fares, payment_type over pickup timestamps).
+
+Usage:
+    python benchmarks/nyc_taxi.py [--rows 1000000] [--workers 4]
+    python benchmarks/nyc_taxi.py --via-flight    # load over the wire
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 4096
+DDL = """
+CREATE TABLE trips (
+    vendor_id STRING,
+    pickup_ts TIMESTAMP TIME INDEX,
+    passenger_count BIGINT,
+    trip_distance DOUBLE,
+    fare_amount DOUBLE,
+    tip_amount DOUBLE,
+    total_amount DOUBLE,
+    payment_type STRING,
+    PRIMARY KEY(vendor_id)
+)"""
+
+QUERIES = [
+    ("count", "SELECT count(*) FROM trips"),
+    ("avg_fare", "SELECT avg(fare_amount) FROM trips"),
+    ("group_vendor",
+     "SELECT vendor_id, count(*), avg(total_amount) FROM trips"
+     " GROUP BY vendor_id ORDER BY vendor_id"),
+    ("group_payment",
+     "SELECT payment_type, avg(tip_amount) FROM trips"
+     " GROUP BY payment_type ORDER BY payment_type"),
+    ("filtered",
+     "SELECT count(*) FROM trips WHERE trip_distance > 5.0"),
+]
+
+
+def gen_batch(rng, base_ts: int, n: int) -> dict:
+    dist = np.round(rng.gamma(2.0, 1.8, n), 2)
+    fare = np.round(3.0 + dist * 2.5 + rng.random(n), 2)
+    tip = np.round(fare * rng.random(n) * 0.3, 2)
+    return {
+        "vendor_id": [f"V{v}" for v in rng.integers(1, 5, n)],
+        "pickup_ts": (base_ts + np.arange(n, dtype=np.int64) * 1000
+                      ).tolist(),
+        "passenger_count": rng.integers(1, 7, n).tolist(),
+        "trip_distance": dist.tolist(),
+        "fare_amount": fare.tolist(),
+        "tip_amount": tip.tolist(),
+        "total_amount": np.round(fare + tip, 2).tolist(),
+        "payment_type": [("card", "cash", "dispute")[p]
+                         for p in rng.integers(0, 3, n)],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--via-flight", action="store_true",
+                    help="load + query over the Flight wire protocol")
+    args = ap.parse_args()
+
+    from greptimedb_tpu.datanode.instance import (
+        DatanodeInstance, DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+
+    tmp = tempfile.mkdtemp(prefix="nyc_taxi_")
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=tmp, register_numbers_table=False))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    fe.do_query(DDL)
+
+    if args.via_flight:
+        from greptimedb_tpu.client.flight import Database
+        from greptimedb_tpu.servers.flight import FlightFrontendServer
+        server = FlightFrontendServer(fe)
+        server.serve_in_background()
+        while server.port == 0:
+            time.sleep(0.01)
+
+        def make_sink():
+            return Database(server.address)
+
+        def write(sink, cols):
+            return sink.insert("trips", cols, tag_columns=["vendor_id"],
+                               timestamp_column="pickup_ts")
+
+        def query(sql):
+            return make_sink().sql(sql)
+    else:
+        table = fe.catalog.table("greptime", "public", "trips")
+
+        def make_sink():
+            return table
+
+        def write(sink, cols):
+            return sink.insert(cols)
+
+        def query(sql):
+            return fe.do_query(sql)[-1].batches
+
+    # ---- parallel ingest (reference: parallel gRPC clients, batch 4096)
+    n_batches = (args.rows + BATCH - 1) // BATCH
+    t0 = time.perf_counter()
+
+    def worker(wid: int) -> int:
+        rng = np.random.default_rng(wid)
+        sink = make_sink()
+        wrote = 0
+        for b in range(wid, n_batches, args.workers):
+            n = min(BATCH, args.rows - b * BATCH)
+            if n <= 0:
+                break
+            wrote += write(sink, gen_batch(rng, b * BATCH * 1000, n))
+        return wrote
+
+    with concurrent.futures.ThreadPoolExecutor(args.workers) as pool:
+        total = sum(pool.map(worker, range(args.workers)))
+    ingest_dt = time.perf_counter() - t0
+    print(json.dumps({"phase": "ingest", "rows": total,
+                      "rows_per_s": round(total / ingest_dt),
+                      "seconds": round(ingest_dt, 2),
+                      "workers": args.workers,
+                      "via": "flight" if args.via_flight else "local"}),
+          flush=True)
+
+    # ---- queries (warm once, then timed) ----
+    for name, sql in QUERIES:
+        query(sql)
+        t0 = time.perf_counter()
+        iters = 5
+        for _ in range(iters):
+            batches = query(sql)
+        dt = (time.perf_counter() - t0) / iters
+        nrows = sum(b.num_rows for b in batches)
+        print(json.dumps({"phase": "query", "name": name,
+                          "ms": round(dt * 1e3, 1),
+                          "result_rows": nrows}), flush=True)
+    fe.shutdown()
+
+
+if __name__ == "__main__":
+    main()
