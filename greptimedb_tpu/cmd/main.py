@@ -162,11 +162,19 @@ def metasrv_start(args) -> None:
     srv = MetaSrv(kv)
     server = FlightMetaServer(srv, f"grpc://{args.bind_addr}")
     server.serve_in_background()
+    # leader election: with several metasrv replicas over one KV, only
+    # the lease holder mutates routes (reference: election/etcd.rs)
+    from ..meta.lock import Election
+    election = Election(kv, f"metasrv-{args.bind_addr}")
+    election.start()
+
     # region failover runner (reference: FailureDetectRunner on the
     # leader; the action itself is this build's upgrade over v0.2)
     from ..common.runtime import RepeatedTask
 
     def failover_tick():
+        if not election.is_leader:
+            return
         moves = srv.failover_check()
         for m in moves:
             logging.warning("failover: region %s of %s moved %d -> %d",
@@ -175,10 +183,12 @@ def metasrv_start(args) -> None:
     runner = RepeatedTask(args.failover_interval, failover_tick,
                           name="failover-runner")
     runner.start()
-    logging.info("metasrv ready on %s", server.address)
+    logging.info("metasrv ready on %s (leader=%s)", server.address,
+                 election.is_leader)
 
     def shutdown():
         runner.stop()
+        election.stop()
         server.shutdown()
 
     _block_until_signal(shutdown)

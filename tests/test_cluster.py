@@ -260,3 +260,58 @@ class TestDistributedIngest:
                 "tagged", {"host": ["a"], "dc": ["x"],
                            "greptime_timestamp": [2], "v": [2.0]},
                 tag_columns=["host", "dc"])
+
+
+class TestDistributedLockAndElection:
+    """Reference: meta-srv/src/lock/ + election/etcd.rs — KV-lease based."""
+
+    def test_lock_mutual_exclusion(self):
+        from greptimedb_tpu.meta.lock import DistributedLock
+        kv = MemKv()
+        a = DistributedLock(kv, "ddl", holder="a")
+        b = DistributedLock(kv, "ddl", holder="b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.try_acquire()            # re-entrant renewal
+        a.release()
+        assert b.try_acquire()
+
+    def test_expired_lease_taken_over(self):
+        from greptimedb_tpu.meta.lock import DistributedLock
+        kv = MemKv()
+        a = DistributedLock(kv, "x", holder="a", lease_secs=5)
+        b = DistributedLock(kv, "x", holder="b", lease_secs=5)
+        t0 = time.time()
+        assert a.try_acquire(now=t0)
+        assert not b.try_acquire(now=t0 + 2)
+        assert b.try_acquire(now=t0 + 6)  # a's lease expired
+        assert a.holder_of(now=t0 + 7) == "b"
+
+    def test_context_manager(self):
+        from greptimedb_tpu.meta.lock import DistributedLock
+        kv = MemKv()
+        with DistributedLock(kv, "cm", holder="a") as lock:
+            assert lock.holder_of() == "a"
+        assert DistributedLock(kv, "cm", holder="b").try_acquire()
+
+    def test_election_single_leader(self):
+        from greptimedb_tpu.meta.lock import Election
+        kv = MemKv()
+        e1 = Election(kv, "meta-1")
+        e2 = Election(kv, "meta-2")
+        assert e1.campaign_once()
+        assert not e2.campaign_once()
+        assert e1.is_leader and not e2.is_leader
+        assert e2.leader() == "meta-1"
+
+    def test_election_failover_on_lease_expiry(self):
+        from greptimedb_tpu.meta.lock import Election
+        kv = MemKv()
+        e1 = Election(kv, "meta-1", lease_secs=5)
+        e2 = Election(kv, "meta-2", lease_secs=5)
+        t0 = time.time()
+        assert e1.campaign_once(now=t0)
+        # leader dies; challenger wins after the lease lapses
+        assert not e2.campaign_once(now=t0 + 2)
+        assert e2.campaign_once(now=t0 + 6)
+        assert e2.leader() == "meta-2"
