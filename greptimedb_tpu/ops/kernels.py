@@ -445,18 +445,70 @@ def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
             [prev[shift:], jnp.full((min(shift, nb),), ident, prev.dtype)])
         st.append(red(prev, rolled))
     ST = jnp.stack(st)                                    # [K, NB]
-    ln = jnp.maximum(be - bs, 1)
-    k = _floor_log2(ln, K)
-    lo = jnp.minimum(bs, nb - 1)
-    hi = jnp.clip(be - (1 << k), 0, nb - 1)
-    inner = red(ST[k, lo], ST[k, hi])
-    inner = jnp.where(has_inner, inner, ident)
-    edges = _edge_windows(x, starts, ends,
-                          jnp.where(has_inner, bs, starts // _SEG_BLOCK + 1),
-                          jnp.where(has_inner, be, starts // _SEG_BLOCK + 1),
-                          ident, n)
-    er = edges.min(axis=1) if is_min else edges.max(axis=1)
-    return red(inner, er)
+    B = _SEG_BLOCK
+    num_groups = starts.shape[0]
+    if num_groups <= _SEG_SUM_PREFIX_THRESHOLD:
+        # low cardinality: per-segment edge windows (cheap at small G)
+        ln = jnp.maximum(be - bs, 1)
+        k = _floor_log2(ln, K)
+        lo = jnp.minimum(bs, nb - 1)
+        hi = jnp.clip(be - (1 << k), 0, nb - 1)
+        inner = red(ST[k, lo], ST[k, hi])
+        inner = jnp.where(has_inner, inner, ident)
+        edges = _edge_windows(x, starts, ends,
+                              jnp.where(has_inner, bs, starts // B + 1),
+                              jnp.where(has_inner, be, starts // B + 1),
+                              ident, n)
+        er = edges.min(axis=1) if is_min else edges.max(axis=1)
+        return red(inner, er)
+
+    # high cardinality: [G, 2*block] edge gathers are the bottleneck
+    # (O(groups*block) random access). Replace them with in-block
+    # prefix/suffix scans plus an in-block sparse table so every segment
+    # resolves with a handful of O(G) gathers:
+    #   single-block segment  -> two in-block-ST lookups
+    #   multi-block segment   -> suffix[left] ∧ block-ST inner ∧ prefix[right]
+    blocks2d = xp.reshape(nb, B)
+    if is_min:
+        pref = jax.lax.cummin(blocks2d, axis=1)
+        suff = jax.lax.cummin(blocks2d[:, ::-1], axis=1)[:, ::-1]
+    else:
+        pref = jax.lax.cummax(blocks2d, axis=1)
+        suff = jax.lax.cummax(blocks2d[:, ::-1], axis=1)[:, ::-1]
+    K2 = max(1, (B - 1).bit_length() + 1)
+    st_in = [blocks2d]
+    for k in range(1, K2):
+        shift = 1 << (k - 1)
+        prev = st_in[-1]
+        rolled = jnp.concatenate(
+            [prev[:, shift:],
+             jnp.full((nb, min(shift, B)), ident, prev.dtype)], axis=1)
+        st_in.append(red(prev, rolled))
+    STIN = jnp.stack(st_in)                               # [K2, NB, B]
+
+    e1 = jnp.maximum(ends - 1, 0)
+    lb = jnp.minimum(starts // B, nb - 1)
+    r0 = starts % B
+    rb = jnp.minimum(e1 // B, nb - 1)
+    r1 = e1 % B
+    single = lb == rb
+
+    seg_len = jnp.maximum(ends - starts, 1)               # <= B when single
+    k2 = _floor_log2(seg_len, K2)
+    single_val = red(STIN[k2, lb, jnp.minimum(r0, B - 1)],
+                     STIN[k2, lb, jnp.clip(r1 + 1 - (1 << k2), 0, B - 1)])
+
+    left = suff[lb, jnp.minimum(r0, B - 1)]
+    right = pref[rb, r1]
+    iln = rb - lb - 1                                     # inner block count
+    kin = _floor_log2(jnp.maximum(iln, 1), K)
+    ilo = jnp.clip(lb + 1, 0, nb - 1)
+    ihi = jnp.clip(rb - (1 << kin), 0, nb - 1)
+    inner = jnp.where(iln >= 1, red(ST[kin, ilo], ST[kin, ihi]), ident)
+    multi_val = red(red(left, right), inner)
+
+    out = jnp.where(single, single_val, multi_val)
+    return jnp.where(ends > starts, out, ident)
 
 
 def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min):

@@ -481,3 +481,78 @@ class TestSortedGroupedAggregate:
             assert np.asarray(mx)[i] == seg.max()
             assert np.asarray(lst)[i] == seg[-1]
             off += sz
+
+
+class TestHighCardinalityPaths:
+    """Force num_groups above _SEG_SUM_PREFIX_THRESHOLD so the prefix-sum
+    and in-block sparse-table paths (not the edge-window path) execute,
+    cross-checked against the numpy oracle."""
+
+    def _data(self, n=200_000, groups=20_000, seed=0):
+        rng = np.random.default_rng(seed)
+        gids = np.sort(rng.integers(0, groups, n)).astype(np.int32)
+        ts = rng.integers(0, 1 << 30, n).astype(np.int64)
+        vals = (rng.random(n, dtype=np.float32) * 100) - 50
+        mask = rng.random(n) > 0.1
+        return gids, mask, ts, vals, groups
+
+    def test_sum_min_max_avg_vs_oracle(self):
+        from greptimedb_tpu.ops.kernels import (
+            _SEG_SUM_PREFIX_THRESHOLD, sorted_grouped_aggregate)
+        gids, mask, ts, vals, groups = self._data()
+        assert groups > _SEG_SUM_PREFIX_THRESHOLD
+        ops = ("sum", "min", "max", "avg", "count")
+        (s, mn, mx, av, ct), counts = sorted_grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            tuple(jnp.asarray(vals) for _ in ops),
+            num_groups=groups, ops=ops)
+        import pandas as pd
+        df = pd.DataFrame({"g": gids[mask], "v": vals[mask]})
+        want = df.groupby("g")["v"].agg(["sum", "min", "max", "mean",
+                                         "count"])
+        got_s, got_mn = np.asarray(s), np.asarray(mn)
+        got_mx, got_av = np.asarray(mx), np.asarray(av)
+        got_ct = np.asarray(ct)
+        for g in want.index[:4000]:
+            np.testing.assert_allclose(got_s[g], want.loc[g, "sum"],
+                                       rtol=2e-4, atol=1e-3)
+            assert got_mn[g] == np.float32(want.loc[g, "min"])
+            assert got_mx[g] == np.float32(want.loc[g, "max"])
+            np.testing.assert_allclose(got_av[g], want.loc[g, "mean"],
+                                       rtol=2e-4, atol=1e-3)
+            assert got_ct[g] == want.loc[g, "count"]
+        # empty groups: count 0, min/max NaN-ish identity handling
+        empty = np.setdiff1d(np.arange(groups), gids[mask])[:50]
+        assert (got_ct[empty] == 0).all()
+
+    def test_segments_spanning_blocks(self):
+        """Shapes that hit every decomposition branch: empty, single-row,
+        single-block, two-block-no-inner, many-inner-blocks."""
+        from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+        lens = [0, 1, 5, 31, 32, 33, 63, 64, 65, 200, 1024]
+        groups = 9000                     # above the threshold
+        seg = []
+        for g, ln in enumerate(lens):
+            seg += [g] * ln
+        # the rest of the groups get 0-2 rows
+        rng = np.random.default_rng(1)
+        extra = np.sort(rng.integers(len(lens), groups, 5000))
+        gids = np.concatenate([np.array(seg, np.int32),
+                               extra.astype(np.int32)])
+        n = len(gids)
+        vals = (rng.random(n, dtype=np.float32) * 10) - 5
+        mask = np.ones(n, bool)
+        ts = np.arange(n, dtype=np.int64)
+        (mn, mx), _counts = sorted_grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals), jnp.asarray(vals)),
+            num_groups=groups, ops=("min", "max"))
+        mn, mx = np.asarray(mn), np.asarray(mx)
+        for g in range(len(lens)):
+            rows = vals[gids == g]
+            if len(rows):
+                assert mn[g] == rows.min(), f"min len={lens[g]}"
+                assert mx[g] == rows.max(), f"max len={lens[g]}"
+        for g in np.unique(extra)[:200]:
+            rows = vals[gids == g]
+            assert mn[g] == rows.min() and mx[g] == rows.max()
