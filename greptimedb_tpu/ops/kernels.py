@@ -364,13 +364,15 @@ def _pad_block(x, ident, n):
     return x, (n + pad) // _SEG_BLOCK
 
 
-#: above this group count, segment sums switch from exact edge-window
-#: gathers (O(groups*block), gather-bound at high cardinality) to a
-#: two-level prefix sum (two O(groups) gathers). The prefix form can
-#: carry ~1-ulp cancellation noise into small segments, so the exact
-#: form stays for the common low-cardinality group-bys whose results
-#: users read directly.
-_SEG_SUM_PREFIX_THRESHOLD = 8192
+#: above this group count, segment reductions switch from exact
+#: edge-window gathers (O(groups*block), gather-bound at high
+#: cardinality) to O(groups)-gather decompositions: sums use a
+#: two-level prefix sum; min/max use in-block sparse tables + block
+#: suffix/prefix scans. The prefix-sum form can carry ~1-ulp
+#: cancellation noise into small segments, so the exact form stays for
+#: the common low-cardinality group-bys whose results users read
+#: directly.
+_SEG_HIGH_CARD_THRESHOLD = 8192
 
 
 def _sorted_seg_sum(x, starts, ends, bs, be, has_inner, n):
@@ -387,7 +389,7 @@ def _sorted_seg_sum(x, starts, ends, bs, be, has_inner, n):
         acc = jnp.promote_types(x.dtype, jnp.float32)
     B = _SEG_BLOCK
     num_groups = starts.shape[0]
-    if num_groups <= _SEG_SUM_PREFIX_THRESHOLD and \
+    if num_groups <= _SEG_HIGH_CARD_THRESHOLD and \
             not jnp.issubdtype(x.dtype, jnp.integer):
         xp, nb = _pad_block(x.astype(acc), 0, n)
         block_sums = xp.reshape(nb, B).sum(axis=1)
@@ -447,7 +449,7 @@ def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
     ST = jnp.stack(st)                                    # [K, NB]
     B = _SEG_BLOCK
     num_groups = starts.shape[0]
-    if num_groups <= _SEG_SUM_PREFIX_THRESHOLD:
+    if num_groups <= _SEG_HIGH_CARD_THRESHOLD:
         # low cardinality: per-segment edge windows (cheap at small G)
         ln = jnp.maximum(be - bs, 1)
         k = _floor_log2(ln, K)

@@ -484,7 +484,7 @@ class TestSortedGroupedAggregate:
 
 
 class TestHighCardinalityPaths:
-    """Force num_groups above _SEG_SUM_PREFIX_THRESHOLD so the prefix-sum
+    """Force num_groups above _SEG_HIGH_CARD_THRESHOLD so the prefix-sum
     and in-block sparse-table paths (not the edge-window path) execute,
     cross-checked against the numpy oracle."""
 
@@ -498,9 +498,9 @@ class TestHighCardinalityPaths:
 
     def test_sum_min_max_avg_vs_oracle(self):
         from greptimedb_tpu.ops.kernels import (
-            _SEG_SUM_PREFIX_THRESHOLD, sorted_grouped_aggregate)
+            _SEG_HIGH_CARD_THRESHOLD, sorted_grouped_aggregate)
         gids, mask, ts, vals, groups = self._data()
-        assert groups > _SEG_SUM_PREFIX_THRESHOLD
+        assert groups > _SEG_HIGH_CARD_THRESHOLD
         ops = ("sum", "min", "max", "avg", "count")
         (s, mn, mx, av, ct), counts = sorted_grouped_aggregate(
             jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
@@ -521,9 +521,12 @@ class TestHighCardinalityPaths:
             np.testing.assert_allclose(got_av[g], want.loc[g, "mean"],
                                        rtol=2e-4, atol=1e-3)
             assert got_ct[g] == want.loc[g, "count"]
-        # empty groups: count 0, min/max NaN-ish identity handling
+        # empty groups: count 0 and min/max at the +/-inf identities
         empty = np.setdiff1d(np.arange(groups), gids[mask])[:50]
         assert (got_ct[empty] == 0).all()
+        if len(empty):
+            assert np.isposinf(got_mn[empty]).all()
+            assert np.isneginf(got_mx[empty]).all()
 
     def test_segments_spanning_blocks(self):
         """Shapes that hit every decomposition branch: empty, single-row,
