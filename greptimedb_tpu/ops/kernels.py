@@ -513,7 +513,8 @@ def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
     return jnp.where(ends > starts, out, ident)
 
 
-def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min):
+def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min,
+                       gids=None):
     """Per-segment lexicographic arg-extreme of (x, position).
 
     first = row with the smallest (ts, position); last = largest — matching
@@ -523,6 +524,26 @@ def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min):
     """
     B = _SEG_BLOCK
     ident = _max_ident(x.dtype) if is_min else _min_ident(x.dtype)
+    num_groups = starts.shape[0]
+    if gids is not None and num_groups > _SEG_HIGH_CARD_THRESHOLD:
+        # two-pass formulation so the cardinality-robust minmax does the
+        # heavy lifting: extreme value per segment, then the tie-breaking
+        # position (min pos for first / max pos for last) among the rows
+        # attaining it, located via one O(n) gather.
+        ext = _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n,
+                                 is_min=is_min)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        hit = x == ext[gids]
+        if is_min:
+            pos_fill = jnp.where(hit, iota, n)
+            pos = _sorted_seg_minmax(pos_fill, starts, ends, bs, be,
+                                     has_inner, n, is_min=True)
+            pos = jnp.where(pos >= n, -1, pos)
+        else:
+            pos_fill = jnp.where(hit, iota, -1)
+            pos = _sorted_seg_minmax(pos_fill, starts, ends, bs, be,
+                                     has_inner, n, is_min=False)
+        return ext, pos
 
     def pick(ta, pa, tb, pb):
         if is_min:
@@ -664,7 +685,8 @@ def _sorted_grouped_aggregate(gids, mask, ts, values, col_masks=(), *,
             ident = _max_ident(ts.dtype) if is_min else _min_ident(ts.dtype)
             key = jnp.where(m, ts, ident)
             ext_t, pos = _sorted_seg_argext(key, starts, ends, bs, be,
-                                            has_inner, n, is_min=is_min)
+                                            has_inner, n, is_min=is_min,
+                                            gids=gids)
             found = (ext_t != ident) & (pos >= 0)
             val = col[jnp.clip(pos, 0, n - 1)]
             empty = jnp.nan if jnp.issubdtype(fdt, jnp.floating) \

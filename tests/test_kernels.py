@@ -559,3 +559,30 @@ class TestHighCardinalityPaths:
         for g in np.unique(extra)[:200]:
             rows = vals[gids == g]
             assert mn[g] == rows.min() and mx[g] == rows.max()
+
+    def test_first_last_high_cardinality(self):
+        """first/last above the threshold (two-pass argext path) vs a
+        pandas oracle, with unsorted ts inside segments and ties."""
+        from greptimedb_tpu.ops.kernels import (
+            _SEG_HIGH_CARD_THRESHOLD, sorted_grouped_aggregate)
+        rng = np.random.default_rng(5)
+        n, groups = 120_000, 20_000
+        assert groups > _SEG_HIGH_CARD_THRESHOLD
+        gids = np.sort(rng.integers(0, groups, n)).astype(np.int32)
+        ts = rng.integers(0, 50, n).astype(np.int64)   # many ties
+        vals = rng.random(n, dtype=np.float32)
+        mask = rng.random(n) > 0.15
+        (first, last), _c = sorted_grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            (jnp.asarray(vals), jnp.asarray(vals)),
+            num_groups=groups, ops=("first", "last"))
+        first, last = np.asarray(first), np.asarray(last)
+        import pandas as pd
+        df = pd.DataFrame({"g": gids, "t": ts, "v": vals,
+                           "i": np.arange(n)})[mask]
+        # oracle: smallest (t, i) / largest (t, i) per group
+        fo = df.sort_values(["g", "t", "i"]).groupby("g").first()["v"]
+        lo = df.sort_values(["g", "t", "i"]).groupby("g").last()["v"]
+        for g in fo.index[:3000]:
+            assert first[g] == np.float32(fo.loc[g]), g
+            assert last[g] == np.float32(lo.loc[g]), g
