@@ -1,0 +1,91 @@
+"""Pallas TPU kernel for PromQL window-bounds counting.
+
+The hot per-eval computation is `hi[s, k] = #{l : b[s, l] <= k}` — how
+many samples of series s fall at or before step k (buckets b are
+elementwise-computed from timestamps; see ops/window.py). The XLA
+formulation (chunked [S, L, T] compare-reduce) measures ~890ms at the
+10k-series × 8192-sample × 1440-step shape on v5e.
+
+MEASURED OUTCOME: this kernel is correct but ~1.3s at the same shape —
+slower than XLA. The inner loop's cross-sublane broadcast of each
+sample column serializes on the VPU, and Mosaic's "dynamic indices only
+on sublanes" rule forbids the layout that would avoid it (every
+orientation of this computation needs either a dynamic lane index or a
+sublane broadcast). XLA's fused compare-reduce (ops/window.py
+_counts_leq_grid) remains the production path; this file stays as the
+measured record + the Pallas harness for future kernel work.
+
+Kernel layout (Mosaic only allows dynamic indexing on the sublane axis,
+not lanes): inputs arrive TRANSPOSED as b_t [L, S] so the inner loop
+walks samples along sublanes; series ride the 128-lane axis; the
+accumulator is the transposed output block [T_pad, 128] revisited
+across the L grid dimension (last grid dim iterates fastest, so all
+L-tiles of one S-tile run consecutively).
+
+`counts_leq_pallas` takes/returns the natural [S, L] / [S, T] layouts
+and performs the transposes at the XLA boundary. Tests run the kernel
+in interpret mode on CPU; real-TPU use is gated by the caller.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+S_LANES = 128       # series per program (lane axis)
+L_TILE = 512        # samples per grid step (sublane axis)
+
+
+def _kernel(bt_ref, out_ref, *, t_pad: int, l_tile: int):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ks = jax.lax.broadcasted_iota(jnp.int32, (t_pad, S_LANES), 0)
+
+    def body(l, acc):
+        col = bt_ref[l, :]                     # [S_LANES], dynamic sublane
+        return acc + (col[None, :] <= ks).astype(jnp.int32)
+
+    out_ref[:] += jax.lax.fori_loop(0, l_tile, body,
+                                    jnp.zeros((t_pad, S_LANES), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("nsteps", "interpret"))
+def counts_leq_pallas(b: jax.Array, nsteps: int,
+                      interpret: bool = False) -> jax.Array:
+    """hi[s, k] = #(b[s, l] <= k) for k < nsteps; b int32 [S, L] with
+    out-of-range samples already clipped to >= nsteps by the caller."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, L = b.shape
+    t_pad = -(-nsteps // 8) * 8                # sublane multiple
+    s_pad = (-S) % S_LANES
+    l_pad = (-L) % L_TILE
+    if s_pad or l_pad:
+        b = jnp.pad(b, ((0, s_pad), (0, l_pad)),
+                    constant_values=nsteps)    # pads count into no step
+    bt = b.T                                   # [Lp, Sp]
+    Lp, Sp = bt.shape
+
+    grid = (Sp // S_LANES, Lp // L_TILE)
+    out_t = pl.pallas_call(
+        functools.partial(_kernel, t_pad=t_pad, l_tile=L_TILE),
+        grid=grid,
+        in_specs=[pl.BlockSpec((L_TILE, S_LANES),
+                               lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((t_pad, S_LANES),
+                               lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t_pad, Sp), jnp.int32),
+        interpret=interpret,
+    )(bt)
+    return out_t.T[:S, :nsteps]

@@ -1,0 +1,35 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh).
+
+ops/pallas_window.py documents the measured outcome on real v5e: the
+XLA fused compare-reduce stays the production window-bounds path. The
+kernel itself must stay correct — it is the in-tree Pallas harness.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from greptimedb_tpu.ops.pallas_window import counts_leq_pallas  # noqa: E402
+
+
+@pytest.mark.parametrize("shape,steps", [
+    ((8, 512), 128),        # exact tiles
+    ((20, 300), 97),        # ragged everything
+    ((1, 1), 1),            # minimal
+    ((130, 1030), 200),     # pad across both grid dims
+])
+def test_counts_leq_matches_oracle(shape, steps):
+    rng = np.random.default_rng(hash(shape) % 2**32)
+    b = np.sort(rng.integers(0, steps + 1, shape).astype(np.int32), axis=1)
+    got = np.asarray(counts_leq_pallas(jnp.asarray(b), steps,
+                                       interpret=True))
+    want = (b[:, :, None] <= np.arange(steps)).sum(1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_out_of_range_buckets_excluded():
+    b = np.array([[0, 2, 5, 5, 5]], np.int32)   # 5 == nsteps → no step
+    got = np.asarray(counts_leq_pallas(jnp.asarray(b), 5, interpret=True))
+    assert got[0].tolist() == [1, 1, 2, 2, 2]
