@@ -154,7 +154,7 @@ class PromqlEngine:
     # public entry points
     # ------------------------------------------------------------------
     def execute_tql(self, stmt: sqlast.Tql, ctx: QueryContext) -> Output:
-        if stmt.kind not in ("eval", "evaluate"):
+        if stmt.kind not in ("eval", "evaluate", "explain", "analyze"):
             raise UnsupportedError(f"TQL {stmt.kind.upper()} not supported")
         start_ms = _parse_tql_time(stmt.start)
         end_ms = _parse_tql_time(stmt.end)
@@ -162,9 +162,71 @@ class PromqlEngine:
         lookback = _parse_tql_duration(stmt.lookback) if stmt.lookback \
             else DEFAULT_LOOKBACK_MS
         expr = parse_promql(stmt.query)
+        if stmt.kind == "explain":
+            return self._explain_output(expr, None)
         ev = _Eval(self, ctx, start_ms, end_ms, step_ms, lookback)
+        if stmt.kind == "analyze":
+            import time as _time
+            t0 = _time.perf_counter()
+            val = ev.eval(expr)
+            elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            nseries = len(getattr(val, "labels", [])) or 1
+            return self._explain_output(expr, {
+                "elapsed_ms": round(elapsed_ms, 2),
+                "series": nseries, "steps": len(ev.steps)})
         val = ev.eval(expr)
         return _to_record_batches(val, ev.steps)
+
+    def _explain_output(self, expr, analyze: Optional[dict]) -> Output:
+        """TQL EXPLAIN / ANALYZE (reference: tql_parser.rs parses all
+        three verbs; EXPLAIN shows the plan the planner built). Renders
+        the evaluation plan tree, one node per line."""
+        lines: List[str] = []
+
+        def walk(e, depth):
+            pad = "  " * depth
+            name = type(e).__name__
+            if isinstance(e, VectorSelector):
+                sel = ", ".join(f"{m.name}{m.op}{m.value!r}"
+                                for m in e.matchers)
+                rng = f"[{e.range_ms}ms]" if getattr(e, "range_ms", None) \
+                    else ""
+                lines.append(f"{pad}PromSeriesScan: {e.metric}{rng}"
+                             f" {{{sel}}}")
+            elif isinstance(e, Call):
+                lines.append(f"{pad}PromCall: {e.func}")
+            elif isinstance(e, Aggregate):
+                mod = ""
+                if e.by:
+                    mod = f" by ({', '.join(e.by)})"
+                elif e.without:
+                    mod = f" without ({', '.join(e.without)})"
+                lines.append(f"{pad}PromAggregate: {e.op}{mod}")
+            elif isinstance(e, Binary):
+                lines.append(f"{pad}PromBinary: {e.op}")
+            elif isinstance(e, NumberLiteral):
+                lines.append(f"{pad}Literal: {e.value}")
+            else:
+                lines.append(f"{pad}{name}")
+            for child in list(getattr(e, "args", []) or []):
+                if isinstance(child, PromExpr):
+                    walk(child, depth + 1)
+            for attr in ("expr", "lhs", "rhs"):
+                child = getattr(e, attr, None)
+                if isinstance(child, PromExpr):
+                    walk(child, depth + 1)
+
+        walk(expr, 0)
+        rows = {"plan_type": ["logical_plan"], "plan": ["\n".join(lines)]}
+        if analyze is not None:
+            rows["plan_type"].append("analyze")
+            rows["plan"].append(
+                f"elapsed: {analyze['elapsed_ms']}ms, series: "
+                f"{analyze['series']}, steps: {analyze['steps']}")
+        schema = Schema([ColumnSchema("plan_type", dt.STRING),
+                         ColumnSchema("plan", dt.STRING)])
+        return Output.record_batches(
+            [RecordBatch.from_pydict(schema, rows)], schema)
 
     def query_range(self, query: str, start_ms: int, end_ms: int,
                     step_ms: int, ctx: Optional[QueryContext] = None,

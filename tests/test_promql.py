@@ -485,3 +485,21 @@ class TestReviewRegressions2:
         from greptimedb_tpu.errors import GreptimeError
         with pytest.raises(GreptimeError):
             parse_prom_duration("abc")
+
+
+class TestTqlExplain:
+    def test_explain_plan_tree(self, fe):
+        _mk_cpu(fe)
+        out = fe.do_query("TQL EXPLAIN (0, 60, '1m')"
+                          " sum by (host) (rate(cpu[1m]))")[-1]
+        plan = out.batches[0].to_pydict()["plan"][0]
+        assert "PromAggregate: sum by (host)" in plan
+        assert "PromCall: rate" in plan
+        assert "PromSeriesScan: cpu[60000ms]" in plan
+
+    def test_analyze_reports_stats(self, fe):
+        _mk_cpu(fe)
+        out = fe.do_query("TQL ANALYZE (0, 100, '10s') cpu")[-1]
+        doc = out.batches[0].to_pydict()
+        assert doc["plan_type"] == ["logical_plan", "analyze"]
+        assert "elapsed" in doc["plan"][1] and "series: 2" in doc["plan"][1]
