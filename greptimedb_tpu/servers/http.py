@@ -54,9 +54,10 @@ def output_to_json(out: Output) -> Dict[str, Any]:
 
 class HttpServer:
     def __init__(self, frontend, user_provider: Optional[UserProvider] = None,
-                 addr: str = "127.0.0.1:4000"):
+                 addr: str = "127.0.0.1:4000", ssl_context=None):
         self.frontend = frontend
         self.user_provider = user_provider or NoopUserProvider()
+        self.ssl_context = ssl_context
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -91,7 +92,36 @@ class HttpServer:
         r.add_route("*", "/api/v1/series", self.handle_prom_api_series)
         r.add_route("*", "/api/v1/label/{name}/values",
                     self.handle_prom_api_label_values)
+        # Grafana/Prometheus compatibility probes
+        r.add_get("/api/v1/status/buildinfo", self.handle_prom_buildinfo)
+        r.add_route("*", "/api/v1/metadata", self.handle_prom_metadata)
         return app
+
+    async def handle_prom_buildinfo(self, request):
+        """Grafana probes this to detect the Prometheus flavor."""
+        from .mysql import SERVER_VERSION
+        return web.json_response({
+            "status": "success",
+            "data": {"version": "2.45.0",
+                     "application": f"greptimedb-tpu {SERVER_VERSION}",
+                     "revision": "", "branch": "", "buildUser": "",
+                     "buildDate": "", "goVersion": ""}})
+
+    async def handle_prom_metadata(self, request):
+        """Metric metadata: every field column of every table, typed as
+        untyped (the reference serves the same shape)."""
+        ctx = self._ctx(request)
+        out = {}
+        catalog = ctx.current_catalog
+        for schema_name in self.frontend.catalog.schema_names(catalog):
+            for tname in self.frontend.catalog.table_names(catalog,
+                                                           schema_name):
+                t = self.frontend.catalog.table(catalog, schema_name,
+                                                tname)
+                if t is None:
+                    continue
+                out[tname] = [{"type": "untyped", "help": "", "unit": ""}]
+        return web.json_response({"status": "success", "data": out})
 
     @web.middleware
     async def _error_middleware(self, request, handler):
@@ -469,7 +499,8 @@ class HttpServer:
             app = self.make_app()
             self._runner = web.AppRunner(app)
             await self._runner.setup()
-            site = web.TCPSite(self._runner, self.host, self.port)
+            site = web.TCPSite(self._runner, self.host, self.port,
+                               ssl_context=self.ssl_context)
             await site.start()
             if self.port == 0:
                 self.port = self._runner.addresses[0][1]

@@ -196,15 +196,39 @@ class _PgConnection:
 
         provider = self.server.user_provider
         if provider is not None and provider.requires_password:
-            self.io.send(b"R", struct.pack("!I", 3))   # cleartext password
-            msg = self.io.read_message()
-            if msg is None or msg[0] != ord("p"):
-                return False
-            password = msg[1].rstrip(b"\x00").decode()
-            if not provider.authenticate(user, password):
-                self.send_error(f'password authentication failed for '
-                                f'user "{user}"', "28P01", "FATAL")
-                return False
+            if self.server.auth_method == "md5":
+                # md5(md5(password + user) + salt), "md5"-prefixed hex
+                # (reference: pgwire md5 flow, auth_handler.rs)
+                import hashlib
+                import os as _os
+                salt = _os.urandom(4)
+                self.io.send(b"R", struct.pack("!I", 5) + salt)
+                msg = self.io.read_message()
+                if msg is None or msg[0] != ord("p"):
+                    return False
+                got = msg[1].rstrip(b"\x00").decode()
+                expected_pwd = provider.plain_password(user)
+                ok = False
+                if expected_pwd is not None:
+                    inner = hashlib.md5(
+                        (expected_pwd + user).encode()).hexdigest()
+                    want = "md5" + hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    ok = got == want
+                if not ok:
+                    self.send_error(f'password authentication failed for '
+                                    f'user "{user}"', "28P01", "FATAL")
+                    return False
+            else:
+                self.io.send(b"R", struct.pack("!I", 3))  # cleartext
+                msg = self.io.read_message()
+                if msg is None or msg[0] != ord("p"):
+                    return False
+                password = msg[1].rstrip(b"\x00").decode()
+                if not provider.authenticate(user, password):
+                    self.send_error(f'password authentication failed for '
+                                    f'user "{user}"', "28P01", "FATAL")
+                    return False
         self.ctx.username = user
         self.io.send(b"R", struct.pack("!I", 0))       # AuthenticationOk
         for k, v in (("server_version", "16.0"),
@@ -416,10 +440,12 @@ class PostgresServer:
 
     def __init__(self, instance, host: str = "127.0.0.1", port: int = 0,
                  user_provider=None,
-                 ssl_context: Optional[ssl_mod.SSLContext] = None):
+                 ssl_context: Optional[ssl_mod.SSLContext] = None,
+                 auth_method: str = "md5"):
         self.instance = instance
         self.user_provider = user_provider
         self.ssl_context = ssl_context
+        self.auth_method = auth_method
         self._next_conn_id = 1
         self._lock = threading.Lock()
         server_self = self

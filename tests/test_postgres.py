@@ -53,6 +53,15 @@ class MiniPgClient:
                 if code == 3:
                     assert password is not None, "server demanded password"
                     self._send(b"p", password.encode() + b"\x00")
+                elif code == 5:
+                    import hashlib
+                    assert password is not None, "server demanded password"
+                    salt = payload[4:8]
+                    inner = hashlib.md5(
+                        (password + user).encode()).hexdigest()
+                    resp = "md5" + hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send(b"p", resp.encode() + b"\x00")
                 elif code == 0:
                     pass
                 else:
