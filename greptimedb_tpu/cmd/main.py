@@ -89,11 +89,12 @@ def build_servers(opts: StandaloneOptions):
         host, _, port = addr.partition(":")
         return host or "127.0.0.1", int(port or 0)
 
-    servers = [HttpServer(fe, provider, opts.http_addr)]
     ssl_context = None
     if opts.tls:
         from ..servers.tls import TlsOption
         ssl_context = TlsOption.from_config(opts.tls).setup()
+    servers = [HttpServer(fe, provider, opts.http_addr,
+                          ssl_context=ssl_context)]
     if opts.enable_mysql:
         from ..servers.mysql import MysqlServer
         host, port = split_addr(opts.mysql_addr)
