@@ -162,16 +162,71 @@ def _gather(row2d: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.take_along_axis(row2d, idx, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("op", "nsteps"))
+def _rebase_i64_host(ts2d, t0, step=0, nsteps=1, range_ms=0):
+    """Host-validating guard against silent int64→int32 narrowing.
+
+    With jax_enable_x64 off (the norm on TPU), `jnp.asarray` narrows int64
+    host arrays to int32: epoch-ms timestamps wrap negative and the TS_PAD
+    sentinel becomes -1, breaking the sorted-order precondition every range
+    kernel relies on. When handed a host int64 ts matrix in that regime,
+    rebase it to int32 offsets from its minimum (remapping TS_PAD to int32
+    max so padding still sorts last) and shift t0 by the same base. Device
+    arrays and non-int64 inputs pass through untouched.
+
+    The whole quantity range the kernel computes with must fit int32:
+    the data span, t0, the last step end t0 + (nsteps-1)*step, and the
+    earliest window start t0 - range_ms are all validated (strictly below
+    int32 max: a sample rebasing exactly to int32 max would alias the pad
+    sentinel and be silently dropped).
+
+    Returns (ts2d, t0) safe to hand to jit.
+    """
+    if jax.config.jax_enable_x64:
+        return ts2d, t0
+    if not (isinstance(ts2d, np.ndarray) and ts2d.dtype == np.int64):
+        return ts2d, t0
+    valid = ts2d != TS_PAD
+    if valid.any():
+        base, hi = int(ts2d[valid].min()), int(ts2d[valid].max())
+    else:
+        # no samples: rebase the query grid onto itself so evaluation
+        # proceeds and every step reports ok=False (not a crash)
+        base = hi = int(t0)
+    i32 = np.iinfo(np.int32)
+    last_end = int(t0) + (int(nsteps) - 1) * int(step)
+    bounds = [hi - base, int(t0) - base, last_end - base,
+              int(t0) - int(range_ms) - base]
+    if any(b >= i32.max or b < i32.min for b in bounds):
+        raise ValueError(
+            f"timestamp/query span after rebase exceeds int32 "
+            f"({min(bounds)}..{max(bounds)}) and x64 is disabled: rebase to "
+            f"region-relative offsets first (see SeriesMatrix.device_arrays)")
+    rel = np.where(valid, ts2d - base, i32.max).astype(np.int32)
+    return rel, np.int32(int(t0) - base)
+
+
 def range_aggregate_cumsum(
-    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
-    t0, step, range_ms, *, op: str, nsteps: int, param: float = 0.0,
+    ts2d, val2d, lengths, t0, step, range_ms, *, op: str, nsteps: int,
+    param: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Evaluate a cumsum-path range function on the aligned step grid.
 
     Returns (result [S, T], ok [S, T]) — ok False means "no point for this
     series at this step" (NaN / absent in PromQL terms).
+
+    Host int64 timestamps are auto-rebased when x64 is off (step/range are
+    deltas and stay as passed; t0 shifts with the base).
     """
+    ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
+    return _range_aggregate_cumsum(ts2d, val2d, lengths, t0, step, range_ms,
+                                   op=op, nsteps=nsteps, param=param)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps"))
+def _range_aggregate_cumsum(
+    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+    t0, step, range_ms, *, op: str, nsteps: int, param: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
     S, L = ts2d.shape
     step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
     lo, hi = window_bounds(ts2d, step_ends, range_ms)
@@ -289,8 +344,20 @@ def range_aggregate_cumsum(
     raise ValueError(f"not a cumsum-path op: {op}")
 
 
-@functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "series_block"))
 def range_aggregate_gather(
+    ts2d, val2d, t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
+    param: float = 0.0, param2: float = 0.0, series_block: int = 128,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather-path range functions (host int64 ts auto-rebased, see
+    `range_aggregate_cumsum`)."""
+    ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
+    return _range_aggregate_gather(ts2d, val2d, t0, step, range_ms, op=op,
+                                   nsteps=nsteps, maxw=maxw, param=param,
+                                   param2=param2, series_block=series_block)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "series_block"))
+def _range_aggregate_gather(
     ts2d: jax.Array, val2d: jax.Array,
     t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
     param: float = 0.0, param2: float = 0.0, series_block: int = 128,
@@ -410,10 +477,18 @@ def _holt_winters(vals: jax.Array, mask: jax.Array, sf, tf) -> jax.Array:
     return s_fin
 
 
-@functools.partial(jax.jit, static_argnames=("nsteps",))
-def instant_select(ts2d: jax.Array, val2d: jax.Array,
-                   t0, step, lookback_ms, *, nsteps: int
+def instant_select(ts2d, val2d, t0, step, lookback_ms, *, nsteps: int
                    ) -> Tuple[jax.Array, jax.Array]:
+    """InstantManipulate (host int64 ts auto-rebased, see
+    `range_aggregate_cumsum`)."""
+    ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, lookback_ms)
+    return _instant_select(ts2d, val2d, t0, step, lookback_ms, nsteps=nsteps)
+
+
+@functools.partial(jax.jit, static_argnames=("nsteps",))
+def _instant_select(ts2d: jax.Array, val2d: jax.Array,
+                    t0, step, lookback_ms, *, nsteps: int
+                    ) -> Tuple[jax.Array, jax.Array]:
     """InstantManipulate: at each step pick the latest sample within the
     lookback window [t - lookback, t] (reference:
     src/promql/src/extension_plan/instant_manipulate.rs:46)."""

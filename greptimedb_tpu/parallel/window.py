@@ -79,18 +79,13 @@ def series_sharded_range_aggregate(
     pad = (-S) % mesh.size if mesh.size > 1 else 0
     if S == 0:
         raise ValueError("series_sharded_range_aggregate: empty series axis")
-    if ts2d.dtype == np.int64 and not jax.config.jax_enable_x64:
+    if isinstance(ts2d, np.ndarray) and ts2d.dtype == np.int64:
         # jnp silently narrows int64→int32 when x64 is off; rebase instead
         # of truncating (callers with epoch-ms timestamps should pass the
-        # SeriesMatrix.device_arrays form — this is the safety net)
-        finite = ts2d[ts2d != TS_PAD]
-        lo = int(finite.min()) if finite.size else 0
-        hi = int(finite.max()) if finite.size else 0
-        if hi - lo >= 2**31 - 1:
-            raise ValueError("timestamp span exceeds int32; rebase first")
-        ts2d = np.where(ts2d == TS_PAD, np.iinfo(np.int32).max,
-                        ts2d - lo).astype(np.int32)
-        t0, step, range_ms = int(t0) - lo, int(step), int(range_ms)
+        # SeriesMatrix.device_arrays form — this is the safety net, shared
+        # with the single-chip wrappers)
+        from ..ops.window import _rebase_i64_host
+        ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
     if pad:
         # sentinel must fit the (possibly rebased-to-int32) ts dtype
         sentinel = np.iinfo(ts2d.dtype).max

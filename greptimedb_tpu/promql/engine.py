@@ -49,6 +49,27 @@ _RANGE_FUNCS = {
 # which drop the metric name from results (all except last_over_time)
 _KEEP_NAME_RANGE_FUNCS = {"last_over_time"}
 
+
+def _from_device_f32(v) -> np.ndarray:
+    """Bring device results to host float64, honestly.
+
+    The device path computes in float32 (TPU has no f64); a raw cast to
+    float64 fabricates noise digits (f32 of 2.0/60 → 1.9999998807907104…).
+    A single f32 carries ~7.2 significant decimal digits and the window/rate
+    chains accumulate a few ulps, so quantize to 6 — emitted samples then
+    read as the values they actually are at device precision (rate of a
+    steady counter prints 2.0, not 1.9999998807907104)."""
+    a = np.asarray(v)
+    if a.dtype != np.float32:
+        return np.asarray(a, dtype=np.float64)
+    out = np.asarray(a, dtype=np.float64)
+    finite = np.isfinite(out) & (out != 0.0)
+    mag = np.floor(np.log10(np.abs(out, where=finite, out=np.ones_like(out))))
+    dec = 5.0 - mag
+    scale = np.power(10.0, dec, where=finite, out=np.ones_like(out))
+    good = finite & np.isfinite(scale) & (scale != 0)
+    return np.where(good, np.round(out * scale) / scale, out)
+
 _SIMPLE_FUNCS = {
     "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
     "ln": np.log, "log2": np.log2, "log10": np.log10, "sqrt": np.sqrt,
@@ -476,7 +497,7 @@ class _Eval:
             if t < dmin or t - win_ms > dmax:
                 return VectorVal(selection.labels, out_vals, out_ok)
             v, ok = kernel(selection.matrix, np.int64(t), 1)
-            v = np.asarray(v, dtype=np.float64)[:, :1]
+            v = _from_device_f32(v)[:, :1]
             ok = np.asarray(ok)[:, :1]
             out_vals[:] = np.repeat(v, self.nsteps, axis=1)
             out_ok[:] = np.repeat(ok, self.nsteps, axis=1)
@@ -492,7 +513,7 @@ class _Eval:
         n_pad = 1 << (n_eval - 1).bit_length() if n_eval > 1 else 1
         v, ok = kernel(selection.matrix, np.int64(t0 + j0 * self.step),
                        n_pad)
-        v = np.asarray(v, dtype=np.float64)[:, :n_eval]
+        v = _from_device_f32(v)[:, :n_eval]
         ok = np.asarray(ok)[:, :n_eval]
         out_vals[:, j0:j1 + 1] = v
         out_ok[:, j0:j1 + 1] = ok

@@ -571,10 +571,45 @@ def _match_field_pred(e: Expr, field_names: set) -> Optional[FieldFilter]:
 # execution
 # ---------------------------------------------------------------------------
 
+#: Below this many estimated rows the CPU columnar path wins: device
+#: round-trips dominate latency (BASELINE config 1: 281 ms device vs ~10 ms
+#: CPU at 10k rows) and the host path keeps float64 precision for DOUBLE
+#: columns, which the f32 device mirrors cannot. Cost-based dispatch playing
+#: the role of DataFusion's physical-plan costing in the reference
+#: (src/query/src/datafusion.rs).
+TPU_DISPATCH_MIN_ROWS = 131072
+
+
+def _estimated_table_rows(table) -> Optional[int]:
+    """Cheap upper-bound row estimate from memtable counters + SST metas —
+    no SST reads, no merged-scan build."""
+    regions = getattr(table, "regions", None)
+    if not regions:
+        return None
+    total = 0
+    for region in regions.values():
+        vc = getattr(region, "version_control", None)
+        if vc is None:
+            return None
+        v = vc.current
+        for mt in v.memtables.all_memtables():
+            total += mt.num_rows
+        for meta in v.ssts.all_files():
+            total += meta.num_rows
+    return total
+
+
 def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     plan = plan_for(table, a, query)
     if plan is None:
         return None
+    if not hasattr(table, "execute_tpu_plan"):
+        # Distributed tables always push down (the fallback would pull raw
+        # rows over the wire); local tables route small scans to the CPU
+        # columnar path, which is faster and float64-exact.
+        est = _estimated_table_rows(table)
+        if est is not None and est < TPU_DISPATCH_MIN_ROWS:
+            return None
     try:
         if hasattr(table, "execute_tpu_plan"):
             # distributed: aggregate pushdown — datanodes reduce their

@@ -171,5 +171,6 @@ if __name__ == "__main__":
     sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
+    # run goldens in the production numeric regime (x64 off, as on TPU)
+    jax.config.update("jax_enable_x64", False)
     sys.exit(main())

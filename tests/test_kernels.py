@@ -61,9 +61,12 @@ class TestGroupedAggregate:
         for g in range(G):
             sel = (gids == g) & mask
             if sel.any():
-                np.testing.assert_allclose(s[g], vals[sel].sum(), rtol=1e-9)
+                # f32 accumulation in the production (x64-off) regime
+                np.testing.assert_allclose(s[g], vals[sel].sum(), rtol=1e-4,
+                                           atol=1e-4)
                 assert int(c[g]) == sel.sum()
-                np.testing.assert_allclose(a[g], vals[sel].mean(), rtol=1e-9)
+                np.testing.assert_allclose(a[g], vals[sel].mean(), rtol=1e-4,
+                                           atol=1e-4)
                 np.testing.assert_allclose(mn[g], vals[sel].min())
                 np.testing.assert_allclose(mx[g], vals[sel].max())
             assert int(counts[g]) == sel.sum()
@@ -102,7 +105,7 @@ class TestGroupedAggregate:
                 np.testing.assert_allclose(sd[g], vals[sel].std(), rtol=1e-6)
 
     def test_time_bucket_combine(self):
-        ts = jnp.array([0, 999, 1000, 2500], dtype=jnp.int64)
+        ts = jnp.array([0, 999, 1000, 2500], dtype=jnp.int32)
         b = time_bucket_ids(ts, 0, 1000, 4)
         assert b.tolist() == [0, 0, 1, 2]
         gid = combine_group_ids(jnp.array([1, 0, 1, 0]), b, 4)
@@ -178,7 +181,7 @@ class TestWindow:
         m = make_matrix()
         # steps at 60s, 120s; range 60s → window (t-60s, t]
         out, ok = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             60_000, 60_000, 60_000, op="avg_over_time", nsteps=2)
         # series 0 window (0,60s]: samples at 10..60s → values 1..6 → avg 3.5
         np.testing.assert_allclose(out[0, 0], 3.5)
@@ -186,19 +189,19 @@ class TestWindow:
         np.testing.assert_allclose(out[0, 1], 9.5)
         assert not bool(ok[2, 0])  # empty series
         out, _ = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             60_000, 60_000, 60_000, op="count_over_time", nsteps=2)
         assert out[0, 0] == 6
 
     def test_min_max_gather(self):
         m = make_matrix()
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             60_000, 60_000, 60_000, op="max_over_time", nsteps=2, maxw=32)
         np.testing.assert_allclose(out[0, 0], 6.0)
         np.testing.assert_allclose(out[0, 1], 12.0)
         out, _ = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             60_000, 60_000, 60_000, op="min_over_time", nsteps=2, maxw=32)
         np.testing.assert_allclose(out[0, 0], 1.0)
 
@@ -206,7 +209,7 @@ class TestWindow:
         m = make_matrix()
         # series 0 increases by 1 every 10s → rate = 0.1/s
         out, ok = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             100_000, 100_000, 100_000, op="rate", nsteps=2)
         assert bool(ok[0, 0])
         np.testing.assert_allclose(out[0, 0], 0.1, rtol=1e-6)
@@ -216,7 +219,7 @@ class TestWindow:
         vals = np.array([0.0, 10.0, 20.0, 5.0, 15.0])  # reset at i=3
         m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
         out, ok = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             40_000, 40_000, 40_000, op="increase", nsteps=1)
         # within (0, 40000]: samples v=10,20,5,15 → adjusted 10,20,25,35
         # raw = 25; extrapolation factor: sampled=30000, durToStart/End=10000/0,
@@ -228,7 +231,7 @@ class TestWindow:
         vals = np.array([10.0, 8.0, 6.0, 4.0, 2.0])
         m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
         out, ok = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             40_000, 40_000, 40_000, op="delta", nsteps=1)
         np.testing.assert_allclose(out[0, 0], (2.0 - 8.0) * (40000 / 30000), rtol=1e-6)
 
@@ -237,11 +240,11 @@ class TestWindow:
         vals = np.array([1.0, 1.0, 2.0, 1.0, 1.0, 3.0])
         m = SeriesMatrix.build(np.zeros(6, np.int32), ts, vals, 1)
         out, _ = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             50_000, 50_000, 50_001, op="changes", nsteps=1)
         assert out[0, 0] == 3  # 1→2, 2→1, 1→3
         out, _ = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             50_000, 50_000, 50_001, op="resets", nsteps=1)
         assert out[0, 0] == 1
 
@@ -250,7 +253,7 @@ class TestWindow:
         vals = np.array([1.0, 2.0, 3.0, 4.0])
         m = SeriesMatrix.build(np.zeros(4, np.int32), ts, vals, 1)
         out, _ = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             30_000, 30_000, 30_001, op="quantile_over_time", nsteps=1,
             maxw=8, param=0.5)
         np.testing.assert_allclose(out[0, 0], 2.5)
@@ -260,20 +263,20 @@ class TestWindow:
         vals = 2.0 * np.arange(5) + 3.0  # slope 2 per 10s = 0.2/s
         m = SeriesMatrix.build(np.zeros(5, np.int32), ts, vals, 1)
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             40_000, 40_000, 40_001, op="deriv", nsteps=1, maxw=8)
-        np.testing.assert_allclose(out[0, 0], 0.2, rtol=1e-9)
+        np.testing.assert_allclose(out[0, 0], 0.2, rtol=1e-5)
 
     def test_instant_select_lookback(self):
         m = make_matrix()
         vals, ok = instant_select(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             55_000, 100_000, 300_000, nsteps=1)
         # series 1 latest sample at 50s (value 5.0) within 5m lookback
         assert bool(ok[1, 0]) and vals[1, 0] == 5.0
         # short lookback (1s) → no point
         vals, ok = instant_select(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             55_000, 100_000, 1_000, nsteps=1)
         assert not bool(ok[1, 0])
 
@@ -281,8 +284,7 @@ class TestWindow:
         ts = np.arange(0, 40_000, 10_000, dtype=np.int64)
         vals = np.array([1.0, 5.0, 2.0, 9.0])
         m = SeriesMatrix.build(np.zeros(4, np.int32), ts, vals, 1)
-        args = (jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
-                30_000, 30_000, 30_001)
+        args = (m.ts, m.values, m.lengths, 30_000, 30_000, 30_001)
         out, _ = range_aggregate_cumsum(*args, op="idelta", nsteps=1)
         np.testing.assert_allclose(out[0, 0], 7.0)
         out, _ = range_aggregate_cumsum(*args, op="last_over_time", nsteps=1)
@@ -328,33 +330,47 @@ class TestReviewRegressions:
         np.testing.assert_allclose(out[0, 0], 10.0)
 
     def test_first_last_preserve_int_dtype(self):
-        gids = jnp.asarray(np.array([0], np.int32))
-        mask = jnp.ones(1, bool)
-        ts = jnp.asarray(np.array([5], np.int64))
+        import jax
+        gids = np.array([0], np.int32)
+        mask = np.ones(1, bool)
+        ts = np.array([5], np.int64)
         big = np.array([2**60 + 7], np.int64)
-        (fst,), _ = grouped_aggregate(gids, mask, ts, (jnp.asarray(big),),
+        if jax.config.jax_enable_x64:
+            (fst,), _ = grouped_aggregate(gids, mask, ts, (big,),
+                                          num_groups=2, ops=("first",))
+            assert fst.dtype == jnp.int64
+            assert int(fst[0]) == 2**60 + 7
+        else:
+            # production regime: values beyond int32 cannot ride the device
+            # silently — the host guard must refuse, not truncate
+            with pytest.raises(ValueError, match="rebase"):
+                grouped_aggregate(gids, mask, ts, (big,),
+                                  num_groups=2, ops=("first",))
+        # in-range int values keep an integer dtype end to end
+        small = np.array([123456], np.int64)
+        (fst,), _ = grouped_aggregate(gids, mask, ts, (small,),
                                       num_groups=2, ops=("first",))
-        assert fst.dtype == jnp.int64
-        assert int(fst[0]) == 2**60 + 7
+        assert jnp.issubdtype(fst.dtype, jnp.integer)
+        assert int(fst[0]) == 123456
 
     def test_holt_winters(self):
         ts = np.arange(0, 60_000, 10_000, dtype=np.int64)
         vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
         m = SeriesMatrix.build(np.zeros(6, np.int32), ts, vals, 1)
         out, ok = range_aggregate_gather(
-            jnp.asarray(m.ts), jnp.asarray(m.values),
+            m.ts, m.values,
             50_000, 50_000, 50_001, op="holt_winters", nsteps=1, maxw=8,
             param=0.5, param2=0.5)
         assert bool(ok[0, 0])
         # perfectly linear data → smoothed value equals the last sample
-        np.testing.assert_allclose(out[0, 0], 6.0, rtol=1e-9)
+        np.testing.assert_allclose(out[0, 0], 6.0, rtol=1e-5)
 
     def test_rate_negative_first_sample_no_zero_cap(self):
         ts = np.arange(0, 30_000, 10_000, dtype=np.int64)
         vals = np.array([-5.0, 5.0, 10.0])
         m = SeriesMatrix.build(np.zeros(3, np.int32), ts, vals, 1)
         out, ok = range_aggregate_cumsum(
-            jnp.asarray(m.ts), jnp.asarray(m.values), jnp.asarray(m.lengths),
+            m.ts, m.values, m.lengths,
             30_000, 30_000, 30_001, op="increase", nsteps=1)
         assert bool(ok[0, 0])
         assert float(out[0, 0]) > 0  # not sign-flipped by a negative cap

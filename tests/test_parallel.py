@@ -130,12 +130,14 @@ def test_time_blocked_window(op):
 
 
 def test_distributed_first_last_int_exact():
-    # large int64 values must survive first/last without a float32 round-trip
+    # int values above 2**24 must survive first/last without a float32
+    # round-trip (odd values > 2**24 are not f32-representable); kept inside
+    # int32 so the path works in the production x64-off regime
     n, groups = 257, 3
     gids = RNG.integers(0, groups, n).astype(np.int32)
     mask = np.ones(n, bool)
     ts = np.arange(n).astype(np.int32)
-    vals = (RNG.integers(0, 2**30, n).astype(np.int64) * 4 + 1)
+    vals = (RNG.integers(2**26, 2**28, n).astype(np.int64) * 4 + 1)
     mesh = make_mesh()
     (last,), _ = distributed_grouped_aggregate(
         gids, mask, ts, (vals,), num_groups=groups, ops=("last",), mesh=mesh)
@@ -155,13 +157,14 @@ def test_series_sharded_rebase_path_with_padding():
     vals = RNG.random(S * per).astype(np.float32)
     m = SeriesMatrix.build(sids, ts, vals, S)
     mesh = make_mesh()
+    prev = _jax.config.jax_enable_x64
     _jax.config.update("jax_enable_x64", False)
     try:
         out, ok = series_sharded_range_aggregate(
             m.ts, m.values, m.lengths, base + 60_000, 30_000, 60_000,
             op="sum_over_time", nsteps=4, mesh=mesh)
     finally:
-        _jax.config.update("jax_enable_x64", True)
+        _jax.config.update("jax_enable_x64", prev)
     end0 = base + 60_000
     for s in range(3):
         sel = (ts[sids == s] > end0 - 60_000) & (ts[sids == s] <= end0)

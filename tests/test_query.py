@@ -23,6 +23,47 @@ from greptimedb_tpu.storage.engine import EngineConfig, StorageEngine
 from greptimedb_tpu.table import CreateTableRequest, NumbersTable
 
 
+@pytest.fixture(autouse=True)
+def _force_tpu_dispatch(monkeypatch):
+    """These tests cross-check the TPU path against the fallback on small
+    tables; disable the cost-based row threshold so the device path actually
+    executes (its dispatch behavior is tested separately below)."""
+    monkeypatch.setattr(tpu_exec, "TPU_DISPATCH_MIN_ROWS", 0)
+
+
+def test_cost_dispatch_small_scan_uses_cpu(tmp_path, monkeypatch):
+    """BASELINE config 1 regression: small scans must take the CPU columnar
+    path — exact float64 results, no device round-trip latency."""
+    monkeypatch.setattr(tpu_exec, "TPU_DISPATCH_MIN_ROWS", 131072)
+    storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+    mito = MitoEngine(storage)
+    cm = MemoryCatalogManager()
+    schema = Schema([
+        ColumnSchema("host", dt.STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", dt.FLOAT64),
+    ])
+    t = mito.create_table(CreateTableRequest(
+        "monitor", schema, primary_key_indices=[0]))
+    cm.register_table(CAT, SCH, "monitor", t)
+    t.insert({"host": ["host1", "host2"], "ts": [1000, 1000],
+              "cpu": [66.6, 77.7]})
+    engine = QueryEngine(cm)
+    executed = []
+    orig = tpu_exec.region_moment_frames
+    monkeypatch.setattr(tpu_exec, "region_moment_frames",
+                        lambda *a, **k: (executed.append(1), orig(*a, **k))[1])
+    rows = run(engine, "SELECT host, avg(cpu) AS c FROM monitor "
+                       "GROUP BY host ORDER BY host").batches[0].to_pylist()
+    # float64-exact: 66.6 survives only on the CPU path (device mirror is f32)
+    assert [(r["host"], r["c"]) for r in rows] == \
+        [("host1", 66.6), ("host2", 77.7)]
+    assert executed == [], "small scan took the device path"
+    storage.close()
+
+
 @pytest.fixture()
 def world(tmp_path):
     storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))

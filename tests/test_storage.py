@@ -362,15 +362,14 @@ class TestReviewRegressions:
     def test_i64_guard_without_x64(self):
         import jax
         from greptimedb_tpu.ops.kernels import sort_merge_dedup
-        if jax.config.jax_enable_x64:
-            # simulate the TPU default inside this test only
-            jax.config.update("jax_enable_x64", False)
-            try:
-                ts = np.array([1_700_000_000_000, 1_700_000_000_000 + 2**32],
-                              dtype=np.int64)
-                with pytest.raises(ValueError, match="rebase"):
-                    sort_merge_dedup(np.zeros(2, np.int32), ts,
-                                     np.arange(2, dtype=np.int64),
-                                     np.zeros(2, np.int8), np.ones(2, bool))
-            finally:
-                jax.config.update("jax_enable_x64", True)
+        prev = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", False)  # the TPU default
+        try:
+            ts = np.array([1_700_000_000_000, 1_700_000_000_000 + 2**32],
+                          dtype=np.int64)
+            with pytest.raises(ValueError, match="rebase"):
+                sort_merge_dedup(np.zeros(2, np.int32), ts,
+                                 np.arange(2, dtype=np.int64),
+                                 np.zeros(2, np.int8), np.ones(2, bool))
+        finally:
+            jax.config.update("jax_enable_x64", prev)
