@@ -43,6 +43,15 @@ class MemKv:
             self._data[key] = value
             return True
 
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        """Atomic delete iff the current value equals `expect` (lease
+        release must not clobber a lock a later holder re-acquired)."""
+        with self._lock:
+            if self._data.get(key) != expect:
+                return False
+            del self._data[key]
+            return True
+
     def incr(self, key: str, start: int = 0) -> int:
         """Atomic counter (sequence allocation, reference sequence.rs)."""
         with self._lock:
@@ -100,6 +109,14 @@ class FileKv(MemKv):
             if cur != expect:
                 return False
             self._data[key] = value
+            self._persist_locked()
+            return True
+
+    def compare_and_delete(self, key, expect):
+        with self._lock:
+            if self._data.get(key) != expect:
+                return False
+            del self._data[key]
             self._persist_locked()
             return True
 

@@ -65,9 +65,9 @@ class DistributedLock:
             return False
         if json.loads(current)["holder"] != self.holder:
             return False
-        return self.kv.compare_and_put(self.key, current, None) \
-            if hasattr(self.kv, "compare_and_delete") else \
-            self.kv.delete(self.key)
+        # atomic: a plain get/delete could remove a lock another node
+        # acquired after our lease expired between the get and the delete
+        return self.kv.compare_and_delete(self.key, current)
 
     def holder_of(self, now: Optional[float] = None) -> Optional[str]:
         now = time.time() if now is None else now

@@ -114,6 +114,12 @@ class MetaSrv:
         self._detectors: Dict[int, PhiAccrualFailureDetector] = {}
         self._phi_threshold = phi_threshold
         self._mailboxes: Dict[int, List[dict]] = {}
+        # Startup grace: peers persist in the KV but _last_seen does not.
+        # After a metasrv restart every persisted peer would read seen=None
+        # and a single failover tick would reassign ALL healthy nodes'
+        # regions (split-brain: the old leaders keep serving writes). Treat
+        # process start as the last-seen time for unseen persisted peers.
+        self._start_time = time.time()
 
     # ---- membership ----
     def register_datanode(self, peer: Peer) -> None:
@@ -240,8 +246,8 @@ class MetaSrv:
         now_t = time.time() if now is None else now
         dead = {p.id for p in self.failed_datanodes(now_t)}
         for p in self.peers():
-            seen = self._last_seen.get(p.id)
-            if seen is None or now_t - seen > 2 * self.datanode_lease_secs:
+            seen = self._last_seen.get(p.id, self._start_time)
+            if now_t - seen > 2 * self.datanode_lease_secs:
                 dead.add(p.id)
         if not dead:
             return []

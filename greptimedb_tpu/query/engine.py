@@ -266,8 +266,26 @@ class QueryEngine:
             if residual and j.kind != "inner":
                 raise UnsupportedError(
                     "non-equi conditions are only supported on INNER JOIN")
-            joined = joined.merge(right, how=j.kind, left_on=left_on,
-                                  right_on=right_on)
+            # SQL semantics: NULL = NULL is not true, but pandas merge
+            # matches NaN keys to each other. Null-keyed rows are removed
+            # from any side whose rows must *match* to survive, and for
+            # preserved sides re-enter as unmatched rows.
+            lnull = joined[left_on].isna().any(axis=1)
+            rnull = right[right_on].isna().any(axis=1)
+            if j.kind == "full":
+                merged = joined[~lnull].merge(
+                    right[~rnull], how="outer", left_on=left_on,
+                    right_on=right_on)
+                joined = pd.concat(
+                    [merged, joined[lnull], right[rnull]],
+                    ignore_index=True)
+            else:
+                lkeys = joined[~lnull] if j.kind in ("inner", "right") \
+                    else joined
+                rkeys = right[~rnull] if j.kind in ("inner", "left") \
+                    else right
+                joined = lkeys.merge(rkeys, how=j.kind, left_on=left_on,
+                                     right_on=right_on)
             for c in residual:
                 ev = Evaluator(joined)
                 mask = ev.eval(_qualify_columns(c, joined.columns))

@@ -302,9 +302,13 @@ class _Connection:
 
     # ---- handshake ----
     def handshake(self) -> bool:
-        nonce = hashlib.sha1(
-            struct.pack("<Id", self.conn_id, threading.get_ident())
-        ).digest()[:20]
+        # Per-connection random salt, printable non-zero bytes (0x21-0x7E)
+        # as real MySQL servers send: NUL would truncate the scramble in
+        # libmysqlclient-style clients, and a deterministic salt would let a
+        # sniffed mysql_native_password response be replayed.
+        import secrets
+        nonce = bytes(0x21 + secrets.randbelow(0x7F - 0x21)
+                      for _ in range(20))
         caps = SERVER_CAPABILITIES
         if self.server.ssl_context is not None:
             caps |= CLIENT_SSL

@@ -96,11 +96,22 @@ class _MessageIO:
         self.sock.sendall(data)
 
 
+_PG_ROW_RETURNING = {"select", "show", "describe", "desc", "tql", "explain",
+                     "with", "values", "table"}
+
+
+def _returns_rows(sql: str) -> bool:
+    word = sql.lstrip().split(None, 1)
+    return bool(word) and word[0].lower() in _PG_ROW_RETURNING
+
+
 class _PgPortal:
-    __slots__ = ("sql",)
+    __slots__ = ("sql", "result", "described")
 
     def __init__(self, sql: str):
         self.sql = sql
+        self.result = None     # Output cached by Describe, reused by Execute
+        self.described = False  # Describe sent RowDescription already
 
 
 class _PgConnection:
@@ -113,6 +124,10 @@ class _PgConnection:
         self.ctx = QueryContext(channel=Channel.POSTGRES)
         self.stmts: Dict[str, str] = {}       # name -> sql with $N params
         self.portals: Dict[str, _PgPortal] = {}
+        # v3 protocol: after an error in the extended protocol, discard
+        # messages until Sync (a pipelined Execute after a failed Bind must
+        # not run a stale portal)
+        self._in_error = False
 
     # ---- message helpers ----
     def send_error(self, message: str, code: str = "XX000",
@@ -124,6 +139,12 @@ class _PgConnection:
 
     def send_ready(self) -> None:
         self.io.send(b"Z", b"I")
+
+    def ext_error(self, message: str, code: str = "XX000") -> None:
+        """ErrorResponse inside the extended protocol: enter the
+        skip-until-Sync state the v3 protocol requires."""
+        self.send_error(message, code)
+        self._in_error = True
 
     def send_row_description(self, schema) -> None:
         body = struct.pack("!H", len(schema.column_schemas))
@@ -298,46 +319,102 @@ class _PgConnection:
             else:
                 params.append(body[pos:pos + plen].decode())
                 pos += plen
-        sql = self.stmts.get(stmt_name, "")
+        sql = self.stmts.get(stmt_name)
+        if sql is None:
+            self.ext_error(
+                f"prepared statement {stmt_name!r} does not exist", "26000")
+            return
         self.portals[portal] = _PgPortal(_substitute_pg_params(sql, params))
         self.io.send(b"2")                              # BindComplete
 
     def handle_describe(self, body: bytes) -> None:
+        """Describe must return the RowDescription for row-returning
+        statements/portals (v3 protocol; the reference's pgwire plans at
+        Describe, src/servers/src/postgres/handler.rs:648). JDBC and
+        psycopg3 extended mode plan on this. Portals execute here and cache
+        the result for Execute; parametrized statement Describe probes the
+        schema with NULL-substituted params."""
         import re
         kind = chr(body[0])
         name = body[1:].rstrip(b"\x00").decode()
         if kind == "S":
-            sql = self.stmts.get(name, "")
+            sql = self.stmts.get(name)
+            if sql is None:
+                self.ext_error(
+                    f"prepared statement {name!r} does not exist", "26000")
+                return
             nparams = len(set(re.findall(r"\$(\d+)", sql)))
             # all parameters described as text; values coerce at parse time
             self.io.send(b"t", struct.pack("!H", nparams)
                          + struct.pack("!I", OID_TEXT) * nparams)
-        # row description needs planning; it is sent with the Execute
-        # response instead (clients accept 'T' arriving there)
+            if _returns_rows(sql):
+                probe = _substitute_pg_params(sql, [None] * nparams) \
+                    if nparams else sql
+                # prefer a LIMIT 0 probe: schema without scanning any rows
+                # (Execute re-runs the statement through its portal anyway)
+                candidates = []
+                if probe.lstrip().split(None, 1)[0].lower() == "select":
+                    candidates.append(probe.rstrip().rstrip(";") + " LIMIT 0")
+                candidates.append(probe)
+                for cand in candidates:
+                    try:
+                        out = self._execute_sql(cand)
+                    except Exception:  # noqa: BLE001 — try next / NoData
+                        logger.debug("describe probe failed: %s", cand,
+                                     exc_info=True)
+                        continue
+                    if out.is_batches and out.batches:
+                        self.send_row_description(out.batches[0].schema)
+                        return
+            self.io.send(b"n")                          # NoData
+            return
+        portal = self.portals.get(name)
+        if portal is None:
+            self.ext_error(f"portal {name!r} does not exist", "34000")
+            return
+        if _returns_rows(portal.sql):
+            try:
+                portal.result = self._execute_sql(portal.sql)
+            except GreptimeError as e:
+                self.ext_error(str(e))
+                return
+            except Exception as e:  # noqa: BLE001
+                logger.exception("postgres describe failed: %s", portal.sql)
+                self.ext_error(str(e))
+                return
+            if portal.result.is_batches and portal.result.batches:
+                self.send_row_description(portal.result.batches[0].schema)
+                portal.described = True
+                return
         self.io.send(b"n")                              # NoData
 
     def handle_execute(self, body: bytes) -> None:
         name = body[:body.index(b"\x00")].decode()
         portal = self.portals.get(name)
         if portal is None:
-            self.send_error(f"portal {name!r} does not exist", "34000")
+            self.ext_error(f"portal {name!r} does not exist", "34000")
             return
         sql = portal.sql
         try:
-            out = self._execute_sql(sql)
+            # reuse the result a preceding Describe already computed
+            out, portal.result = portal.result, None
+            described, portal.described = portal.described, False
+            if out is None:
+                out = self._execute_sql(sql)
             if out.is_batches:
                 batches = out.batches
                 if batches:
-                    self.send_row_description(batches[0].schema)
+                    if not described:  # Describe already sent the 'T'
+                        self.send_row_description(batches[0].schema)
                     self.send_rows(batches)
-                else:
+                elif not described:
                     self.io.send(b"T", struct.pack("!H", 0))
             self.send_complete(sql, out)
         except GreptimeError as e:
-            self.send_error(str(e))
+            self.ext_error(str(e))
         except Exception as e:  # noqa: BLE001
             logger.exception("postgres execute failed: %s", sql)
-            self.send_error(str(e))
+            self.ext_error(str(e))
 
     def handle_close(self, body: bytes) -> None:
         kind = chr(body[0])
@@ -361,8 +438,14 @@ class _PgConnection:
                 ch = chr(tag)
                 if ch == "X":                           # Terminate
                     return
-                if ch == "Q":
+                if ch == "S":                           # Sync
+                    self._in_error = False              # error state ends
+                    self.send_ready()
+                elif ch == "Q":
+                    self._in_error = False
                     self.handle_simple_query(body.decode())
+                elif self._in_error and ch in "PBDECH":
+                    pass  # v3: discard until Sync after an error
                 elif ch == "P":
                     self.handle_parse(body)
                 elif ch == "B":
@@ -373,8 +456,6 @@ class _PgConnection:
                     self.handle_execute(body)
                 elif ch == "C":
                     self.handle_close(body)
-                elif ch == "S":                         # Sync
-                    self.send_ready()
                 elif ch == "H":                         # Flush
                     pass
                 else:

@@ -287,6 +287,27 @@ class TestDistributedLockAndElection:
         assert b.try_acquire(now=t0 + 6)  # a's lease expired
         assert a.holder_of(now=t0 + 7) == "b"
 
+    def test_stale_release_does_not_break_new_holder(self):
+        # release() must be compare-and-delete: after a's lease expires and
+        # b takes over, a's late release must NOT delete b's lock
+        from greptimedb_tpu.meta.lock import DistributedLock
+        kv = MemKv()
+        a = DistributedLock(kv, "x", holder="a", lease_secs=5)
+        b = DistributedLock(kv, "x", holder="b", lease_secs=5)
+        t0 = time.time()
+        assert a.try_acquire(now=t0)
+        assert b.try_acquire(now=t0 + 6)   # takeover after expiry
+        assert not a.release()             # stale holder: no-op
+        assert b.holder_of(now=t0 + 7) == "b"
+
+    def test_compare_and_delete_atomicity(self):
+        kv = MemKv()
+        kv.put("k", b"v1")
+        assert not kv.compare_and_delete("k", b"other")
+        assert kv.get("k") == b"v1"
+        assert kv.compare_and_delete("k", b"v1")
+        assert kv.get("k") is None
+
     def test_context_manager(self):
         from greptimedb_tpu.meta.lock import DistributedLock
         kv = MemKv()

@@ -124,6 +124,43 @@ class TestFailover:
         _beat_regularly(srv, 2, t0, t0 + 10)
         assert srv.failover_check(now=t0 + 10) == []
 
+    def test_metasrv_restart_grace_period(self, tmp_path):
+        """After a metasrv restart, persisted peers have no in-memory
+        heartbeat record; the first datanode to heartbeat must NOT trigger
+        a mass reassignment of every other (healthy) node's regions —
+        persisted peers get a full grace window from process start."""
+        from greptimedb_tpu.meta.kv import FileKv
+        kv = FileKv(str(tmp_path / "meta.kv"))
+        srv1 = MetaSrv(kv, datanode_lease_secs=5.0)
+        srv1.register_datanode(Peer(1, "dn1"))
+        srv1.register_datanode(Peer(2, "dn2"))
+        t0 = time.time()
+        srv1.handle_heartbeat(1, now=t0)
+        srv1.handle_heartbeat(2, now=t0)
+        route = srv1.create_table_route("greptime.public.t", [0, 1], now=t0)
+        assert {rr.leader.id for rr in route.region_routes} == {1, 2}
+        srv1.put_table_info("greptime.public.t", {"stub": True})
+        # "restart": a fresh MetaSrv over the same persisted KV — routes
+        # and peers are there, heartbeat history is not
+        srv2 = MetaSrv(kv, datanode_lease_secs=5.0)
+        assert {p.id for p in srv2.peers()} == {1, 2}
+        t1 = srv2._start_time
+        srv2.handle_heartbeat(1, now=t1)       # only node 1 beat so far
+        # immediately after restart: within grace, node 2 is NOT failed over
+        assert srv2.failover_check(now=t1 + 1) == []
+        # node 2 heartbeats within the grace window → stays healthy forever
+        srv2.handle_heartbeat(2, now=t1 + 2)
+        _beat_regularly(srv2, 1, t1, t1 + 15)
+        _beat_regularly(srv2, 2, t1, t1 + 15)
+        assert srv2.failover_check(now=t1 + 15) == []
+        # but a peer that never heartbeats after restart IS failed over
+        # once the grace window (2x lease) lapses
+        srv3 = MetaSrv(kv, datanode_lease_secs=5.0)
+        t2 = srv3._start_time
+        _beat_regularly(srv3, 1, t2, t2 + 12)
+        moves = srv3.failover_check(now=t2 + 12)
+        assert moves and all(m["from"] == 2 and m["to"] == 1 for m in moves)
+
     def test_no_alive_targets_is_noop(self, cluster):
         fe, _, srv, _, _ = cluster
         fe.do_query(DDL)

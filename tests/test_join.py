@@ -55,9 +55,52 @@ class TestJoins:
                          " ORDER BY meta.host")
         assert rows == [("a", 1.0), ("b", 2.0), ("d", None)]
 
+    def test_null_keys_never_match(self, fe):
+        # SQL: NULL = NULL is not true — pandas merge would match NaN keys
+        fe.do_query("CREATE TABLE lt (id STRING, ts TIMESTAMP TIME INDEX,"
+                    " k STRING, v DOUBLE, PRIMARY KEY(id))")
+        fe.do_query("CREATE TABLE rt (id STRING, ts TIMESTAMP TIME INDEX,"
+                    " k STRING, w DOUBLE, PRIMARY KEY(id))")
+        fe.do_query("INSERT INTO lt (id, ts, k, v) VALUES"
+                    " ('l1', 1, 'x', 1.0), ('l2', 2, NULL, 2.0)")
+        fe.do_query("INSERT INTO rt (id, ts, k, w) VALUES"
+                    " ('r1', 1, 'x', 10.0), ('r2', 2, NULL, 20.0)")
+        rows = _rows(fe, "SELECT lt.id, rt.id FROM lt"
+                         " JOIN rt ON lt.k = rt.k")
+        assert rows == [("l1", "r1")]      # no NULL-NULL match
+        rows = _rows(fe, "SELECT lt.id, rt.id, w FROM lt"
+                         " LEFT JOIN rt ON lt.k = rt.k ORDER BY lt.id")
+        assert rows == [("l1", "r1", 10.0), ("l2", None, None)]
+        rows = _rows(fe, "SELECT lt.id, rt.id FROM lt"
+                         " RIGHT JOIN rt ON lt.k = rt.k ORDER BY rt.id")
+        assert rows == [("l1", "r1"), (None, "r2")]
+
     def test_cross_join(self, fe):
         rows = _rows(fe, "SELECT count(*) FROM metrics CROSS JOIN meta")
         assert rows == [(9,)]
+
+    def test_full_outer_join(self, fe):
+        rows = _rows(fe, "SELECT metrics.host, meta.host, cpu, dc"
+                         " FROM metrics FULL JOIN meta"
+                         " ON metrics.host = meta.host")
+        assert sorted(rows, key=str) == sorted([
+            ("a", "a", 1.0, "us-east"), ("b", "b", 2.0, "us-west"),
+            ("c", None, 3.0, None), (None, "d", None, "eu-1")], key=str)
+
+    def test_full_outer_join_null_keys(self, fe):
+        fe.do_query("CREATE TABLE fl (id STRING, ts TIMESTAMP TIME INDEX,"
+                    " k STRING, PRIMARY KEY(id))")
+        fe.do_query("CREATE TABLE fr (id STRING, ts TIMESTAMP TIME INDEX,"
+                    " k STRING, PRIMARY KEY(id))")
+        fe.do_query("INSERT INTO fl (id, ts, k) VALUES ('l1', 1, 'x'),"
+                    " ('l2', 2, NULL)")
+        fe.do_query("INSERT INTO fr (id, ts, k) VALUES ('r1', 1, 'x'),"
+                    " ('r2', 2, NULL)")
+        rows = _rows(fe, "SELECT fl.id, fr.id FROM fl"
+                         " FULL JOIN fr ON fl.k = fr.k")
+        # NULL keys never match, but full-join preserves both null rows
+        assert sorted(rows, key=str) == sorted(
+            [("l1", "r1"), ("l2", None), (None, "r2")], key=str)
 
     def test_aliased_self_join(self, fe):
         rows = _rows(fe, "SELECT l.host, r.host FROM metrics l"

@@ -38,6 +38,7 @@ class MiniMysqlClient:
         nonce = greeting[pos:pos + 8]
         pos += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
         nonce += greeting[pos:pos + 12]
+        self.nonce = nonce
         caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
                 | CLIENT_PLUGIN_AUTH)
         if database:
@@ -262,6 +263,19 @@ class TestMysqlProtocol:
             "SELECT cpu FROM pst WHERE host = ?")
         names, rows = client.stmt_execute(stmt2, ("h2",))
         assert rows == [["4.75"]]
+
+    def test_handshake_salt_random_printable(self, server):
+        # real MySQL servers send a per-connection random salt of printable
+        # non-zero bytes: NUL truncates the scramble in libmysqlclient, and
+        # a deterministic salt allows auth-response replay
+        c1 = MiniMysqlClient(server.port)
+        c2 = MiniMysqlClient(server.port)
+        for c in (c1, c2):
+            assert len(c.nonce) == 20
+            assert all(0x21 <= b <= 0x7E for b in c.nonce), c.nonce
+        assert c1.nonce != c2.nonce, "salt must differ per connection"
+        c1.close()
+        c2.close()
 
     def test_multiple_clients(self, server):
         c1 = MiniMysqlClient(server.port)

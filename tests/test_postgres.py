@@ -250,6 +250,87 @@ class TestPostgresProtocol:
         names, rows = client.query("SHOW TABLES")
         assert ["vis"] in rows
 
+    def _collect_until_ready(self, c):
+        tags = {}
+        while True:
+            tag, payload = c._read_message()
+            tags.setdefault(tag, []).append(payload)
+            if tag == "Z":
+                return tags
+
+    def test_describe_portal_returns_row_description(self, client):
+        # v3 protocol: drivers that plan on Describe (JDBC, psycopg3
+        # extended) need the real RowDescription at Describe time
+        c = client
+        c.query("CREATE TABLE dsc (host STRING, ts TIMESTAMP TIME INDEX,"
+                " cpu DOUBLE, PRIMARY KEY(host))")
+        c.query("INSERT INTO dsc VALUES ('a', 1000, 1.5)")
+        c._send(b"P", b"\x00SELECT host, cpu FROM dsc\x00"
+                + struct.pack("!H", 0))
+        c._send(b"B", b"\x00\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"D", b"P\x00")
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert "T" in tags, f"Describe portal replied {sorted(tags)}"
+        assert c._parse_row_description(tags["T"][0]) == ["host", "cpu"]
+        # Execute must not repeat the RowDescription Describe already sent
+        c._send(b"B", b"\x00\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"D", b"P\x00")
+        c._send(b"E", b"\x00" + struct.pack("!I", 0))
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert len(tags.get("T", [])) == 1
+        assert [r for r in map(c._parse_data_row, tags.get("D", []))] == \
+            [["a", "1.5"]]
+
+    def test_describe_statement_returns_schema(self, client):
+        c = client
+        c.query("CREATE TABLE dss (host STRING, ts TIMESTAMP TIME INDEX,"
+                " cpu DOUBLE, PRIMARY KEY(host))")
+        c._send(b"P", b"s1\x00SELECT cpu, host FROM dss WHERE host = $1\x00"
+                + struct.pack("!H", 0))
+        c._send(b"D", b"Ss1\x00")
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert "t" in tags         # ParameterDescription: one text param
+        assert struct.unpack_from("!H", tags["t"][0], 0)[0] == 1
+        assert "T" in tags, f"Describe statement replied {sorted(tags)}"
+        assert c._parse_row_description(tags["T"][0]) == ["cpu", "host"]
+
+    def test_bind_unknown_statement_errors(self, client):
+        c = client
+        c._send(b"B", b"\x00nope\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert "E" in tags and b"26000" in tags["E"][0]
+        # connection still usable afterwards
+        assert c.query("CREATE TABLE ok2 (ts TIMESTAMP TIME INDEX,"
+                       " v DOUBLE)") == "CREATE"
+
+    def test_error_skips_pipeline_until_sync(self, client):
+        # v3: after an extended-protocol error, everything before Sync is
+        # discarded — a pipelined Execute must NOT run a stale portal
+        c = client
+        c.query("CREATE TABLE pipe (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        c.query("INSERT INTO pipe VALUES (1, 1.0)")
+        # bind the unnamed portal to a valid statement first
+        c._send(b"P", b"\x00SELECT v FROM pipe\x00" + struct.pack("!H", 0))
+        c._send(b"B", b"\x00\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"E", b"\x00" + struct.pack("!I", 0))
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert len(tags.get("D", [])) == 1
+        # now a failing Bind followed by a pipelined Execute of the stale
+        # unnamed portal: the Execute must be discarded, not served
+        c._send(b"B", b"\x00gone\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"E", b"\x00" + struct.pack("!I", 0))
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert "E" in tags and b"26000" in tags["E"][0]
+        assert "D" not in tags and "C" not in tags
+        # recovered after Sync
+        assert c.query("SELECT v FROM pipe")[1] == [["1.0"]]
+
 
 class TestPostgresAuth:
     @pytest.fixture()
