@@ -748,9 +748,15 @@ def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
             col_masks.append(scan.device_valid(m.column))
             ops.append(m.op)
 
+    # segment ends are free on the host (run boundaries are already
+    # computed); shipping them skips the device binary search, the dominant
+    # cost at high run cardinality
+    run_ends = np.full(nbucket, n, dtype=np.int32)
+    run_ends[:nruns - 1] = run_starts[1:]
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
-        num_groups=nbucket, ops=tuple(ops), has_col_masks=True)
+        num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
+        ends=run_ends)
     counts = np.asarray(counts)[:nruns]
     res_np = [np.asarray(r)[:nruns] for r in results]
 

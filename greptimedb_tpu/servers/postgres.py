@@ -352,10 +352,14 @@ class _PgConnection:
                     if nparams else sql
                 # prefer a LIMIT 0 probe: schema without scanning any rows
                 # (Execute re-runs the statement through its portal anyway)
+                word = probe.lstrip().split(None, 1)[0].lower()
                 candidates = []
-                if probe.lstrip().split(None, 1)[0].lower() == "select":
+                if word in ("select", "with", "values", "table"):
                     candidates.append(probe.rstrip().rstrip(";") + " LIMIT 0")
-                candidates.append(probe)
+                if word in ("show", "describe", "desc"):
+                    candidates.append(probe)  # metadata queries are cheap
+                # expensive non-LIMITable statements (TQL, EXPLAIN) fall
+                # through to NoData rather than executing twice
                 for cand in candidates:
                     try:
                         out = self._execute_sql(cand)
@@ -440,6 +444,12 @@ class _PgConnection:
                     return
                 if ch == "S":                           # Sync
                     self._in_error = False              # error state ends
+                    # Describe-cached results live only within one pipeline
+                    # batch: replaying them in a later cycle would miss
+                    # intervening writes, and an un-Executed portal would
+                    # pin its whole result set for the connection lifetime
+                    for p in self.portals.values():
+                        p.result = None
                     self.send_ready()
                 elif ch == "Q":
                     self._in_error = False

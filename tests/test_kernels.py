@@ -576,6 +576,33 @@ class TestHighCardinalityPaths:
             rows = vals[gids == g]
             assert mn[g] == rows.min() and mx[g] == rows.max()
 
+    def test_precomputed_ends_match_device_bounds(self):
+        """The host-ends fast path (LSM callers ship run boundaries) must
+        agree exactly with the on-device searchsorted bounds."""
+        from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+        rng = np.random.default_rng(9)
+        n, groups = 100_000, 11_000
+        gids = np.sort(rng.integers(0, groups, n)).astype(np.int32)
+        mask = rng.random(n) > 0.2
+        ts = np.arange(n, dtype=np.int32)
+        vals = rng.normal(size=n).astype(np.float32)
+        ends = np.cumsum(np.bincount(gids, minlength=groups),
+                         dtype=np.int64).astype(np.int32)
+        ops = ("sum", "avg", "min", "max", "count", "first", "last")
+        values = tuple(vals for _ in ops)
+        got, counts = sorted_grouped_aggregate(
+            gids, mask, ts, values, num_groups=groups, ops=ops, ends=ends)
+        want, want_counts = sorted_grouped_aggregate(
+            jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+            tuple(jnp.asarray(v) for v in values),
+            num_groups=groups, ops=ops)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(want_counts))
+        for op, g, w in zip(ops, got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-5, atol=1e-5, err_msg=op,
+                                       equal_nan=True)
+
     def test_first_last_high_cardinality(self):
         """first/last above the threshold (two-pass argext path) vs a
         pandas oracle, with unsorted ts inside segments and ties."""

@@ -297,6 +297,25 @@ class TestPostgresProtocol:
         assert "T" in tags, f"Describe statement replied {sorted(tags)}"
         assert c._parse_row_description(tags["T"][0]) == ["cpu", "host"]
 
+    def test_describe_cache_not_stale_across_sync(self, client):
+        # a result cached by Describe lives only within one pipeline batch:
+        # an Execute in a later cycle must see intervening writes
+        c = client
+        c.query("CREATE TABLE stale (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        c.query("INSERT INTO stale VALUES (1, 1.0)")
+        c._send(b"P", b"\x00SELECT v FROM stale\x00" + struct.pack("!H", 0))
+        c._send(b"B", b"\x00\x00" + struct.pack("!HHH", 0, 0, 0))
+        c._send(b"D", b"P\x00")
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        assert "T" in tags
+        c.query("INSERT INTO stale VALUES (2, 2.0)")
+        c._send(b"E", b"\x00" + struct.pack("!I", 0))
+        c._send(b"S")
+        tags = self._collect_until_ready(c)
+        got = sorted(r[0] for r in map(c._parse_data_row, tags.get("D", [])))
+        assert got == ["1.0", "2.0"], got
+
     def test_bind_unknown_statement_errors(self, client):
         c = client
         c._send(b"B", b"\x00nope\x00" + struct.pack("!HHH", 0, 0, 0))
