@@ -131,8 +131,7 @@ def config3_tsbs_double_highcard(scale):
 def config4_promql(scale):
     import jax
     import jax.numpy as jnp
-    from greptimedb_tpu.ops.window import (
-        SeriesMatrix, range_aggregate_cumsum)
+    from greptimedb_tpu.ops.window import AlignedWindowEval, SeriesMatrix
 
     num_series = int(10_000 * max(scale, 0.1))
     pts = 5760                            # 24h at 15s scrape
@@ -147,199 +146,25 @@ def config4_promql(scale):
     d_vals = jax.device_put(d_vals)
     d_lens = jax.device_put(d_lens)
     nsteps = 1440                         # 24h at 1m step
+    add = jax.jit(lambda v, s: v + s)
 
-    @jax.jit
-    def eval_rate(ts2d, v2d, lens, shift):
-        r, ok = range_aggregate_cumsum(
-            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
-            op="rate", nsteps=nsteps)
-        a, ok2 = range_aggregate_cumsum(
-            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
-            op="avg_over_time", nsteps=nsteps)
-        return r, a, ok & ok2
+    def eval_once(i):
+        """Engine-style evaluation: AlignedWindowEval shares the bounds
+        pass, cumsums, and the one stacked gather between rate and
+        avg_over_time — the same path PromqlEngine takes."""
+        v2 = add(d_vals, jnp.float32(i))
+        awe = AlignedWindowEval(d_ts, v2, d_lens, 300_000 - base, 60_000,
+                                300_000, nsteps)
+        r, ok = awe.eval("rate")
+        a, ok2 = awe.eval("avg_over_time")
+        return r, a, jnp.logical_and(ok, ok2)
 
-    out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(0))
+    out = eval_once(0)
     float(np.asarray(out[0])[0, 0])
     iters = 4
     t0 = time.perf_counter()
     for i in range(iters):
-        out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(i))
-    float(np.asarray(out[0])[0, 0])
-    dt = (time.perf_counter() - t0) / iters
-    _p("4_promql_rate_avg_24h", dt * 1e3, "ms/eval",
-       {"series": num_series, "points": n, "steps": nsteps,
-        "points_per_s_m": round(n / dt / 1e6, 1),
-        "outputs_per_s_m": round(2 * num_series * nsteps / dt / 1e6, 1)})
-
-
-# ---------------------------------------------------------------------------
-def config5_downsample(tmpdir, scale):
-    from greptimedb_tpu.datanode.instance import (
-        DatanodeInstance, DatanodeOptions)
-    from greptimedb_tpu.frontend.instance import FrontendInstance
-
-    n_rows = int(8e6 * max(scale, 0.1))
-    per_sst = n_rows // 4
-    dn = DatanodeInstance(DatanodeOptions(
-        data_home=f"{tmpdir}/ds", register_numbers_table=False))
-    dn.start()
-    fe = FrontendInstance(dn)
-    fe.start()
-    fe.do_query("CREATE TABLE raw (host STRING, ts TIMESTAMP TIME INDEX,"
-                " v DOUBLE, PRIMARY KEY(host))")
-    fe.do_query("CREATE TABLE agg (host STRING, ts TIMESTAMP TIME INDEX,"
-                " v DOUBLE, PRIMARY KEY(host))")
-    raw = fe.catalog.table("greptime", "public", "raw")
-    rng = np.random.default_rng(3)
-    n_hosts = 100
-    secs_per_sst = per_sst // n_hosts     # every host emits 1 point/sec
-    t_load = time.perf_counter()
-    for s in range(4):
-        base_ts = s * secs_per_sst * 1000
-        ts = np.tile(np.arange(secs_per_sst, dtype=np.int64) * 1000
-                     + base_ts, n_hosts)
-        host = np.repeat([f"h{i}" for i in range(n_hosts)], secs_per_sst)
-        cols = {"host": host.tolist(), "ts": ts.tolist(),
-                "v": rng.random(len(ts)).tolist()}
-        raw.insert(cols)
-        raw.flush()
-    n_rows = 4 * secs_per_sst * n_hosts
-    load_dt = time.perf_counter() - t_load
-
-    from greptimedb_tpu.storage.downsample import downsample_region
-    agg = fe.catalog.table("greptime", "public", "agg")
-    src_region = next(iter(raw.regions.values()))
-    dst_region = next(iter(agg.regions.values()))
-    t0 = time.perf_counter()
-    downsample_region(src_region, dst_region, stride_ms=60_000,
-                      aggs={"v": "avg"})
-    dt = time.perf_counter() - t0
-    out_rows = sum(b.num_rows for b in agg.scan_batches())
-    _p("5_downsample_1s_to_1m", n_rows / dt / 1e6, "Mrows/s",
-       {"rows_in": n_rows, "rows_out": out_rows,
-        "load_rows_per_s": round(n_rows / load_dt),
-        "downsample_s": round(dt, 2)})
-    fe.shutdown()
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows-scale", type=float, default=1.0,
-                    help="scale factor on row counts (1.0 = full size)")
-    ap.add_argument("--configs", default="1,2,3,4,5")
-    ap.add_argument("--block-rows", type=int, default=50_000_000)
-    args = ap.parse_args()
-    import tempfile
-    want = set(args.configs.split(","))
-    with tempfile.TemporaryDirectory() as tmpdir:
-        if "1" in want:
-            config1_monitor(tmpdir)
-        if "2" in want:
-            config2_tsbs_single(args.rows_scale)
-        if "3" in want:
-            config3_tsbs_double_highcard(args.rows_scale)
-        if "3b" in want:
-            config3_blocked_1b(block_rows=args.block_rows)
-        if "4" in want:
-            config4_promql(args.rows_scale)
-        if "5" in want:
-            config5_downsample(tmpdir, args.rows_scale)
-
-
-
-
-def config3_blocked_1b(total_rows: int = 1_000_000_000,
-                       block_rows: int = 50_000_000):
-    """BASELINE config 3 at its true scale: 1B rows streamed through
-    HBM-sized time blocks inside ONE device program (lax.fori_loop over
-    blocks — one dispatch, no per-block host round trips), per-block
-    aggregation into accumulated moments — the time-axis blocking design
-    from SURVEY §5/§7. Block data is generated on device (measures the
-    scan+aggregate+merge path, not host→device transfer)."""
-    import jax
-    import jax.numpy as jnp
-    from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
-
-    groups = 10_000 * 12
-    # exact sorted-uniform ids without int32-overflowing products
-    # (x64 is off on TPU): block = groups * reps rows
-    reps = max(1, block_rows // groups)
-    block_rows = groups * reps
-    n_blocks = max(1, total_rows // block_rows)
-
-    @jax.jit
-    def run(key):
-        gids = jnp.repeat(jnp.arange(groups, dtype=jnp.int32), reps)
-        ts = jnp.zeros((block_rows,), jnp.int32)
-        mask = jnp.ones((block_rows,), bool)
-
-        def body(i, acc):
-            acc_s, acc_c = acc
-            kv = jax.random.fold_in(key, i)
-            vals = tuple(
-                jax.random.uniform(jax.random.fold_in(kv, j),
-                                   (block_rows,), jnp.float32) * 100
-                for j in range(5))
-            sums, counts = sorted_grouped_aggregate(
-                gids, mask, ts, vals, num_groups=groups, ops=("sum",) * 5)
-            return acc_s + jnp.stack(sums), acc_c + counts
-
-        acc = (jnp.zeros((5, groups), jnp.float32),
-               jnp.zeros((groups,), jnp.int32))
-        acc_s, acc_c = jax.lax.fori_loop(0, n_blocks, body, acc)
-        return acc_s / jnp.maximum(acc_c, 1)[None, :]
-
-    key = jax.random.PRNGKey(0)
-    out = run(key)
-    float(np.asarray(out)[0, 0])                   # compile + warmup
-    t0 = time.perf_counter()
-    out = run(jax.random.PRNGKey(1))
-    got = np.asarray(out)
-    dt = time.perf_counter() - t0
-    rows = n_blocks * block_rows
-    # sanity: uniform[0,100) values → every group mean near 50
-    assert abs(float(got.mean()) - 50.0) < 1.0, got.mean()
-    _p("3b_tsbs_double_groupby_1B_blocked", rows / dt / 1e6,
-       "Mrows/s", {"rows": rows, "blocks": n_blocks, "groups": groups,
-                   "block_rows": block_rows, "wall_s": round(dt, 1)})
-
-
-def config4_promql(scale):
-    import jax
-    import jax.numpy as jnp
-    from greptimedb_tpu.ops.window import (
-        SeriesMatrix, range_aggregate_cumsum)
-
-    num_series = int(10_000 * max(scale, 0.1))
-    pts = 5760                            # 24h at 15s scrape
-    n = num_series * pts
-    rng = np.random.default_rng(11)
-    sids = np.repeat(np.arange(num_series, dtype=np.int32), pts)
-    ts = np.tile(np.arange(pts, dtype=np.int64) * 15_000, num_series)
-    vals = np.cumsum(rng.random(n, dtype=np.float32), dtype=np.float32)
-    matrix = SeriesMatrix.build(sids, ts, vals, num_series)
-    d_ts, d_vals, d_lens, base = matrix.device_arrays()
-    d_ts = jax.device_put(d_ts)
-    d_vals = jax.device_put(d_vals)
-    d_lens = jax.device_put(d_lens)
-    nsteps = 1440                         # 24h at 1m step
-
-    @jax.jit
-    def eval_rate(ts2d, v2d, lens, shift):
-        r, ok = range_aggregate_cumsum(
-            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
-            op="rate", nsteps=nsteps)
-        a, ok2 = range_aggregate_cumsum(
-            ts2d, v2d + shift, lens, 300_000 - base, 60_000, 300_000,
-            op="avg_over_time", nsteps=nsteps)
-        return r, a, ok & ok2
-
-    out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(0))
-    float(np.asarray(out[0])[0, 0])
-    iters = 4
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = eval_rate(d_ts, d_vals, d_lens, jnp.float32(i))
+        out = eval_once(i)
     float(np.asarray(out[0])[0, 0])
     dt = (time.perf_counter() - t0) / iters
     _p("4_promql_rate_avg_24h", dt * 1e3, "ms/eval",

@@ -116,14 +116,16 @@ def _counts_leq_grid(ts2d: jax.Array, t0, step, nsteps: int) -> jax.Array:
     b = jnp.clip(-jnp.floor_divide(t0 - safe_ts, step), 0, nsteps) \
         .astype(jnp.int32)
     b = jnp.where(is_pad, nsteps, b)
-    ks = jnp.arange(nsteps, dtype=jnp.int32)
+    cmp_dtype = jnp.int16 if nsteps + 1 < 2**15 else jnp.int32
+    b = b.astype(cmp_dtype)   # halve compare width: 2x VPU lanes
+    ks = jnp.arange(nsteps, dtype=cmp_dtype)
     chunk = max(1, min(S, 512))
     pad = (-S) % chunk
     if pad:
         # padded rows are garbage and sliced off; padding avoids the
         # dynamic_slice start clamp silently duplicating rows
         b = jnp.concatenate(
-            [b, jnp.full((pad, L), nsteps, jnp.int32)], axis=0)
+            [b, jnp.full((pad, L), nsteps, b.dtype)], axis=0)
     outs = []
     for i in range(0, S + pad, chunk):
         part = jax.lax.dynamic_slice_in_dim(b, i, chunk, 0)
@@ -137,6 +139,31 @@ def _counts_leq_grid(ts2d: jax.Array, t0, step, nsteps: int) -> jax.Array:
 #: O(S*T*log L) gather-bound binary search (crossover ~55k at measured
 #: v5e gather/VPU rates)
 _BUCKETIZE_MAX_LEN = 32768
+
+
+@functools.partial(jax.jit, static_argnames=("step", "range_ms", "nsteps"))
+def compute_window_bounds(ts2d, t0, *, step: int, range_ms: int,
+                          nsteps: int) -> Tuple[jax.Array, jax.Array]:
+    """Standalone window-bounds kernel for callers that reuse bounds across
+    range functions (rate + avg_over_time over one selector share them —
+    the bounds pass dominates PromQL evaluation at 10k-series scale).
+
+    When the window is step-aligned (range % step == 0, the common PromQL
+    shape) and the extension is not wider than the grid itself, lo is a
+    shifted hi: ONE extended compare-reduce over T + range/step steps
+    replaces the two separate passes. Wide-range instant queries
+    (shift >> nsteps, e.g. rate(x[1d]) at one step) keep the two-pass
+    form, which is O(nsteps)."""
+    T = int(nsteps)
+    L = ts2d.shape[1]
+    if (L <= _BUCKETIZE_MAX_LEN and T > 1 and step > 0
+            and range_ms % step == 0 and range_ms >= 0
+            and range_ms // step <= T):
+        shift = range_ms // step
+        ext = _ext_counts(ts2d, t0, step=step, range_ms=range_ms, nsteps=T)
+        return ext[:, :T], ext[:, shift:]
+    step_ends = t0 + jnp.arange(T, dtype=ts2d.dtype) * step
+    return window_bounds(ts2d, step_ends, range_ms)
 
 
 def window_bounds(ts2d: jax.Array, step_ends: jax.Array, range_ms: int
@@ -207,7 +234,7 @@ def _rebase_i64_host(ts2d, t0, step=0, nsteps=1, range_ms=0):
 
 def range_aggregate_cumsum(
     ts2d, val2d, lengths, t0, step, range_ms, *, op: str, nsteps: int,
-    param: float = 0.0,
+    param: float = 0.0, bounds: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Evaluate a cumsum-path range function on the aligned step grid.
 
@@ -215,9 +242,16 @@ def range_aggregate_cumsum(
     series at this step" (NaN / absent in PromQL terms).
 
     Host int64 timestamps are auto-rebased when x64 is off (step/range are
-    deltas and stay as passed; t0 shifts with the base).
+    deltas and stay as passed; t0 shifts with the base). `bounds` lets
+    callers reuse one `compute_window_bounds` result across several range
+    functions over the same selector — the bounds pass dominates PromQL
+    evaluation at 10k-series scale.
     """
     ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
+    if bounds is not None:
+        return _range_aggregate_cumsum_pre(
+            ts2d, val2d, lengths, t0, step, range_ms, bounds[0], bounds[1],
+            op=op, nsteps=nsteps, param=param)
     return _range_aggregate_cumsum(ts2d, val2d, lengths, t0, step, range_ms,
                                    op=op, nsteps=nsteps, param=param)
 
@@ -227,9 +261,25 @@ def _range_aggregate_cumsum(
     ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
     t0, step, range_ms, *, op: str, nsteps: int, param: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
-    S, L = ts2d.shape
     step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
     lo, hi = window_bounds(ts2d, step_ends, range_ms)
+    return _rac_body(ts2d, val2d, lengths, lo, hi, step_ends, range_ms,
+                     op=op, nsteps=nsteps)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps"))
+def _range_aggregate_cumsum_pre(
+    ts2d: jax.Array, val2d: jax.Array, lengths: jax.Array,
+    t0, step, range_ms, lo, hi, *, op: str, nsteps: int, param: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    step_ends = t0 + jnp.arange(nsteps, dtype=ts2d.dtype) * step
+    return _rac_body(ts2d, val2d, lengths, lo, hi, step_ends, range_ms,
+                     op=op, nsteps=nsteps)
+
+
+def _rac_body(ts2d, val2d, lengths, lo, hi, step_ends, range_ms, *,
+              op: str, nsteps: int) -> Tuple[jax.Array, jax.Array]:
+    S, L = ts2d.shape
     idx = jnp.arange(L, dtype=jnp.int32)
     valid = idx[None, :] < lengths[:, None]
     fv = val2d.dtype
@@ -305,7 +355,6 @@ def _range_aggregate_cumsum(
         last_v = pick_last()
         if op == "delta":
             raw = last_v - first_v
-            first_for_zero = jnp.zeros_like(first_v)  # no zero-capping for gauges
             is_counter = False
         else:
             # counter-reset correction: adjusted[i] = v[i] + sum of resets<=i
@@ -315,42 +364,57 @@ def _range_aggregate_cumsum(
             corr = jnp.cumsum(contrib, axis=1)
             adj = val2d + corr
             raw = _gather(adj, hi1) - _gather(adj, jnp.minimum(lo, L - 1))
-            first_for_zero = first_v
             is_counter = True
-        # Prometheus extrapolation (extrapolate_rate.rs:100-200)
-        ms = jnp.asarray(range_ms, fv)
-        range_start = step_ends[None, :].astype(fv) - ms
-        range_end = step_ends[None, :].astype(fv)
-        dur_to_start = first_t - range_start
-        dur_to_end = range_end - last_t
-        sampled = last_t - first_t
-        avg_dur = sampled / jnp.maximum(count - 1, 1).astype(fv)
-        threshold = avg_dur * 1.1
-        if is_counter:
-            # cap extrapolation below zero for counters (only meaningful when
-            # the first sample is non-negative, per extrapolate_rate.rs)
-            dur_to_zero = jnp.where((raw > 0) & (first_for_zero >= 0),
-                                    sampled * (first_for_zero / jnp.where(raw == 0, 1, raw)),
-                                    jnp.inf)
-            dur_to_start = jnp.minimum(dur_to_start, dur_to_zero)
-        ext_start = jnp.where(dur_to_start < threshold, dur_to_start, avg_dur / 2)
-        ext_end = jnp.where(dur_to_end < threshold, dur_to_end, avg_dur / 2)
-        factor = (sampled + ext_start + ext_end) / jnp.where(sampled == 0, 1, sampled)
-        out = raw * factor
-        if op == "rate":
-            out = out / (ms / 1000.0)
-        return out, ok2 & (sampled > 0)
+        return _extrapolate(raw, first_t, last_t, first_v, count, step_ends,
+                            range_ms, op=op, is_counter=is_counter)
 
     raise ValueError(f"not a cumsum-path op: {op}")
+
+
+def _extrapolate(raw, first_t, last_t, first_v, count, step_ends, range_ms,
+                 *, op: str, is_counter: bool):
+    """Prometheus extrapolation epilogue (extrapolate_rate.rs:100-200),
+    shared by the per-op kernel and the stacked-gather fast path."""
+    fv = raw.dtype
+    ok2 = count >= 2
+    ms = jnp.asarray(range_ms, fv)
+    range_start = step_ends[None, :].astype(fv) - ms
+    range_end = step_ends[None, :].astype(fv)
+    dur_to_start = first_t - range_start
+    dur_to_end = range_end - last_t
+    sampled = last_t - first_t
+    avg_dur = sampled / jnp.maximum(count - 1, 1).astype(fv)
+    threshold = avg_dur * 1.1
+    if is_counter:
+        # cap extrapolation below zero for counters (only meaningful when
+        # the first sample is non-negative, per extrapolate_rate.rs)
+        dur_to_zero = jnp.where((raw > 0) & (first_v >= 0),
+                                sampled * (first_v / jnp.where(raw == 0, 1, raw)),
+                                jnp.inf)
+        dur_to_start = jnp.minimum(dur_to_start, dur_to_zero)
+    ext_start = jnp.where(dur_to_start < threshold, dur_to_start, avg_dur / 2)
+    ext_end = jnp.where(dur_to_end < threshold, dur_to_end, avg_dur / 2)
+    factor = (sampled + ext_start + ext_end) / jnp.where(sampled == 0, 1, sampled)
+    out = raw * factor
+    if op == "rate":
+        out = out / (ms / 1000.0)
+    return out, ok2 & (sampled > 0)
 
 
 def range_aggregate_gather(
     ts2d, val2d, t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
     param: float = 0.0, param2: float = 0.0, series_block: int = 128,
+    bounds: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Gather-path range functions (host int64 ts auto-rebased, see
-    `range_aggregate_cumsum`)."""
+    `range_aggregate_cumsum`; `bounds` reuses a `compute_window_bounds`
+    result)."""
     ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
+    if bounds is not None:
+        return _range_aggregate_gather_pre(
+            ts2d, val2d, t0, step, range_ms, bounds[0], bounds[1], op=op,
+            nsteps=nsteps, maxw=maxw, param=param, param2=param2,
+            series_block=series_block)
     return _range_aggregate_gather(ts2d, val2d, t0, step, range_ms, op=op,
                                    nsteps=nsteps, maxw=maxw, param=param,
                                    param2=param2, series_block=series_block)
@@ -360,6 +424,27 @@ def range_aggregate_gather(
 def _range_aggregate_gather(
     ts2d: jax.Array, val2d: jax.Array,
     t0, step, range_ms, *, op: str, nsteps: int, maxw: int,
+    param: float = 0.0, param2: float = 0.0, series_block: int = 128,
+) -> Tuple[jax.Array, jax.Array]:
+    return _rag_body(ts2d, val2d, t0, step, range_ms, None, None, op=op,
+                     nsteps=nsteps, maxw=maxw, param=param, param2=param2,
+                     series_block=series_block)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps", "maxw", "series_block"))
+def _range_aggregate_gather_pre(
+    ts2d: jax.Array, val2d: jax.Array,
+    t0, step, range_ms, lo, hi, *, op: str, nsteps: int, maxw: int,
+    param: float = 0.0, param2: float = 0.0, series_block: int = 128,
+) -> Tuple[jax.Array, jax.Array]:
+    return _rag_body(ts2d, val2d, t0, step, range_ms, lo, hi, op=op,
+                     nsteps=nsteps, maxw=maxw, param=param, param2=param2,
+                     series_block=series_block)
+
+
+def _rag_body(
+    ts2d: jax.Array, val2d: jax.Array,
+    t0, step, range_ms, pre_lo, pre_hi, *, op: str, nsteps: int, maxw: int,
     param: float = 0.0, param2: float = 0.0, series_block: int = 128,
 ) -> Tuple[jax.Array, jax.Array]:
     """Gather-path range functions: each window materializes ≤ maxw samples.
@@ -376,10 +461,18 @@ def _range_aggregate_gather(
     ts2d = jnp.pad(ts2d, ((0, pad_s), (0, 0)), constant_values=pad_sentinel)
     val2d = jnp.pad(val2d, ((0, pad_s), (0, 0)))
     SB = (S + pad_s) // series_block
+    have_bounds = pre_lo is not None
+    if have_bounds:
+        # padded series get empty windows (lo == hi == 0)
+        pre_lo = jnp.pad(pre_lo, ((0, pad_s), (0, 0)))
+        pre_hi = jnp.pad(pre_hi, ((0, pad_s), (0, 0)))
 
     def block(args):
-        tsb, valb = args  # [B, L]
-        lo, hi = window_bounds(tsb, step_ends, range_ms)
+        if have_bounds:
+            tsb, valb, lo, hi = args  # [B, L] / [B, T]
+        else:
+            tsb, valb = args          # [B, L]
+            lo, hi = window_bounds(tsb, step_ends, range_ms)
         lo = jnp.maximum(lo, hi - maxw)
         w = jnp.arange(maxw, dtype=jnp.int32)
         widx = lo[:, :, None] + w[None, None, :]            # [B, T, W]
@@ -428,11 +521,234 @@ def _range_aggregate_gather(
             return _holt_winters(vals, inwin, param, param2), count >= 2
         raise ValueError(f"not a gather-path op: {op}")
 
-    outs, oks = jax.lax.map(
-        block, (ts2d.reshape(SB, series_block, L), val2d.reshape(SB, series_block, L)))
+    operands = (ts2d.reshape(SB, series_block, L),
+                val2d.reshape(SB, series_block, L))
+    if have_bounds:
+        operands += (pre_lo.reshape(SB, series_block, nsteps),
+                     pre_hi.reshape(SB, series_block, nsteps))
+    outs, oks = jax.lax.map(block, operands)
     out = outs.reshape(-1, nsteps)[:S]
     ok = oks.reshape(-1, nsteps)[:S]
     return out, ok
+
+
+# ---------------------------------------------------------------------------
+# Aligned-window shared evaluation (the PromQL dashboard fast path)
+# ---------------------------------------------------------------------------
+# When the window is a multiple of the step (rate(x[5m]) at 1m step — the
+# common dashboard shape), every per-(series, step) quantity the cumsum-op
+# family needs is a value at either index lo[k] or hi[k]-1, and lo is a
+# shifted view of hi over an EXTENDED grid. Measured on v5e: a stacked
+# [S, L, 8] take_along_axis costs the same as a single-channel gather
+# (~275ms at 10k series x 1440 steps), so ONE stacked gather at the
+# extended grid serves every op — rate + avg_over_time + ... over the same
+# selector share the bounds pass, the cumsums, and the gather, leaving only
+# tiny [S, T] vector epilogues per op.
+
+# tier-A channels (prefix/instant values)
+_CH_CSP, _CH_TS_PREV, _CH_TS_AT, _CH_VAL_PREV, _CH_VAL_AT, _CH_VAL_PREV2 = \
+    range(6)
+
+
+@jax.jit
+def _stack_prefix(ts2d, val2d, lengths, ext):
+    """Tier A: gather [csp, ts_prev, ts_at, val_prev, val_at, val_prev2]
+    at the extended-grid positions; X_at[e] = X[min(e, L-1)],
+    X_prev[e] = X[max(e-1, 0)], X_prev2[e] = X[max(e-2, 0)]."""
+    S, L = ts2d.shape
+    fv = val2d.dtype
+    idx = jnp.arange(L, dtype=jnp.int32)
+    valid = idx[None, :] < lengths[:, None]
+    vz = jnp.where(valid, val2d, 0).astype(fv)
+    csp = jnp.concatenate([jnp.zeros((S, 1), fv), jnp.cumsum(vz, axis=1)],
+                          axis=1)
+    tsf = ts2d.astype(fv)
+    stack = jnp.stack([
+        csp,
+        jnp.concatenate([tsf[:, :1], tsf], axis=1),
+        jnp.concatenate([tsf, tsf[:, -1:]], axis=1),
+        jnp.concatenate([val2d[:, :1], val2d], axis=1).astype(fv),
+        jnp.concatenate([val2d, val2d[:, -1:]], axis=1).astype(fv),
+        jnp.concatenate([val2d[:, :1], val2d[:, :1], val2d[:, :-1]],
+                        axis=1).astype(fv),
+    ], axis=-1)
+    e = jnp.minimum(ext, L)
+    return jnp.take_along_axis(stack, e[:, :, None], axis=1)
+
+
+@jax.jit
+def _stack_counter(ts2d, val2d, lengths, ext):
+    """Tier B: counter-reset-adjusted values [adj_prev, adj_at]."""
+    S, L = ts2d.shape
+    fv = val2d.dtype
+    idx = jnp.arange(L, dtype=jnp.int32)
+    valid = idx[None, :] < lengths[:, None]
+    prev = jnp.concatenate([val2d[:, :1], val2d[:, :-1]], axis=1)
+    pair_ok = valid & (idx[None, :] >= 1)
+    contrib = jnp.where(pair_ok & (val2d < prev), prev, 0).astype(fv)
+    adj = val2d + jnp.cumsum(contrib, axis=1)
+    stack = jnp.stack([
+        jnp.concatenate([adj[:, :1], adj], axis=1),
+        jnp.concatenate([adj, adj[:, -1:]], axis=1),
+    ], axis=-1)
+    e = jnp.minimum(ext, L)
+    return jnp.take_along_axis(stack, e[:, :, None], axis=1)
+
+
+@jax.jit
+def _stack_sq(ts2d, val2d, lengths, ext):
+    """Tier C: squared-value prefix (stddev/stdvar only)."""
+    S, L = ts2d.shape
+    fv = val2d.dtype
+    idx = jnp.arange(L, dtype=jnp.int32)
+    valid = idx[None, :] < lengths[:, None]
+    vz = jnp.where(valid, val2d, 0).astype(fv)
+    csp2 = jnp.concatenate(
+        [jnp.zeros((S, 1), fv), jnp.cumsum(vz * vz, axis=1)], axis=1)
+    e = jnp.minimum(ext, L)
+    return jnp.take_along_axis(csp2[:, :, None], e[:, :, None], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("step", "range_ms", "nsteps"))
+def _ext_counts(ts2d, t0, *, step: int, range_ms: int, nsteps: int):
+    """Counts at the extended grid [t0 - range, ..., t0 + (nsteps-1)*step]:
+    lo = ext[:, :nsteps], hi = ext[:, shift:] for shift = range // step."""
+    shift = range_ms // step
+    T_ext = nsteps + shift
+    if ts2d.shape[1] <= _BUCKETIZE_MAX_LEN and T_ext > 1:
+        return _counts_leq_grid(ts2d, t0 - range_ms, step, T_ext)
+    ends = (t0 - range_ms) + jnp.arange(T_ext, dtype=ts2d.dtype) * step
+    ss = jax.vmap(lambda row, v: jnp.searchsorted(row, v, side="right"),
+                  in_axes=(0, None))
+    return ss(ts2d, ends).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "nsteps", "shift"))
+def _op_from_stack(ga, gb, gc, lo, hi, t0, step, range_ms, *,
+                   op: str, nsteps: int, shift: int):
+    T = nsteps
+    fv = ga.dtype
+    count = (hi - lo).astype(jnp.int32)
+    ok1 = count >= 1
+
+    def lo_of(x):
+        return x[:, :T]
+
+    def hi_of(x):
+        return x[:, shift:]
+
+    def A(c):
+        return ga[..., c]
+
+    if op == "sum_over_time":
+        return hi_of(A(_CH_CSP)) - lo_of(A(_CH_CSP)), ok1
+    if op in ("avg_over_time", "stddev_over_time", "stdvar_over_time"):
+        wsum = hi_of(A(_CH_CSP)) - lo_of(A(_CH_CSP))
+        cnt = jnp.maximum(count, 1).astype(fv)
+        mean = wsum / cnt
+        if op == "avg_over_time":
+            return mean, ok1
+        csp2 = gc[..., 0]
+        wsq = hi_of(csp2) - lo_of(csp2)
+        var = jnp.maximum(wsq / cnt - mean * mean, 0.0)
+        return (var if op == "stdvar_over_time" else jnp.sqrt(var)), ok1
+    if op == "first_over_time":
+        return lo_of(A(_CH_VAL_AT)), ok1
+    if op == "last_over_time":
+        return hi_of(A(_CH_VAL_PREV)), ok1
+    if op in ("idelta", "irate_num"):
+        ok2 = count >= 2
+        last = hi_of(A(_CH_VAL_PREV))
+        prev = hi_of(A(_CH_VAL_PREV2))
+        if op == "irate_num":
+            return jnp.where(last < prev, last, last - prev), ok2
+        return last - prev, ok2
+    if op in ("rate", "increase", "delta"):
+        step_ends = t0 + jnp.arange(T, dtype=jnp.int32) * step
+        first_t = lo_of(A(_CH_TS_AT))
+        last_t = hi_of(A(_CH_TS_PREV))
+        first_v = lo_of(A(_CH_VAL_AT))
+        last_v = hi_of(A(_CH_VAL_PREV))
+        if op == "delta":
+            raw = last_v - first_v
+            is_counter = False
+        else:
+            raw = hi_of(gb[..., 0]) - lo_of(gb[..., 1])
+            is_counter = True
+        return _extrapolate(raw, first_t, last_t, first_v, count, step_ends,
+                            range_ms, op=op, is_counter=is_counter)
+    raise ValueError(f"not a stack-path op: {op}")
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _count_from_bounds(lo, hi, *, op: str):
+    count = (hi - lo).astype(jnp.int32)
+    ok1 = count >= 1
+    if op == "present_over_time":
+        return jnp.ones_like(count, dtype=jnp.float32), ok1
+    return count.astype(jnp.float32), ok1
+
+
+class AlignedWindowEval:
+    """Shared-state evaluator for cumsum-path range functions over one
+    series matrix and one step-aligned grid (range % step == 0).
+
+    Bounds, cumsums, and the stacked gather are computed once and cached;
+    each op adds only a [S, T] vector epilogue. The PromQL engine caches
+    one of these per (selector, window) within an evaluation."""
+
+    def __init__(self, ts2d, val2d, lengths, t0, step, range_ms, nsteps):
+        step, range_ms, nsteps = int(step), int(range_ms), int(nsteps)
+        if step <= 0 or range_ms < 0 or range_ms % step:
+            raise ValueError("AlignedWindowEval needs range % step == 0")
+        ts2d, t0 = _rebase_i64_host(ts2d, t0, step, nsteps, range_ms)
+        self.ts2d, self.val2d, self.lengths = ts2d, val2d, lengths
+        self.t0, self.step, self.range_ms = t0, step, range_ms
+        self.nsteps = nsteps
+        self.shift = range_ms // step
+        self._ext = None
+        self._ga = self._gb = self._gc = None
+
+    def ext(self):
+        if self._ext is None:
+            self._ext = _ext_counts(self.ts2d, self.t0, step=self.step,
+                                    range_ms=self.range_ms,
+                                    nsteps=self.nsteps)
+        return self._ext
+
+    def bounds(self) -> Tuple[jax.Array, jax.Array]:
+        ext = self.ext()
+        return ext[:, :self.nsteps], ext[:, self.shift:]
+
+    def eval(self, op: str) -> Tuple[jax.Array, jax.Array]:
+        if op not in CUMSUM_OPS:
+            raise ValueError(f"not a cumsum-path op: {op}")
+        lo, hi = self.bounds()
+        if op in ("count_over_time", "present_over_time"):
+            return _count_from_bounds(lo, hi, op=op)
+        if op in ("changes", "resets"):
+            # outside the stack family; still shares the bounds pass
+            return range_aggregate_cumsum(
+                self.ts2d, self.val2d, self.lengths, self.t0, self.step,
+                self.range_ms, op=op, nsteps=self.nsteps, bounds=(lo, hi))
+        if self._ga is None:
+            self._ga = _stack_prefix(self.ts2d, self.val2d, self.lengths,
+                                     self.ext())
+        gb = gc = None
+        if op in ("rate", "increase"):
+            if self._gb is None:
+                self._gb = _stack_counter(self.ts2d, self.val2d,
+                                          self.lengths, self.ext())
+            gb = self._gb
+        if op in ("stddev_over_time", "stdvar_over_time"):
+            if self._gc is None:
+                self._gc = _stack_sq(self.ts2d, self.val2d, self.lengths,
+                                     self.ext())
+            gc = self._gc
+        return _op_from_stack(ga=self._ga, gb=gb, gc=gc, lo=lo, hi=hi,
+                              t0=self.t0, step=self.step,
+                              range_ms=self.range_ms, op=op,
+                              nsteps=self.nsteps, shift=self.shift)
 
 
 def _masked_quantile(vals: jax.Array, mask: jax.Array, q) -> jax.Array:

@@ -432,6 +432,12 @@ class _Eval:
                                dtype=np.int64)
         self.nsteps = len(self.steps)
         self.raw_matrix_ok = raw_matrix_ok
+        # per-evaluation device caches: matrices stay resident in HBM and
+        # window bounds are shared across range functions over the same
+        # selector (rate + avg_over_time recompute identical bounds
+        # otherwise — the dominant cost at 10k-series scale)
+        self._dev_cache: Dict[int, tuple] = {}
+        self._bounds_cache: Dict[tuple, tuple] = {}
 
     # -- top-level dispatch --
     def eval(self, e: PromExpr):
@@ -520,9 +526,72 @@ class _Eval:
         return VectorVal(selection.labels, out_vals, out_ok)
 
     def _device_args(self, matrix, t0: np.int64, nsteps: int):
-        """Rebase (ts2d, t0) for int32 device transfer."""
-        ts2d, val2d, lengths, base = matrix.device_arrays()
+        """Rebase (ts2d, t0) for int32 device transfer; arrays are
+        device_put once per matrix and reused across range functions."""
+        # the cache entry holds `matrix` itself: id() keys are only unique
+        # while the object is alive, so pin it for the evaluation
+        ent = self._dev_cache.get(id(matrix))
+        if ent is None:
+            import jax
+            ts2d, val2d, lengths, base = matrix.device_arrays()
+            if val2d.dtype == np.float64 and not jax.config.jax_enable_x64:
+                val2d = val2d.astype(np.float32)
+            if ts2d.dtype != np.int64:   # int64 stays host for the safety net
+                ts2d = jax.device_put(ts2d)
+                val2d = jax.device_put(val2d)
+                lengths = jax.device_put(lengths)
+            ent = (matrix, ts2d, val2d, lengths, base)
+            self._dev_cache[id(matrix)] = ent
+        _, ts2d, val2d, lengths, base = ent
         return ts2d, val2d, lengths, np.int64(t0) - base
+
+    def _cached_bounds(self, matrix, ts2d, t0r, win: int, nsteps: int):
+        """Window bounds shared across range functions on one selector."""
+        from ..ops.window import compute_window_bounds
+        key = (id(matrix), int(t0r), int(win), nsteps)
+        ent = self._bounds_cache.get(key)
+        if ent is None:
+            b = compute_window_bounds(ts2d, t0r, step=self.step,
+                                      range_ms=int(win), nsteps=nsteps)
+            ent = (matrix, b)   # pin matrix: id() keys need it alive
+            self._bounds_cache[key] = ent
+        return ent[1]
+
+    #: widest extended grid (nsteps + range/step) the aligned fast path may
+    #: build — beyond this (wide-range instant queries like rate(x[1d]) at
+    #: one step) the O(nsteps) two-pass bounds form is both faster and
+    #: bounded in memory
+    _ALIGNED_MAX_EXT = 4096
+
+    def _aligned_ok(self, win: int, nsteps: int) -> bool:
+        return (win % self.step == 0 and win >= 0 and
+                win // self.step + nsteps <= self._ALIGNED_MAX_EXT)
+
+    def _aligned_eval(self, matrix, ts2d, val2d, lengths, t0r, win: int,
+                      nsteps: int):
+        """AlignedWindowEval shared across range functions on one selector
+        (step-aligned windows): one bounds pass + one stacked gather serve
+        rate, avg_over_time, and the rest of the cumsum family."""
+        from ..ops.window import AlignedWindowEval
+        key = ("awe", id(matrix), int(t0r), int(win), nsteps)
+        ent = self._bounds_cache.get(key)
+        if ent is None:
+            awe = AlignedWindowEval(ts2d, val2d, lengths, t0r, self.step,
+                                    int(win), nsteps)
+            ent = (matrix, awe)   # pin matrix: id() keys need it alive
+            self._bounds_cache[key] = ent
+        return ent[1]
+
+    def _bounds_for(self, matrix, ts2d, val2d, lengths, t0r, win: int,
+                    nsteps: int):
+        """Window bounds for any kernel path (None when ts stays host
+        int64 for the safety net)."""
+        if ts2d.dtype == np.int64:
+            return None
+        if self._aligned_ok(win, nsteps):
+            return self._aligned_eval(matrix, ts2d, val2d, lengths, t0r,
+                                      win, nsteps).bounds()
+        return self._cached_bounds(matrix, ts2d, t0r, win, nsteps)
 
     def _instant(self, sel: VectorSelector) -> VectorVal:
         from ..ops.window import instant_select
@@ -551,15 +620,23 @@ class _Eval:
 
         def kernel(matrix, t0, nsteps):
             ts2d, val2d, lengths, t0r = self._device_args(matrix, t0, nsteps)
+            if op in CUMSUM_OPS and ts2d.dtype != np.int64 \
+                    and self._aligned_ok(win, nsteps):
+                awe = self._aligned_eval(matrix, ts2d, val2d, lengths, t0r,
+                                         win, nsteps)
+                return awe.eval(op)
+            bounds = self._bounds_for(matrix, ts2d, val2d, lengths, t0r,
+                                      win, nsteps)
             if op in CUMSUM_OPS:
                 return range_aggregate_cumsum(
                     ts2d, val2d, lengths, t0r, self.step, win,
-                    op=op, nsteps=nsteps, param=param)
+                    op=op, nsteps=nsteps, param=param, bounds=bounds)
             if op in GATHER_OPS:
                 maxw = int(matrix.max_len)
                 return range_aggregate_gather(
                     ts2d, val2d, t0r, self.step, win, op=op, nsteps=nsteps,
-                    maxw=max(maxw, 2), param=param, param2=param2)
+                    maxw=max(maxw, 2), param=param, param2=param2,
+                    bounds=bounds)
             raise UnsupportedError(f"range function {func} not implemented")
 
         out = self._window_eval(sel, win, kernel)
@@ -584,6 +661,8 @@ class _Eval:
         def kernel(matrix, t0, nsteps):
             import jax
             ts2d, val2d, lengths, t0r = self._device_args(matrix, t0, nsteps)
+            bounds = self._bounds_for(matrix, ts2d, val2d, lengths, t0r,
+                                      win, nsteps)
             # idelta over *rebased* sample times: absolute epoch seconds
             # (~1.7e9) as float32 device values would cancel to 0 between
             # adjacent samples; a gap of relative seconds is exact
@@ -592,7 +671,8 @@ class _Eval:
             return range_aggregate_cumsum(
                 ts2d, jax.device_put(rel.astype(np.float32)
                                      if val2d.dtype == np.float32 else rel),
-                lengths, t0r, self.step, win, op="idelta", nsteps=nsteps)
+                lengths, t0r, self.step, win, op="idelta", nsteps=nsteps,
+                bounds=bounds)
 
         return self._window_eval(sel, win, kernel)
 

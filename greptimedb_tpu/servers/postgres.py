@@ -355,7 +355,11 @@ class _PgConnection:
                 word = probe.lstrip().split(None, 1)[0].lower()
                 candidates = []
                 if word in ("select", "with", "values", "table"):
+                    # LIMIT 0 probe first (schema without scanning rows);
+                    # the full probe is the fallback for statements the
+                    # suffix breaks (e.g. an existing LIMIT clause)
                     candidates.append(probe.rstrip().rstrip(";") + " LIMIT 0")
+                    candidates.append(probe)
                 if word in ("show", "describe", "desc"):
                     candidates.append(probe)  # metadata queries are cheap
                 # expensive non-LIMITable statements (TQL, EXPLAIN) fall

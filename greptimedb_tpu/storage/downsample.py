@@ -99,13 +99,17 @@ def downsample_region(src, dst, *, stride_ms: int,
             slots.append((fname, op))
 
     nbucket = shape_bucket(nruns, minimum=256)
+    run_starts = np.nonzero(change)[0]
+    # segment ends are free on the host (run boundaries just computed);
+    # shipping them skips the on-device binary search for bounds
+    run_ends = np.full(nbucket, len(ts), dtype=np.int32)
+    run_ends[:nruns - 1] = run_starts[1:]
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
-        num_groups=nbucket, ops=tuple(ops), has_col_masks=True)
+        num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
+        ends=run_ends)
     counts = np.asarray(counts)[:nruns]
     res = {slot: np.asarray(r)[:nruns] for slot, r in zip(slots, results)}
-
-    run_starts = np.nonzero(change)[0]
     out_sids = sids[run_starts]
     out_ts = buckets[run_starts] * stride_ms
     live = counts > 0
