@@ -200,17 +200,28 @@ def config5_downsample(tmpdir, scale):
         ts = np.tile(np.arange(secs_per_sst, dtype=np.int64) * 1000
                      + base_ts, n_hosts)
         host = np.repeat([f"h{i}" for i in range(n_hosts)], secs_per_sst)
-        cols = {"host": host.tolist(), "ts": ts.tolist(),
-                "v": rng.random(len(ts)).tolist()}
+        cols = {"host": host, "ts": ts, "v": rng.random(len(ts))}
         raw.insert(cols)
         raw.flush()
     n_rows = 4 * secs_per_sst * n_hosts
     load_dt = time.perf_counter() - t_load
 
     from greptimedb_tpu.storage.downsample import downsample_region
+    fe.do_query("CREATE TABLE agg_warm (host STRING, ts TIMESTAMP TIME "
+                "INDEX, v DOUBLE, PRIMARY KEY(host))")
     agg = fe.catalog.table("greptime", "public", "agg")
     src_region = next(iter(raw.regions.values()))
     dst_region = next(iter(agg.regions.values()))
+    warm_region = next(iter(fe.catalog.table(
+        "greptime", "public", "agg_warm").regions.values()))
+    # cold pass pays XLA compile + scan-cache build (once per process /
+    # region); the timed pass is the steady state a periodic maintenance
+    # job runs in — kernels compiled, source region device-resident (the
+    # same warm-then-time protocol as config 4)
+    t0 = time.perf_counter()
+    downsample_region(src_region, warm_region, stride_ms=60_000,
+                      aggs={"v": "avg"})
+    cold_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     downsample_region(src_region, dst_region, stride_ms=60_000,
                       aggs={"v": "avg"})
@@ -219,7 +230,7 @@ def config5_downsample(tmpdir, scale):
     _p("5_downsample_1s_to_1m", n_rows / dt / 1e6, "Mrows/s",
        {"rows_in": n_rows, "rows_out": out_rows,
         "load_rows_per_s": round(n_rows / load_dt),
-        "downsample_s": round(dt, 2)})
+        "downsample_s": round(dt, 2), "cold_s": round(cold_dt, 2)})
     fe.shutdown()
 
 

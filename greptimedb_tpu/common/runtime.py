@@ -66,3 +66,30 @@ def shutdown_runtimes(wait: bool = True) -> None:
         pools, _pools_copy = dict(_pools), _pools.clear()
     for pool in pools.values():
         pool.shutdown(wait=wait)
+
+
+def parallel_map(fn: Callable, items, *, max_workers: int = 8) -> list:
+    """Map fn over items with a transient thread pool; serial for <=1 item.
+
+    The storage IO fan-outs (SST read/decode, per-bucket SST encode/write)
+    share this: parquet + zstd drop the GIL, so concurrent workers overlap
+    IO and (de)compression."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
+        return list(p.map(fn, items))
+
+
+def parallel_imap(fn: Callable, items, *, max_workers: int = 8):
+    """parallel_map but yielding results in order as they become ready, so
+    the consumer can process-and-drop instead of holding every result."""
+    items = list(items)
+    if len(items) <= 1:
+        for x in items:
+            yield fn(x)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
+        yield from p.map(fn, items)

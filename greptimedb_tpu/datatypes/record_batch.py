@@ -43,7 +43,10 @@ class RecordBatch:
     def from_pydict(schema: Schema, data: Dict[str, Sequence[Any]]) -> "RecordBatch":
         cols = []
         for c in schema.column_schemas:
-            cols.append(Vector.from_pylist(list(data[c.name]), c.dtype))
+            v = data[c.name]
+            if not isinstance(v, (list, np.ndarray)):
+                v = list(v)
+            cols.append(Vector.from_pylist(v, c.dtype))
         return RecordBatch(schema, cols)
 
     @staticmethod

@@ -50,6 +50,11 @@ class Vector:
     # ---- constructors ----
     @staticmethod
     def from_pylist(values: Sequence[Any], dtype: ConcreteDataType) -> "Vector":
+        if isinstance(values, np.ndarray) and values.dtype != object \
+                and not (dtype.is_string or dtype.is_binary):
+            # numeric ndarray fast path: no per-value cast, no nulls
+            return Vector(dtype,
+                          np.ascontiguousarray(values, dtype=dtype.np_dtype))
         n = len(values)
         validity = np.ones(n, dtype=bool)
         if dtype.is_string or dtype.is_binary:

@@ -50,8 +50,26 @@ class Dictionary:
         return list(self._values)
 
     def encode(self, values: Sequence[Hashable]) -> np.ndarray:
-        """Encode values to int32 ids, inserting unseen values."""
-        out = np.empty(len(values), dtype=np.int32)
+        """Encode values to int32 ids, inserting unseen values.
+
+        Batches beyond a few hundred rows dedup through np.unique first so
+        the per-value dict walk touches each distinct value once — ingest
+        batches usually carry few distinct tags (TSBS: 100s of hosts across
+        millions of rows)."""
+        n = len(values)
+        if n > 256:
+            arr = values if isinstance(values, np.ndarray) \
+                else np.asarray(values, dtype=object)
+            try:
+                uniq, inv = np.unique(arr, return_inverse=True)
+            except TypeError:
+                uniq = None      # unorderable values (e.g. None vs str)
+            if uniq is not None:
+                ids_u = np.empty(len(uniq), dtype=np.int32)
+                for i, v in enumerate(uniq.tolist()):
+                    ids_u[i] = self.get_or_insert(v)
+                return ids_u[inv.reshape(-1)].astype(np.int32, copy=False)
+        out = np.empty(n, dtype=np.int32)
         get = self._value_to_id.get
         for i, v in enumerate(values):
             j = get(v)

@@ -680,13 +680,15 @@ def _op_from_stack(ga, gb, gc, lo, hi, t0, step, range_ms, *,
     raise ValueError(f"not a stack-path op: {op}")
 
 
-@functools.partial(jax.jit, static_argnames=("op",))
-def _count_from_bounds(lo, hi, *, op: str):
+@functools.partial(jax.jit, static_argnames=("op", "fv"))
+def _count_from_bounds(lo, hi, *, op: str, fv):
+    # fv = value dtype, so results match the non-aligned kernel's dtype
+    # (float64 under x64) regardless of which path a query takes
     count = (hi - lo).astype(jnp.int32)
     ok1 = count >= 1
     if op == "present_over_time":
-        return jnp.ones_like(count, dtype=jnp.float32), ok1
-    return count.astype(jnp.float32), ok1
+        return jnp.ones_like(count, dtype=fv), ok1
+    return count.astype(fv), ok1
 
 
 class AlignedWindowEval:
@@ -725,7 +727,8 @@ class AlignedWindowEval:
             raise ValueError(f"not a cumsum-path op: {op}")
         lo, hi = self.bounds()
         if op in ("count_over_time", "present_over_time"):
-            return _count_from_bounds(lo, hi, op=op)
+            return _count_from_bounds(lo, hi, op=op,
+                                      fv=self.val2d.dtype)
         if op in ("changes", "resets"):
             # outside the stack family; still shares the bounds pass
             return range_aggregate_cumsum(

@@ -724,7 +724,6 @@ def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
     # queries with the same moment signature + shape bucket) ----
     d_ts = scan.device_ts()
     nbucket = shape_bucket(nruns, minimum=256)
-    d_rid = jax.device_put(rid)
     d_mask = jax.device_put(mask)
 
     values = []
@@ -753,6 +752,11 @@ def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
     # cost at high run cardinality
     run_ends = np.full(nbucket, n, dtype=np.int32)
     run_ends[:nruns - 1] = run_starts[1:]
+    # with host ends the kernel reads gids only for first/last (arg-extreme
+    # tie-break); for every other op ts stands in for shape and the O(n)
+    # rid upload is skipped
+    needs_gids = any(op in ("first", "last") for op in ops)
+    d_rid = jax.device_put(rid) if needs_gids else d_ts
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
         num_groups=nbucket, ops=tuple(ops), has_col_masks=True,

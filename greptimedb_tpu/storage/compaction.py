@@ -93,8 +93,12 @@ def run_compaction(region, plan: CompactionPlan,
     retracts = bool(plan.expired)
     new_files: List[FileMeta] = []
     if plan.inputs:
-        datas = [al.read_sst(m) for m in plan.inputs]
-        datas = [d for d in datas if d.num_rows]
+        # overlap input decode: parquet reads drop the GIL, so concurrent
+        # readers hide IO + decompression behind each other (reference's
+        # parallel compaction readers, strategy.rs:36-120)
+        from ..common.runtime import parallel_map
+        datas = [d for d in parallel_map(al.read_sst, plan.inputs)
+                 if d.num_rows]
         if datas:
             sids = np.concatenate([d.series_ids for d in datas])
             ts = np.concatenate([d.ts for d in datas])
@@ -126,9 +130,12 @@ def run_compaction(region, plan: CompactionPlan,
                     fields = {n: (d[live], v[live] if v is not None else None)
                               for n, (d, v) in fields.items()}
             if len(ts):
-                # bucket rows by time window → one sorted L1 file per bucket
+                # bucket rows by time window → one sorted L1 file per bucket;
+                # encode+write buckets concurrently (zstd/parquet encode
+                # drops the GIL) so output IO overlaps encoding
                 buckets = ts // plan.window_ms
-                for b in np.unique(buckets):
+
+                def _write_bucket(b):
                     m = buckets == b
                     bs, bt, bq, bo = sids[m], ts[m], seq[m], op[m]
                     bf = {n: (d[m], v[m] if v is not None else None)
@@ -137,11 +144,13 @@ def run_compaction(region, plan: CompactionPlan,
                         name: region.series_dict.decode_tag_column(bs, i)
                         for i, name in
                         enumerate(region.series_dict.tag_names)}
-                    meta = al.write_sst(level=1, series_ids=bs, ts=bt,
+                    return al.write_sst(level=1, series_ids=bs, ts=bt,
                                         seq=bq, op_types=bo, fields=bf,
                                         tag_columns=tag_cols, schema=schema)
-                    if meta is not None:
-                        new_files.append(meta)
+
+                from ..common.runtime import parallel_map
+                metas = parallel_map(_write_bucket, np.unique(buckets))
+                new_files.extend(m for m in metas if m is not None)
 
     removed = [f.file_name for f in plan.inputs] + \
         [f.file_name for f in plan.expired]

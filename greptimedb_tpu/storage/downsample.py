@@ -2,20 +2,25 @@
 
 The north-star maintenance job (BASELINE config 5: 1s→1m downsample).
 The reference has no downsample in v0.2 — its compaction only merges
-files — so this is a capability extension: a background job that reads a
-source region (merged + deduped), reduces every (series, bucket) group
-with the scatter-free sorted-segment TPU kernel, and writes the result
-into a destination region whose time index carries the bucket timestamps.
+files — so this is a capability extension: a background job that reduces
+every (series, bucket) group with the scatter-free sorted-segment TPU
+kernel and writes the result into a destination region whose time index
+carries the bucket timestamps.
 
-Data flow (all static-shaped for XLA):
-  merged scan (sorted by series, ts) → run ids over (series, bucket)
-  → sorted_grouped_aggregate moments on device → host fold → WriteBatch.
+TPU-first data flow: the job rides the SAME device-resident merged-scan
+cache the query path uses (`query/tpu_exec.SCAN_CACHE`) — on a region
+that has been queried (or downsampled) before, the sorted/deduped column
+arrays are already in HBM and the job ships only the run ids; on a cold
+region the cache build it pays is then amortized by every later query.
+All device work is dispatched asynchronously and fetched in ONE batched
+device_get, so host-side prep for the destination write overlaps the
+kernel execution instead of serializing behind it.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -35,6 +40,7 @@ def downsample_region(src, dst, *, stride_ms: int,
     import jax
 
     from ..ops.kernels import shape_bucket, sorted_grouped_aggregate
+    from ..query.tpu_exec import SCAN_CACHE
     from .write_batch import WriteBatch
 
     schema = src.schema
@@ -46,72 +52,71 @@ def downsample_region(src, dst, *, stride_ms: int,
         if op not in _SUPPORTED:
             raise ValueError(f"unsupported downsample op {op}")
 
-    data = src.snapshot().read_merged(time_range=time_range)
-    if data.num_rows == 0:
+    # merged + MVCC-deduped view, sorted by (series, ts); PUT rows only
+    # (tombstones are dropped by the merge). Device mirrors of ts/fields
+    # are cached per region version and shared with the query path.
+    scan = SCAN_CACHE.get(src)
+    n = scan.num_rows
+    if n == 0:
         return 0
-    # keep only PUT rows (tombstones end their keys' history)
-    puts = data.op_types == 0
-    sids = data.series_ids[puts]
-    ts = data.ts[puts]
-    if not len(ts):
-        return 0
+    sids, ts = scan.series_ids, scan.ts
 
-    buckets = (ts // stride_ms).astype(np.int64)
-    # run ids over the (series, bucket) pairs — rows arrive sorted by
-    # (series, ts) so pair changes are run boundaries (device-friendly ids)
-    change = np.empty(len(ts), dtype=bool)
-    change[0] = True
-    change[1:] = (sids[1:] != sids[:-1]) | (buckets[1:] != buckets[:-1])
-    rid = np.cumsum(change) - 1
+    mask_np = None
+    if time_range is not None:
+        mask_np = np.ones(n, dtype=bool)
+        if time_range.start is not None:
+            mask_np &= ts >= time_range.start
+        if time_range.end is not None:
+            mask_np &= ts < time_range.end
+        if not mask_np.any():
+            return 0
+
+    # run ids over (series, bucket): rows are sorted by (series, ts) so
+    # pair changes are run boundaries — vectorized host pass, and the
+    # segment ends ship with the call (no device binary search)
+    buckets = ts // stride_ms
+    flags = np.empty(n, dtype=bool)
+    flags[0] = True
+    np.not_equal(sids[1:], sids[:-1], out=flags[1:])
+    flags[1:] |= buckets[1:] != buckets[:-1]
+    rid = np.cumsum(flags, dtype=np.int32) - 1
     nruns = int(rid[-1]) + 1
+    run_starts = np.nonzero(flags)[0]
 
-    base = int(ts.min())
-    rel = ts - base
-    if rel.max(initial=0) >= 2**31:
-        raise ValueError("downsample window exceeds int32 relative span")
-    d_rid = jax.device_put(rid.astype(np.int32))
-    d_ts = jax.device_put(rel.astype(np.int32))
-    d_mask = jax.device_put(np.ones(len(ts), dtype=bool))
+    nbucket = shape_bucket(nruns, minimum=256)
+    d_mask = jax.device_put(mask_np) if mask_np is not None \
+        else scan.device_valid_all()
+    d_ts = scan.device_ts()
+    # with host-precomputed ends the kernel reads gids only for first/last
+    # (arg-extreme tie-break); every other op works off the segment bounds,
+    # so the O(n) rid upload is skipped and ts stands in for shape
+    needs_gids = any(op in ("first", "last") for op in aggs.values())
+    d_rid = jax.device_put(rid) if needs_gids else d_ts
 
     values, col_masks, ops, slots = [], [], [], []
     for fname in field_names:
         if fname not in aggs:
             continue
         op = aggs[fname]
-        vals, valid = data.fields[fname]
-        vals = vals[puts]
-        valid_p = valid[puts] if valid is not None else \
-            np.ones(len(ts), dtype=bool)
-        v = vals.astype(np.float64)
-        x64 = jax.config.jax_enable_x64
-        d_vals = jax.device_put(v.astype(np.float64 if x64 else np.float32))
-        d_valid = jax.device_put(valid_p)
-        if op == "avg":
-            for sub in ("sum", "count"):
-                values.append(d_vals)
-                col_masks.append(d_valid)
-                ops.append(sub)
-                slots.append((fname, sub))
-        else:
-            values.append(d_vals)
-            col_masks.append(d_valid)
-            ops.append(op)
-            slots.append((fname, op))
+        values.append(d_ts if op == "count" else scan.device_field(fname))
+        col_masks.append(scan.device_valid(fname))
+        ops.append(op)
+        slots.append(fname)
 
-    nbucket = shape_bucket(nruns, minimum=256)
-    run_starts = np.nonzero(change)[0]
-    # segment ends are free on the host (run boundaries just computed);
-    # shipping them skips the on-device binary search for bounds
-    run_ends = np.full(nbucket, len(ts), dtype=np.int32)
+    run_ends = np.full(nbucket, n, dtype=np.int32)
     run_ends[:nruns - 1] = run_starts[1:]
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
         num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
         ends=run_ends)
-    counts = np.asarray(counts)[:nruns]
-    res = {slot: np.asarray(r)[:nruns] for slot, r in zip(slots, results)}
+
+    # host prep for the destination write runs while the device computes
+    # (dispatch above is async); the single batched fetch below is the
+    # only synchronization point
     out_sids = sids[run_starts]
     out_ts = buckets[run_starts] * stride_ms
+    counts, results = jax.device_get((counts, list(results)))
+    counts = counts[:nruns]
     live = counts > 0
     out_sids, out_ts = out_sids[live], out_ts[live]
 
@@ -120,28 +125,19 @@ def downsample_region(src, dst, *, stride_ms: int,
     for i, tag in enumerate(sd.tag_names):
         cols[tag] = sd.decode_tag_column(out_sids, i)
     ts_name = dst.schema.timestamp_column.name
-    cols[ts_name] = out_ts.tolist()
-    for fname in field_names:
-        if fname not in aggs:
-            continue
-        op = aggs[fname]
-        if op == "avg":
-            s = res[(fname, "sum")][live]
-            c = res[(fname, "count")][live]
-            vals = np.where(c > 0, s / np.maximum(c, 1), np.nan)
-        elif op == "count":
-            vals = res[(fname, "count")][live].astype(np.float64)
-        else:
-            vals = res[(fname, op)][live].astype(np.float64)
-        cols[fname] = [None if np.isnan(v) else float(v) for v in
-                       np.asarray(vals, dtype=np.float64)]
+    cols[ts_name] = out_ts
+    for fname, op, res in zip(slots, ops, results):
+        vals = np.asarray(res)[:nruns][live].astype(np.float64)
+        nan = np.isnan(vals)
+        cols[fname] = vals if not nan.any() else \
+            [None if m else float(v) for v, m in zip(vals, nan)]
 
-    n = len(out_ts)
-    if n == 0:
+    n_out = len(out_ts)
+    if n_out == 0:
         return 0
     wb = WriteBatch(dst.schema)
     wb.put(cols)
     dst.write(wb)
     logger.info("downsampled %s -> %s: %d rows into %d buckets (stride %dms)",
-                src.name, dst.name, len(ts), n, stride_ms)
-    return n
+                src.name, dst.name, n, n_out, stride_ms)
+    return n_out

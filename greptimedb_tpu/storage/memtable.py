@@ -112,7 +112,13 @@ class Memtable:
         schema = self.schema
         tag_names = schema.tag_names()
         if tag_names:
-            tag_cols = [rb.column(t).to_pylist() for t in tag_names]
+            tag_cols = []
+            for t in tag_names:
+                vec = rb.column(t)
+                # object ndarray feeds Dictionary.encode directly; only
+                # null-bearing tag columns pay the to_pylist walk
+                tag_cols.append(vec.data if vec.validity is None
+                                else vec.to_pylist())
             sids = self.series_dict.encode_rows(tag_cols)
         else:
             sids = self.series_dict.encode_zero_tags(n)

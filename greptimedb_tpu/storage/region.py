@@ -112,10 +112,15 @@ class RegionSnapshot:
                         schema.column_schema(name), int(sel.sum()))
             runs.append((snap.series_ids[sel], snap.ts[sel], snap.seq[sel],
                          snap.op_types[sel], fields))
-        # SSTs (row-group pruned)
-        for meta in v.ssts.files_in_range(time_range):
-            sst = region.access_layer.read_sst(
-                meta, projection=field_names, time_range=time_range)
+        # SSTs (row-group pruned; concurrent readers — parquet decode
+        # drops the GIL, so IO and decompression overlap across files;
+        # in-order streaming consumption keeps at most the decoded-but-
+        # unprocessed files alive, not the whole region)
+        from ..common.runtime import parallel_imap
+        for sst in parallel_imap(
+                lambda m: region.access_layer.read_sst(
+                    m, projection=field_names, time_range=time_range),
+                v.ssts.files_in_range(time_range)):
             if sst.num_rows == 0:
                 continue
             sel = None

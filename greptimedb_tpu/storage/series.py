@@ -25,6 +25,10 @@ class SeriesDict:
         self.tag_dicts: List[Dictionary] = [Dictionary() for _ in self.tag_names]
         self.series = Dictionary()          # tuple(tag ids) -> series id
         self._series_rows: List[Tuple[int, ...]] = []  # series id -> tag ids
+        # decode_tag_column staging (per tag): (num_series, id column,
+        # num_values, values array) — rebuilt only when the dictionary grew
+        self._decode_cache: Dict[int, Tuple[int, np.ndarray, int,
+                                            np.ndarray]] = {}
 
     @property
     def num_series(self) -> int:
@@ -36,9 +40,24 @@ class SeriesDict:
             return np.zeros(len(tag_columns[0]) if tag_columns else 0, np.int32)
         n = len(tag_columns[0])
         ids_per_tag = [d.encode(col) for d, col in zip(self.tag_dicts, tag_columns)]
-        out = np.empty(n, dtype=np.int32)
         series = self.series
         rows = self._series_rows
+        if n > 1024:
+            # dedup tag-id combinations first: the per-row dict walk then
+            # touches each distinct series once (batches are rarely wider
+            # than a few hundred series)
+            mat = np.stack(ids_per_tag, axis=1)
+            uniq, inv = np.unique(mat, axis=0, return_inverse=True)
+            sids_u = np.empty(len(uniq), dtype=np.int32)
+            for k, row in enumerate(uniq):
+                key = tuple(int(x) for x in row)
+                sid = series.get(key)
+                if sid is None:
+                    sid = series.get_or_insert(key)
+                    rows.append(key)
+                sids_u[k] = sid
+            return sids_u[inv.reshape(-1)].astype(np.int32, copy=False)
+        out = np.empty(n, dtype=np.int32)
         for i in range(n):
             key = tuple(int(ids[i]) for ids in ids_per_tag)
             sid = series.get(key)
@@ -58,6 +77,22 @@ class SeriesDict:
     def decode_tag_column(self, series_ids: np.ndarray, tag_index: int) -> List:
         d = self.tag_dicts[tag_index]
         rows = self._series_rows
+        n = len(series_ids)
+        if n > 1024 and rows:
+            # gather through the [num_series] id column + values array
+            # instead of a per-row Python walk; both arrays are cached and
+            # rebuilt only when the dictionary grew (ids are append-only)
+            cached = self._decode_cache.get(tag_index)
+            if cached is None or cached[0] != len(rows) \
+                    or cached[2] != len(d):
+                col = np.fromiter((r[tag_index] for r in rows), np.int32,
+                                  len(rows))
+                vals = np.asarray(d.values(), dtype=object)
+                cached = (len(rows), col, len(d), vals)
+                self._decode_cache[tag_index] = cached
+            _, col, _, vals = cached
+            sids = np.asarray(series_ids, dtype=np.int64)
+            return vals[col[sids]].tolist()
         return [d.value(rows[int(s)][tag_index]) for s in series_ids]
 
     def series_tag_matrix(self) -> np.ndarray:

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import io
 import json
+
+import numpy as np
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -62,7 +64,9 @@ class WriteBatch:
             cols = {}
             for c in self.schema.column_schemas:
                 if c.name in data:
-                    vals = list(data[c.name])
+                    vals = data[c.name]
+                    if not isinstance(vals, (list, np.ndarray)):
+                        vals = list(vals)
                     if n is None:
                         n = len(vals)
                     elif len(vals) != n:
