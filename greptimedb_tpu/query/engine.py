@@ -403,13 +403,25 @@ class QueryEngine:
     def _project_and_finish(self, df: pd.DataFrame, a: Analysis, query: Query,
                             table: Optional[Table], aggregated: bool = False
                             ) -> Output:
+        if a.window_calls:
+            from .window import compute_windows
+            # windows over non-aggregate queries follow the time index so
+            # unordered specs still see rows in scan order
+            ts_col = None
+            if not aggregated and table is not None:
+                tc = table.schema.timestamp_column
+                if tc is not None and tc.name in df.columns:
+                    ts_col = tc.name
+            if ts_col is not None:
+                df = df.sort_values(ts_col, kind="stable")
+            df = compute_windows(df, a)
         ev = Evaluator(df)
         out_cols: Dict[str, Any] = {}
         out_names: List[str] = []
         source_cols: Dict[str, Optional[str]] = {}
         dtype_overrides: Dict[str, dt.ConcreteDataType] = {}
         for item in (a.projections if aggregated or a.is_aggregate
-                     else query.projections):
+                     or a.window_calls else query.projections):
             if isinstance(item.expr, Star):
                 cols = list(df.columns) if table is None else \
                     [c for c in table.schema.names() if c in df.columns]
@@ -451,8 +463,8 @@ class QueryEngine:
         # ORDER BY over the result frame (may reference hidden columns,
         # which are evaluated against the pre-projection frame)
         if query.order_by:
-            pairs = a.order_by if (aggregated or a.is_aggregate) \
-                else query.order_by
+            pairs = a.order_by if (aggregated or a.is_aggregate
+                                   or a.window_calls) else query.order_by
             sort_frame = proj.copy()
             keys: List[str] = []
             ascs: List[bool] = []

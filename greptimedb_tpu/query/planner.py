@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from ..errors import PlanError, UnsupportedError
 from ..sql.ast import (
     Between, BinaryOp, Case, Cast, Column, Expr, FunctionCall, InList,
-    IsNull, Literal, Query, SelectItem, Star, Subquery, UnaryOp,
+    IsNull, Literal, Query, SelectItem, Star, Subquery, UnaryOp, WindowSpec,
 )
 from .expr import expr_name
 from .functions import AGGREGATE_FUNCTIONS
@@ -25,6 +25,14 @@ from .functions import AGGREGATE_FUNCTIONS
 AGG_NAMES = set(AGGREGATE_FUNCTIONS) | {"first", "last", "first_value",
                                         "last_value"}
 _AGG_CANON = {"mean": "avg", "first_value": "first", "last_value": "last"}
+
+#: ranking / navigation functions valid only with OVER
+WINDOW_ONLY_NAMES = {"row_number", "rank", "dense_rank", "percent_rank",
+                     "cume_dist", "ntile", "lag", "lead", "first_value",
+                     "last_value"}
+#: aggregates that may also run as window functions
+WINDOW_AGG_NAMES = {"sum", "avg", "mean", "min", "max", "count", "stddev",
+                    "variance"}
 
 
 @dataclass
@@ -41,11 +49,22 @@ class AggCall:
 
 
 @dataclass
+class WindowCall:
+    """One windowed function: computed over the (post-agg) result frame and
+    exposed to projections as `slot` (mirrors DataFusion's WindowExpr)."""
+    op: str                       # lowercase function name (mean→avg)
+    args: List[Expr] = field(default_factory=list)
+    spec: WindowSpec = field(default_factory=WindowSpec)
+    slot: str = ""
+
+
+@dataclass
 class Analysis:
     query: Query
     projections: List[SelectItem] = field(default_factory=list)  # rewritten
     group_exprs: List[Expr] = field(default_factory=list)
     agg_calls: List[AggCall] = field(default_factory=list)
+    window_calls: List[WindowCall] = field(default_factory=list)
     having: Optional[Expr] = None                                # rewritten
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
     column_refs: List[str] = field(default_factory=list)
@@ -65,6 +84,11 @@ def _walk_columns(e: Expr, out: set) -> None:
     if isinstance(e, FunctionCall):
         for a in e.args:
             _walk_columns(a, out)
+        if e.over is not None:
+            for p in e.over.partition_by:
+                _walk_columns(p, out)
+            for oe, _ in e.over.order_by:
+                _walk_columns(oe, out)
     if isinstance(e, InList):
         for a in e.items:
             _walk_columns(a, out)
@@ -78,6 +102,83 @@ def _walk_columns(e: Expr, out: set) -> None:
             _walk_columns(e.else_, out)
 
 
+def map_expr_children(e: Expr, f) -> Expr:
+    """Rebuild e with f applied to each child expression."""
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, f(e.left), f(e.right))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, f(e.operand))
+    if isinstance(e, Cast):
+        return Cast(f(e.expr), e.type_name)
+    if isinstance(e, Between):
+        return Between(f(e.expr), f(e.low), f(e.high), e.negated)
+    if isinstance(e, InList):
+        return InList(f(e.expr), [f(i) for i in e.items], e.negated)
+    if isinstance(e, IsNull):
+        return IsNull(f(e.expr), e.negated)
+    if isinstance(e, Case):
+        return Case(
+            f(e.operand) if e.operand else None,
+            [(f(c), f(v)) for c, v in e.whens],
+            f(e.else_) if e.else_ else None)
+    if isinstance(e, FunctionCall):
+        return FunctionCall(e.name, [f(a) for a in e.args], e.distinct,
+                            e.over)
+    return e
+
+
+class _WindowRewriter:
+    """Replaces windowed FunctionCalls with slot Columns, collecting calls."""
+
+    def __init__(self):
+        self.calls: List[WindowCall] = []
+        self._seen: Dict[str, str] = {}
+
+    def rewrite(self, e: Expr) -> Expr:
+        if isinstance(e, FunctionCall) and e.over is not None:
+            key = expr_name(e)
+            if key in self._seen:
+                return Column(self._seen[key])
+            op = "avg" if e.name == "mean" else e.name
+            if op not in WINDOW_ONLY_NAMES and op not in WINDOW_AGG_NAMES:
+                raise UnsupportedError(f"window function {op!r}")
+            if e.distinct:
+                raise UnsupportedError("DISTINCT in window functions")
+            for a in e.args:
+                if _contains_window(a):
+                    raise PlanError("nested window functions")
+            args = list(e.args)
+            if args and isinstance(args[0], Star):
+                if op != "count":
+                    raise PlanError(f"{op}(*) is not valid")
+                args = []        # count(*) counts frame rows
+            slot = f"__win{len(self.calls)}"
+            self.calls.append(WindowCall(op=op, args=args,
+                                         spec=e.over, slot=slot))
+            self._seen[key] = slot
+            return Column(slot)
+        return map_expr_children(e, self.rewrite)
+
+
+def _contains_window(e: Expr) -> bool:
+    if isinstance(e, FunctionCall) and e.over is not None:
+        return True
+    if isinstance(e, FunctionCall):
+        return any(_contains_window(a) for a in e.args)
+    for attr in ("left", "right", "operand", "expr", "low", "high"):
+        child = getattr(e, attr, None)
+        if isinstance(child, Expr) and _contains_window(child):
+            return True
+    if isinstance(e, InList):
+        return any(_contains_window(i) for i in e.items)
+    if isinstance(e, Case):
+        parts = ([e.operand] if e.operand else []) + \
+            [x for cv in e.whens for x in cv] + \
+            ([e.else_] if e.else_ else [])
+        return any(_contains_window(p) for p in parts)
+    return False
+
+
 class _AggRewriter:
     """Replaces aggregate FunctionCalls with slot Columns, collecting calls."""
 
@@ -86,7 +187,8 @@ class _AggRewriter:
         self._seen: Dict[str, str] = {}
 
     def rewrite(self, e: Expr) -> Expr:
-        if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+        if isinstance(e, FunctionCall) and e.name in AGG_NAMES \
+                and e.over is None:
             key = expr_name(e)
             if key in self._seen:
                 return Column(self._seen[key])
@@ -108,41 +210,18 @@ class _AggRewriter:
             self.calls.append(call)
             self._seen[key] = slot
             return Column(slot)
-        return self._map_children(e)
+        return map_expr_children(e, self.rewrite)
 
     def rewrite_inner_check(self, e: Expr) -> Expr:
-        if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+        if isinstance(e, FunctionCall) and e.name in AGG_NAMES \
+                and e.over is None:
             raise PlanError("nested aggregate functions are not allowed")
-        return e
-
-    def _map_children(self, e: Expr) -> Expr:
-        if isinstance(e, BinaryOp):
-            return BinaryOp(e.op, self.rewrite(e.left), self.rewrite(e.right))
-        if isinstance(e, UnaryOp):
-            return UnaryOp(e.op, self.rewrite(e.operand))
-        if isinstance(e, Cast):
-            return Cast(self.rewrite(e.expr), e.type_name)
-        if isinstance(e, Between):
-            return Between(self.rewrite(e.expr), self.rewrite(e.low),
-                           self.rewrite(e.high), e.negated)
-        if isinstance(e, InList):
-            return InList(self.rewrite(e.expr),
-                          [self.rewrite(i) for i in e.items], e.negated)
-        if isinstance(e, IsNull):
-            return IsNull(self.rewrite(e.expr), e.negated)
-        if isinstance(e, Case):
-            return Case(
-                self.rewrite(e.operand) if e.operand else None,
-                [(self.rewrite(c), self.rewrite(v)) for c, v in e.whens],
-                self.rewrite(e.else_) if e.else_ else None)
-        if isinstance(e, FunctionCall):
-            return FunctionCall(e.name, [self.rewrite(a) for a in e.args],
-                                e.distinct)
         return e
 
 
 def contains_aggregate(e: Expr) -> bool:
-    if isinstance(e, FunctionCall) and e.name in AGG_NAMES:
+    if isinstance(e, FunctionCall) and e.name in AGG_NAMES \
+            and e.over is None:
         return True
     if isinstance(e, FunctionCall):
         return any(contains_aggregate(a) for a in e.args)
@@ -184,14 +263,22 @@ def analyze(query: Query) -> Analysis:
         if contains_aggregate(g):
             raise PlanError("aggregate functions are not allowed in GROUP BY")
 
+    for e in ([query.where] if query.where is not None else []) + \
+            list(query.group_by) + \
+            ([query.having] if query.having is not None else []):
+        if _contains_window(e):
+            raise PlanError("window functions are only allowed in the "
+                            "SELECT list and ORDER BY")
+
     rw = _AggRewriter()
+    wrw = _WindowRewriter()
     group_names = {expr_name(g) for g in a.group_exprs}
 
     def rewrite_top(e: Expr) -> Expr:
         # a projection identical to a group expr passes through
         if expr_name(e) in group_names:
             return Column(_group_slot(expr_name(e)))
-        return rw.rewrite(e)
+        return rw.rewrite(wrw.rewrite(e))
 
     a.projections = []
     for item in query.projections:
@@ -206,9 +293,20 @@ def analyze(query: Query) -> Analysis:
     a.order_by = []
     for e, asc in query.order_by:
         e = resolve_ref(e)
-        a.order_by.append((rewrite_top(e) if (rw.calls or a.group_exprs)
+        a.order_by.append((rewrite_top(e)
+                           if (rw.calls or a.group_exprs or wrw.calls)
                            else e, asc))
     a.agg_calls = rw.calls
+    a.window_calls = wrw.calls
+    # window args / PARTITION BY / ORDER BY may reference aggregates in a
+    # grouped query (e.g. rank() OVER (ORDER BY sum(v) DESC)) — rewrite
+    # them to agg slots so they evaluate over the grouped frame
+    for wc in a.window_calls:
+        wc.args = [rewrite_top(x) for x in wc.args]
+        wc.spec = WindowSpec(
+            [rewrite_top(x) for x in wc.spec.partition_by],
+            [(rewrite_top(x), asc) for x, asc in wc.spec.order_by],
+            wc.spec.frame)
 
     refs: set = set()
     for item in query.projections:

@@ -372,6 +372,11 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
     """Return a TpuPlan if (table, query) fits the fast-path shape."""
     if table is None or not a.is_aggregate or query.joins:
         return None
+    if a.window_calls:
+        # window slots evaluate on the post-aggregate frame in the
+        # fallback engine (query/window.py); the device plan has no
+        # WindowAggExec analogue yet
+        return None
     if not hasattr(table, "regions"):
         return None  # only region-backed (mito) tables have the SoA path
     schema = table.schema

@@ -14,7 +14,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Expr", "Literal", "Column", "Star", "BinaryOp", "UnaryOp",
-    "FunctionCall", "Between", "InList", "IsNull", "Cast", "Case",
+    "FunctionCall", "WindowSpec", "Between", "InList", "IsNull", "Cast", "Case",
     "Interval", "Placeholder", "Subquery",
     "Statement", "SelectItem", "TableRef", "Join", "Query", "Insert",
     "Delete", "ColumnDef", "PartitionEntry", "Partitions", "CreateTable",
@@ -81,16 +81,52 @@ class UnaryOp(Expr):
 
 
 @dataclass
+class WindowSpec:
+    """OVER (...) clause: partitioning, intra-partition order, row frame.
+
+    frame is None (default frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    when order_by is set, the whole partition otherwise) or a ROWS frame
+    (lo, hi) with offsets relative to the current row — negative =
+    preceding, None = unbounded on that side."""
+    partition_by: List["Expr"] = field(default_factory=list)
+    order_by: List[Tuple["Expr", bool]] = field(default_factory=list)
+    frame: Optional[Tuple[Optional[int], Optional[int]]] = None
+
+    def __str__(self):
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY " +
+                         ", ".join(str(e) for e in self.partition_by))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                f"{e}{'' if asc else ' DESC'}" for e, asc in self.order_by))
+        if self.frame is not None:
+            def bound(v, side):
+                if v is None:
+                    return f"UNBOUNDED {side}"
+                if v == 0:
+                    return "CURRENT ROW"
+                return f"{-v} PRECEDING" if v < 0 else f"{v} FOLLOWING"
+            parts.append(f"ROWS BETWEEN {bound(self.frame[0], 'PRECEDING')} "
+                         f"AND {bound(self.frame[1], 'FOLLOWING')}")
+        return " ".join(parts)
+
+
+@dataclass
 class FunctionCall(Expr):
     name: str                       # lowercase
     args: List[Expr] = field(default_factory=list)
     distinct: bool = False
+    over: Optional[WindowSpec] = None   # set → window function
 
     def __str__(self):
         inner = ", ".join(str(a) for a in self.args)
         if self.distinct:
             inner = "DISTINCT " + inner
-        return f"{self.name}({inner})"
+        base = f"{self.name}({inner})"
+        if self.over is not None:
+            return f"{base} OVER ({self.over})"
+        return base
 
 
 @dataclass

@@ -516,6 +516,64 @@ class Parser:
             return self._parse_compound_identifier()
         raise ParserError(f"unexpected token {t.value!r} at offset {t.pos}")
 
+    def _parse_window_spec(self) -> WindowSpec:
+        """OVER ( [PARTITION BY e,...] [ORDER BY e [ASC|DESC],...]
+        [ROWS frame] ) — reference: DataFusion's window planning
+        (src/query/src/datafusion.rs:61-232 delegates to it)."""
+        self.expect_op("(")
+        spec = WindowSpec()
+        if self.match_kw("PARTITION"):
+            self.expect_kw("BY")
+            spec.partition_by.append(self.parse_expr())
+            while self.match_op(","):
+                spec.partition_by.append(self.parse_expr())
+        if self.match_kw("ORDER"):
+            self.expect_kw("BY")
+
+            def one():
+                e = self.parse_expr()
+                asc = True
+                if self.match_kw("DESC"):
+                    asc = False
+                elif self.match_kw("ASC"):
+                    pass
+                return (e, asc)
+            spec.order_by.append(one())
+            while self.match_op(","):
+                spec.order_by.append(one())
+        if self.at_kw("ROWS") or self.at_kw("RANGE"):
+            kind = self.next().upper()
+            if kind == "RANGE":
+                raise ParserError("RANGE frames are not supported; "
+                                  "use ROWS")
+
+            def bound(default_side: int) -> Optional[int]:
+                if self.match_kw("UNBOUNDED"):
+                    if not (self.match_kw("PRECEDING") or
+                            self.match_kw("FOLLOWING")):
+                        raise ParserError("expected PRECEDING/FOLLOWING "
+                                          "after UNBOUNDED")
+                    return None
+                if self.match_kw("CURRENT"):
+                    self.expect_kw("ROW")
+                    return 0
+                n = self._parse_int("frame bound")
+                if self.match_kw("PRECEDING"):
+                    return -n
+                if self.match_kw("FOLLOWING"):
+                    return n
+                raise ParserError("expected PRECEDING or FOLLOWING")
+            if self.match_kw("BETWEEN"):
+                lo = bound(-1)
+                self.expect_kw("AND")
+                hi = bound(1)
+            else:
+                lo = bound(-1)
+                hi = 0
+            spec.frame = (lo, hi)
+        self.expect_op(")")
+        return spec
+
     def _parse_case(self) -> Expr:
         self.expect_kw("CASE")
         operand = None
@@ -544,7 +602,11 @@ class Parser:
                 while self.match_op(","):
                     args.append(self.parse_expr())
             self.expect_op(")")
-            return FunctionCall(name.lower(), args, distinct)
+            fc = FunctionCall(name.lower(), args, distinct)
+            if self.at_kw("OVER"):
+                self.next()
+                fc.over = self._parse_window_spec()
+            return fc
         parts = [name]
         while self.peek().kind == OP and self.peek().value == ".":
             # a.b or a.*

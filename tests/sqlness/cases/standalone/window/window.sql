@@ -1,0 +1,21 @@
+CREATE TABLE wf (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO wf VALUES ('a', 0, 3.0), ('a', 1000, 1.0), ('a', 2000, 4.0), ('b', 0, 10.0), ('b', 1000, 20.0), ('b', 2000, 20.0);
+
+SELECT host, ts, v, row_number() OVER (PARTITION BY host ORDER BY ts) AS rn FROM wf ORDER BY host, ts;
+
+SELECT host, ts, v, rank() OVER (PARTITION BY host ORDER BY v) AS rk, dense_rank() OVER (PARTITION BY host ORDER BY v) AS dr FROM wf ORDER BY host, ts;
+
+SELECT host, ts, lag(v) OVER (PARTITION BY host ORDER BY ts) AS pv, lead(v, 1, -1.0) OVER (PARTITION BY host ORDER BY ts) AS nv FROM wf ORDER BY host, ts;
+
+SELECT host, ts, sum(v) OVER (PARTITION BY host ORDER BY ts) AS cs FROM wf ORDER BY host, ts;
+
+SELECT host, ts, avg(v) OVER (PARTITION BY host ORDER BY ts ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS mv FROM wf ORDER BY host, ts;
+
+SELECT host, ts, first_value(v) OVER (PARTITION BY host ORDER BY ts) AS fv, last_value(v) OVER (PARTITION BY host ORDER BY ts ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS lv FROM wf ORDER BY host, ts;
+
+SELECT host, sum(v) AS total, rank() OVER (ORDER BY sum(v) DESC) AS rk FROM wf GROUP BY host ORDER BY host;
+
+SELECT host, ts, count(*) OVER (PARTITION BY host) AS c FROM wf ORDER BY host, ts;
+
+DROP TABLE wf;

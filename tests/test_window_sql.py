@@ -1,0 +1,164 @@
+"""SQL window functions (OVER clause) — fallback-engine execution.
+
+Reference behavior: DataFusion's WindowAggExec, reached through
+src/query/src/datafusion.rs:61-232; semantics cross-checked against
+PostgreSQL for peers (RANGE default frame), NULL handling, and
+partition-boundary behavior.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import PlanError
+from greptimedb_tpu.frontend.instance import FrontendInstance
+
+
+@pytest.fixture(scope="module")
+def fe(tmp_path_factory):
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=str(tmp_path_factory.mktemp("win")),
+        register_numbers_table=False))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    fe.do_query("CREATE TABLE w (host STRING, ts TIMESTAMP TIME INDEX,"
+                " k BIGINT, v DOUBLE, PRIMARY KEY(host))")
+    fe.do_query(
+        "INSERT INTO w VALUES"
+        " ('a', 0, 1, 3.0), ('a', 1000, 1, 1.0), ('a', 2000, 2, 4.0),"
+        " ('a', 3000, 2, NULL), ('a', 4000, 3, 5.0),"
+        " ('b', 0, 1, 10.0), ('b', 1000, 2, 20.0)")
+    yield fe
+    fe.shutdown()
+
+
+def rows(fe, sql):
+    out = fe.do_query(sql)
+    if isinstance(out, list):
+        out = out[0]
+    rb = out.batches[0]
+    cols = [vec.to_pylist() for vec in rb.columns]
+    return list(zip(*cols)) if cols else []
+
+
+def col(fe, sql, idx=-1):
+    return [r[idx] for r in rows(fe, sql)]
+
+
+class TestRanking:
+    def test_row_number(self, fe):
+        got = col(fe, "SELECT host, ts, row_number() OVER "
+                      "(PARTITION BY host ORDER BY ts) FROM w "
+                      "ORDER BY host, ts")
+        assert got == [1, 2, 3, 4, 5, 1, 2]
+
+    def test_rank_and_dense_rank_ties(self, fe):
+        got = rows(fe, "SELECT ts, rank() OVER (ORDER BY k), "
+                       "dense_rank() OVER (ORDER BY k) FROM w "
+                       "WHERE host = 'a' ORDER BY ts")
+        assert [r[1] for r in got] == [1, 1, 3, 3, 5]
+        assert [r[2] for r in got] == [1, 1, 2, 2, 3]
+
+    def test_percent_rank_cume_dist(self, fe):
+        got = rows(fe, "SELECT ts, percent_rank() OVER (ORDER BY k), "
+                       "cume_dist() OVER (ORDER BY k) FROM w "
+                       "WHERE host = 'a' ORDER BY ts")
+        assert [r[1] for r in got] == [0.0, 0.0, 0.5, 0.5, 1.0]
+        assert [r[2] for r in got] == [0.4, 0.4, 0.8, 0.8, 1.0]
+
+    def test_ntile(self, fe):
+        got = col(fe, "SELECT ts, ntile(2) OVER (ORDER BY ts) FROM w "
+                      "WHERE host = 'a' ORDER BY ts")
+        assert got == [1, 1, 1, 2, 2]
+
+    def test_rank_requires_order(self, fe):
+        with pytest.raises(PlanError):
+            fe.do_query("SELECT rank() OVER () FROM w")
+
+
+class TestNavigation:
+    def test_lag_lead_partition_bounds(self, fe):
+        got = rows(fe, "SELECT host, ts, lag(v) OVER "
+                       "(PARTITION BY host ORDER BY ts), lead(v, 1, -1.0) "
+                       "OVER (PARTITION BY host ORDER BY ts) FROM w "
+                       "ORDER BY host, ts")
+        lags = [r[2] for r in got]
+        leads = [r[3] for r in got]
+        assert lags == [None, 3.0, 1.0, 4.0, None, None, 10.0]
+        assert leads == [1.0, 4.0, None, 5.0, -1.0, 20.0, -1.0]
+
+    def test_first_last_value(self, fe):
+        got = rows(fe, "SELECT host, ts, first_value(v) OVER "
+                       "(PARTITION BY host ORDER BY ts), last_value(v) OVER "
+                       "(PARTITION BY host ORDER BY ts ROWS BETWEEN "
+                       "UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) "
+                       "FROM w ORDER BY host, ts")
+        assert [r[2] for r in got] == [3.0] * 5 + [10.0] * 2
+        assert [r[3] for r in got] == [5.0] * 5 + [20.0] * 2
+
+
+class TestAggregates:
+    def test_cumulative_sum_skips_nulls(self, fe):
+        got = col(fe, "SELECT ts, sum(v) OVER (PARTITION BY host "
+                      "ORDER BY ts) FROM w WHERE host = 'a' ORDER BY ts")
+        assert got == [3.0, 4.0, 8.0, 8.0, 13.0]
+
+    def test_range_peers_share_frame(self, fe):
+        # default frame is RANGE: ties on the order key are peers
+        got = col(fe, "SELECT ts, count(*) OVER (ORDER BY k) FROM w "
+                      "WHERE host = 'a' ORDER BY ts")
+        assert got == [2, 2, 4, 4, 5]
+
+    def test_rows_frame_moving_avg(self, fe):
+        got = col(fe, "SELECT ts, avg(v) OVER (ORDER BY ts ROWS BETWEEN "
+                      "1 PRECEDING AND CURRENT ROW) FROM w "
+                      "WHERE host = 'a' ORDER BY ts")
+        assert got[0] == 3.0
+        assert got[1] == 2.0
+        assert got[2] == 2.5
+        assert got[3] == 4.0          # (4, NULL) -> avg over non-null
+        assert got[4] == 5.0          # (NULL, 5)
+
+    def test_rows_frame_centered_min(self, fe):
+        got = col(fe, "SELECT ts, min(v) OVER (ORDER BY ts ROWS BETWEEN "
+                      "1 PRECEDING AND 1 FOLLOWING) FROM w "
+                      "WHERE host = 'a' ORDER BY ts")
+        assert got == [1.0, 1.0, 1.0, 4.0, 5.0]
+
+    def test_count_star_vs_count_arg(self, fe):
+        got = rows(fe, "SELECT ts, count(*) OVER (ORDER BY ts ROWS BETWEEN "
+                       "1 PRECEDING AND CURRENT ROW), count(v) OVER "
+                       "(ORDER BY ts ROWS BETWEEN 1 PRECEDING AND "
+                       "CURRENT ROW) FROM w WHERE host = 'a' ORDER BY ts")
+        assert [r[1] for r in got] == [1, 2, 2, 2, 2]
+        assert [r[2] for r in got] == [1, 2, 2, 1, 1]
+
+    def test_whole_partition_no_order(self, fe):
+        got = col(fe, "SELECT host, sum(v) OVER (PARTITION BY host) FROM w "
+                      "ORDER BY host, ts")
+        assert got == [13.0] * 5 + [30.0] * 2
+
+    def test_window_over_grouped_query(self, fe):
+        got = rows(fe, "SELECT host, sum(v) AS total, rank() OVER "
+                       "(ORDER BY sum(v) DESC) FROM w GROUP BY host "
+                       "ORDER BY host")
+        assert got == [("a", 13.0, 2), ("b", 30.0, 1)]
+
+    def test_expression_of_window(self, fe):
+        got = col(fe, "SELECT ts, v - avg(v) OVER (PARTITION BY host) "
+                      "FROM w WHERE host = 'b' ORDER BY ts")
+        assert got == [-5.0, 5.0]
+
+
+class TestValidation:
+    def test_window_not_allowed_in_where(self, fe):
+        with pytest.raises(PlanError):
+            fe.do_query("SELECT ts FROM w WHERE "
+                        "rank() OVER (ORDER BY ts) = 1")
+
+    def test_order_by_window_alias(self, fe):
+        got = rows(fe, "SELECT host, ts, row_number() OVER "
+                       "(PARTITION BY host ORDER BY v DESC) AS rn FROM w "
+                       "WHERE v IS NOT NULL ORDER BY host, rn")
+        assert [r[2] for r in got] == [1, 2, 3, 4, 1, 2]
