@@ -27,7 +27,7 @@ from ..client import DatanodeClient
 from ..datatypes.schema import Schema
 from ..errors import (
     GreptimeError, InvalidArgumentsError, TableAlreadyExistsError,
-    TableNotFoundError)
+    TableNotFoundError, UnsupportedError)
 from ..meta import MetaClient, TableRoute
 from ..partition import rule_from_partitions, split_rows
 from ..query import QueryEngine
@@ -385,6 +385,51 @@ class DistInstance:
                                             table_name)
         return table.insert(columns)
 
+    def alter_table(self, stmt: ast.AlterTable, ctx: QueryContext):
+        """Distributed ALTER: fan the engine request out to every owning
+        datanode, then refresh the frontend view (and, for RENAME, move
+        the meta route so the table resolves under its new name).
+        Reference: dist DDL via meta procedures,
+        src/frontend/src/instance/distributed.rs + alter flow in
+        src/table/src/metadata.rs:249-297."""
+        from ..query.output import Output
+        from ..table.requests import (
+            AddColumnRequest, AlterKind, AlterTableRequest)
+        from .statement import build_column_schema
+        catalog, schema_name, table_name = ctx.resolve(stmt.table)
+        table = self._resolve_table(catalog, schema_name, table_name)
+        if table is None:
+            raise TableNotFoundError(f"table {table_name!r} not found")
+        op = stmt.operation
+        if isinstance(op, ast.AddColumn):
+            cs = build_column_schema(op.column, is_tag=False,
+                                     is_time_index=False)
+            req = AlterTableRequest(
+                table_name, AlterKind.ADD_COLUMNS, catalog_name=catalog,
+                schema_name=schema_name,
+                add_columns=[AddColumnRequest(cs, location=op.location)])
+        elif isinstance(op, ast.DropColumn):
+            req = AlterTableRequest(
+                table_name, AlterKind.DROP_COLUMNS, catalog_name=catalog,
+                schema_name=schema_name, drop_columns=[op.name])
+        elif isinstance(op, ast.RenameTable):
+            req = AlterTableRequest(
+                table_name, AlterKind.RENAME_TABLE, catalog_name=catalog,
+                schema_name=schema_name, new_table_name=op.new_name)
+        else:
+            raise UnsupportedError(f"ALTER operation {type(op).__name__}")
+        for client in table._involved_clients():
+            client.ddl_alter_table(req)
+        self.catalog.deregister_table(catalog, schema_name, table_name)
+        if isinstance(op, ast.RenameTable):
+            self.meta.rename_route(
+                f"{catalog}.{schema_name}.{table_name}",
+                f"{catalog}.{schema_name}.{op.new_name}")
+            self._resolve_table(catalog, schema_name, op.new_name)
+        else:
+            self._resolve_table(catalog, schema_name, table_name)
+        return Output.rows(0)
+
     # ---- SQL ----
     def do_query(self, sql: str, ctx: Optional[QueryContext] = None):
         from ..sql import parse_statements
@@ -402,6 +447,8 @@ class DistInstance:
         if isinstance(stmt, ast.DropTable):
             self.drop_table(stmt, ctx)
             return Output.rows(0)
+        if isinstance(stmt, ast.AlterTable):
+            return self.alter_table(stmt, ctx)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):

@@ -64,6 +64,11 @@ class FlightMetaServer(flight.FlightServerBase):
                 resp = {"ok": True,
                         "deleted": self.srv.delete_table_route(
                             body["name"])}
+            elif kind == "rename_route":
+                route = self.srv.rename_table_route(body["name"],
+                                                    body["new_name"])
+                resp = {"ok": True,
+                        "route": route.to_dict() if route else None}
             elif kind == "allocate_table_id":
                 resp = {"ok": True, "id": self.srv.allocate_table_id()}
             elif kind == "put_table_info":
@@ -144,6 +149,13 @@ class FlightMetaClient:
     def delete_route(self, full_name: str) -> bool:
         return bool(self._action("delete_route",
                                  {"name": full_name})["deleted"])
+
+    def rename_route(self, full_name: str,
+                     new_full_name: str) -> Optional[TableRoute]:
+        resp = self._action("rename_route", {"name": full_name,
+                                             "new_name": new_full_name})
+        return TableRoute.from_dict(resp["route"]) \
+            if resp.get("route") else None
 
     def allocate_table_id(self) -> int:
         return int(self._action("allocate_table_id", {})["id"])

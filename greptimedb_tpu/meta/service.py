@@ -217,6 +217,25 @@ class MetaSrv:
     def delete_table_route(self, full_table_name: str) -> bool:
         return self.kv.delete(f"{ROUTE_PREFIX}{full_table_name}")
 
+    def rename_table_route(self, old_full_name: str,
+                           new_full_name: str) -> Optional[TableRoute]:
+        """Move a route (and its table info) to a new name, keeping the
+        table id and region placement (distributed ALTER ... RENAME)."""
+        route = self.table_route(old_full_name)
+        if route is None:
+            return None
+        route.table_name = new_full_name
+        key = f"{ROUTE_PREFIX}{new_full_name}"
+        if not self.kv.compare_and_put(
+                key, None, json.dumps(route.to_dict()).encode()):
+            raise GreptimeError(f"table route exists: {new_full_name}")
+        self.kv.delete(f"{ROUTE_PREFIX}{old_full_name}")
+        info = self.table_info(old_full_name)
+        if info is not None:
+            self.put_table_info(new_full_name, info)
+            self.delete_table_info(old_full_name)
+        return route
+
     def all_table_routes(self) -> List[TableRoute]:
         return [TableRoute.from_dict(json.loads(v))
                 for _, v in self.kv.range(ROUTE_PREFIX)]
@@ -311,6 +330,10 @@ class MetaClient:
 
     def delete_route(self, full_name: str) -> bool:
         return self._srv.delete_table_route(full_name)
+
+    def rename_route(self, full_name: str,
+                     new_full_name: str) -> Optional[TableRoute]:
+        return self._srv.rename_table_route(full_name, new_full_name)
 
     def allocate_table_id(self) -> int:
         return self._srv.allocate_table_id()

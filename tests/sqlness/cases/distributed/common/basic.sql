@@ -1,0 +1,17 @@
+CREATE TABLE dist_basic (n BIGINT, ts TIMESTAMP TIME INDEX, row_id BIGINT) PARTITION BY RANGE COLUMNS (n) (PARTITION r0 VALUES LESS THAN (5), PARTITION r1 VALUES LESS THAN (9), PARTITION r2 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO dist_basic VALUES (1, 1000, 1), (2, 2000, 2), (3, 3000, 3), (5, 5000, 5), (6, 6000, 6), (8, 8000, 8), (9, 9000, 9), (10, 10000, 10);
+
+SELECT * FROM dist_basic ORDER BY n;
+
+SELECT count(*), sum(n), avg(n), min(n), max(n) FROM dist_basic;
+
+SELECT n FROM dist_basic WHERE n > 5 ORDER BY n;
+
+SELECT count(*) FROM dist_basic WHERE n < 9;
+
+DELETE FROM dist_basic WHERE n = 6;
+
+SELECT count(*), sum(n) FROM dist_basic;
+
+DROP TABLE dist_basic;

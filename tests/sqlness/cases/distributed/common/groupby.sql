@@ -1,0 +1,9 @@
+CREATE TABLE dist_gb (host STRING, n BIGINT, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host, n)) PARTITION BY RANGE COLUMNS (n) (PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO dist_gb VALUES ('a', 1, 1000, 1.0), ('a', 15, 2000, 2.0), ('b', 2, 3000, 3.0), ('b', 20, 4000, 4.0), ('a', 5, 5000, 5.0);
+
+SELECT host, count(*), sum(v), avg(v) FROM dist_gb GROUP BY host ORDER BY host;
+
+SELECT host, max(v) FROM dist_gb GROUP BY host HAVING max(v) > 3.5 ORDER BY host;
+
+DROP TABLE dist_gb;

@@ -112,6 +112,41 @@ def make_frontend(data_home: str):
     return fe
 
 
+class _DistEnv:
+    """2-datanode cluster frontend for cases/distributed/ (the reference
+    runs the same golden cases against a distributed env,
+    tests/runner/src/env.rs + tests/cases/distributed/)."""
+
+    def __init__(self, data_home: str):
+        from greptimedb_tpu.client import LocalDatanodeClient
+        from greptimedb_tpu.datanode.instance import (
+            DatanodeInstance, DatanodeOptions)
+        from greptimedb_tpu.frontend.distributed import DistInstance
+        from greptimedb_tpu.meta import MetaClient, Peer
+        from greptimedb_tpu.meta.kv import MemKv
+        from greptimedb_tpu.meta.service import MetaSrv
+        self.datanodes = []
+        srv = MetaSrv(MemKv())
+        clients = {}
+        for i in (1, 2):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=f"{data_home}/dn{i}", node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            self.datanodes.append(dn)
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        self.fe = DistInstance(MetaClient(srv), clients)
+
+    def do_query(self, sql: str, ctx=None):
+        return self.fe.do_query(sql, ctx)
+
+    def shutdown(self):
+        for dn in self.datanodes:
+            dn.shutdown()
+
+
 def case_files(filters: List[str]) -> List[Path]:
     files = sorted(CASES_DIR.rglob("*.sql"))
     if filters:
@@ -122,8 +157,9 @@ def case_files(filters: List[str]) -> List[Path]:
 
 def run_one(sql_path: Path, update: bool) -> Optional[str]:
     result_path = sql_path.with_suffix(".result")
+    distributed = "distributed" in sql_path.relative_to(CASES_DIR).parts
     with tempfile.TemporaryDirectory() as home:
-        fe = make_frontend(home)
+        fe = _DistEnv(home) if distributed else make_frontend(home)
         try:
             got = run_case(sql_path.read_text(), fe)
         finally:
