@@ -159,14 +159,53 @@ def metasrv_start(args) -> None:
     from ..meta.kv import FileKv, MemKv
 
     init_logging(args.log_level or "info")
-    kv = FileKv(args.store) if args.store else MemKv()
+    raft_node = None
+    if args.peers:
+        # replicated meta: this node + --peers form a raft group; routes
+        # survive a metasrv loss (reference: etcd cluster,
+        # store/etcd.rs:762). --node-id indexes into the sorted peer
+        # set; transports ride the same Flight plane.
+        from ..meta.replication import (
+            FlightTransport, RaftNode, ReplicatedKv)
+        peer_addrs = dict(
+            enumerate(sorted(set(args.peers.split(",")) |
+                             {args.bind_addr}), start=1))
+        my_id = next(i for i, a in peer_addrs.items()
+                     if a == args.bind_addr)
+        raft_node = RaftNode(
+            my_id, list(peer_addrs),
+            store_path=f"{args.store}.raft" if args.store else None)
+        for pid, addr in peer_addrs.items():
+            if pid != my_id:
+                raft_node.transports[pid] = FlightTransport(
+                    f"grpc://{addr}")
+        kv = ReplicatedKv(raft_node)
+    else:
+        kv = FileKv(args.store) if args.store else MemKv()
     srv = MetaSrv(kv)
-    server = FlightMetaServer(srv, f"grpc://{args.bind_addr}")
+    server = FlightMetaServer(srv, f"grpc://{args.bind_addr}",
+                              raft_node=raft_node)
     server.serve_in_background()
+    if raft_node is not None:
+        raft_node.start()
     # leader election: with several metasrv replicas over one KV, only
-    # the lease holder mutates routes (reference: election/etcd.rs)
-    from ..meta.lock import Election
-    election = Election(kv, f"metasrv-{args.bind_addr}")
+    # the lease holder mutates routes (reference: election/etcd.rs).
+    # Under raft the consensus leader IS the lease holder.
+    if raft_node is not None:
+        class _RaftElection:
+            def start(self):
+                pass
+
+            def stop(self):
+                pass
+
+            @property
+            def is_leader(self):
+                return raft_node.is_leader
+        election = _RaftElection()
+    else:
+        from ..meta.lock import Election
+        election = Election(kv, f"metasrv-{args.bind_addr}")
     election.start()
 
     # region failover runner (reference: FailureDetectRunner on the
@@ -190,6 +229,8 @@ def metasrv_start(args) -> None:
     def shutdown():
         runner.stop()
         election.stop()
+        if raft_node is not None:
+            raft_node.stop()
         server.shutdown()
 
     _block_until_signal(shutdown)
@@ -289,6 +330,9 @@ def main(argv=None) -> int:
     mstart = msub.add_parser("start")
     mstart.add_argument("--bind-addr", default="127.0.0.1:3002")
     mstart.add_argument("--store", help="path for the file-backed KV")
+    mstart.add_argument("--peers", help="comma-separated bind addrs of "
+                        "the full metasrv replica set (enables the "
+                        "replicated raft store)")
     mstart.add_argument("--failover-interval", type=float, default=10.0)
     mstart.add_argument("--log-level")
     mstart.set_defaults(func=metasrv_start)

@@ -24,9 +24,11 @@ from .service import (
 
 
 class FlightMetaServer(flight.FlightServerBase):
-    def __init__(self, srv: MetaSrv, location: str = "grpc://127.0.0.1:0"):
+    def __init__(self, srv: MetaSrv, location: str = "grpc://127.0.0.1:0",
+                 raft_node=None):
         super().__init__(location)
         self.srv = srv
+        self.raft_node = raft_node    # replication RPCs when clustered
         self._location = location
 
     @property
@@ -86,6 +88,13 @@ class FlightMetaServer(flight.FlightServerBase):
                     if body.get("alive_only", True) else self.srv.peers()
                 resp = {"ok": True,
                         "peers": [p.to_dict() for p in peers]}
+            elif kind == "raft_request_vote" and self.raft_node is not None:
+                resp = {"ok": True,
+                        **self.raft_node.handle_request_vote(**body)}
+            elif kind == "raft_append_entries" \
+                    and self.raft_node is not None:
+                resp = {"ok": True,
+                        **self.raft_node.handle_append_entries(**body)}
             else:
                 raise GreptimeError(f"unknown meta action {kind!r}")
         except GreptimeError as e:

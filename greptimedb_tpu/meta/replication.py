@@ -1,0 +1,479 @@
+"""Replicated metadata KV: raft-lite consensus over the meta plane.
+
+Reference behavior: the meta-srv delegates durability + HA to an etcd
+cluster (src/meta-srv/src/service/store/etcd.rs:762, election at
+src/meta-srv/src/election/etcd.rs:34-70). This repo's single-node stand-in
+is FileKv; this module closes the gap for multi-meta deployments: N meta
+nodes replicate a command log with term-voted leader election and
+majority commit, so the cluster brain survives a node loss the way the
+datanode plane already does (region failover, meta/service.py:259).
+
+Design (raft essentials, sized to the meta workload):
+- Every mutation is a command appended to the leader's log, replicated
+  via append_entries, committed once a majority holds it, then applied
+  to the state machine (a plain dict) — on every node, in log order.
+- Elections: followers time out, become candidates, request votes; a
+  vote needs the candidate's log to be at least as up-to-date
+  (last_term, last_index) — the raft safety rule that keeps committed
+  entries on whoever wins.
+- Persistence: (term, voted_for, log) go to an atomic JSON snapshot per
+  node before any RPC reply, so a restarted node rejoins with its word
+  kept. State is rebuilt by replay.
+- Transport is pluggable: in-process handles for tests/single-process
+  clusters, Flight actions (meta/flight.py) across real sockets.
+
+The KV surface (`ReplicatedKv`) matches MemKv, so MetaSrv mounts it
+unchanged; non-leader nodes raise NotLeaderError carrying the leader
+hint for client-side retry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..errors import GreptimeError
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotLeaderError(GreptimeError):
+    def __init__(self, leader_id: Optional[int]):
+        super().__init__(f"not the meta leader (leader hint: {leader_id})")
+        self.leader_id = leader_id
+
+
+class RaftNode:
+    """One meta replica: consensus state + the applied KV dict."""
+
+    def __init__(self, node_id: int, peer_ids: List[int],
+                 *, store_path: Optional[str] = None,
+                 election_timeout: Tuple[float, float] = (1.5, 3.0),
+                 heartbeat_interval: float = 0.5):
+        self.node_id = node_id
+        self.peer_ids = [p for p in peer_ids if p != node_id]
+        self.transports: Dict[int, Any] = {}   # peer id -> transport
+        self.store_path = store_path
+        self._el_lo, self._el_hi = election_timeout
+        self._hb_every = heartbeat_interval
+
+        self._lock = threading.RLock()
+        self._applied = threading.Condition(self._lock)
+        # persistent
+        self.term = 0
+        self.voted_for: Optional[int] = None
+        self.log: List[dict] = []              # {term, op}
+        # volatile
+        self.role = FOLLOWER
+        self.leader_id: Optional[int] = None
+        self.commit_idx = 0                    # 1-based count committed
+        self.applied_idx = 0
+        self.state: Dict[str, bytes] = {}
+        self.next_idx: Dict[int, int] = {}
+        self._last_heard = time.monotonic()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        if store_path and os.path.exists(store_path):
+            self._load()
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(target=self._ticker, daemon=True,
+                             name=f"raft-{self.node_id}")
+        t.start()
+        self._threads = [t]
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        with self._lock:
+            # a stopped node serves nothing: drop leadership so stale
+            # reads/writes fail over instead of answering from a corpse
+            self.role = FOLLOWER
+            self.leader_id = None
+
+    # ---- persistence ----
+    def _persist_locked(self) -> None:
+        if not self.store_path:
+            return
+        doc = {"term": self.term, "voted_for": self.voted_for,
+               "log": self.log}
+        d = os.path.dirname(self.store_path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.store_path)
+
+    def _load(self) -> None:
+        with open(self.store_path) as f:
+            doc = json.load(f)
+        self.term = doc["term"]
+        self.voted_for = doc.get("voted_for")
+        self.log = doc["log"]
+
+    # ---- timers ----
+    def _election_deadline(self) -> float:
+        return self._last_heard + random.uniform(self._el_lo, self._el_hi)
+
+    def _ticker(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self._hb_every / 2)
+            with self._lock:
+                role = self.role
+                expired = time.monotonic() > self._election_deadline()
+            if role == LEADER:
+                self._broadcast_heartbeat()
+            elif expired:
+                self._run_election()
+
+    # ---- election ----
+    def _run_election(self) -> None:
+        with self._lock:
+            self.role = CANDIDATE
+            self.term += 1
+            self.voted_for = self.node_id
+            self.leader_id = None
+            self._last_heard = time.monotonic()
+            term = self.term
+            last_idx = len(self.log)
+            last_term = self.log[-1]["term"] if self.log else 0
+            self._persist_locked()
+        votes = 1
+        for pid in self.peer_ids:
+            tr = self.transports.get(pid)
+            if tr is None:
+                continue
+            try:
+                resp = tr.request_vote(term=term, candidate=self.node_id,
+                                       last_idx=last_idx,
+                                       last_term=last_term)
+            except Exception:
+                continue
+            with self._lock:
+                if resp["term"] > self.term:
+                    self._step_down(resp["term"])
+                    return
+            if resp.get("granted"):
+                votes += 1
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        with self._lock:
+            if self.role != CANDIDATE or self.term != term:
+                return
+            if votes >= quorum:
+                self.role = LEADER
+                self.leader_id = self.node_id
+                self.next_idx = {p: len(self.log) for p in self.peer_ids}
+                # a no-op in the new term lets prior-term entries commit
+                # (raft §5.4.2: only current-term entries count quorum)
+                self.log.append({"term": self.term, "op": {"kind": "noop"}})
+                self._persist_locked()
+        if self.role == LEADER:
+            self._broadcast_heartbeat()
+
+    def _step_down(self, term: int) -> None:
+        # caller holds the lock
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_locked()
+        self.role = FOLLOWER
+        self._last_heard = time.monotonic()
+
+    # ---- RPC handlers (called by peers' transports) ----
+    def handle_request_vote(self, term: int, candidate: int, last_idx: int,
+                            last_term: int) -> dict:
+        with self._lock:
+            if term > self.term:
+                self._step_down(term)
+            granted = False
+            if term == self.term and self.voted_for in (None, candidate):
+                my_last_term = self.log[-1]["term"] if self.log else 0
+                up_to_date = (last_term, last_idx) >= (my_last_term,
+                                                       len(self.log))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = candidate
+                    self._last_heard = time.monotonic()
+                    self._persist_locked()
+            return {"term": self.term, "granted": granted}
+
+    def handle_append_entries(self, term: int, leader: int, prev_idx: int,
+                              prev_term: int, entries: List[dict],
+                              commit_idx: int) -> dict:
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term, "ok": False}
+            if term > self.term or self.role != FOLLOWER:
+                self._step_down(term)
+            self.leader_id = leader
+            self._last_heard = time.monotonic()
+            # log matching: the entry before the new ones must agree
+            if prev_idx > len(self.log) or (
+                    prev_idx > 0 and
+                    self.log[prev_idx - 1]["term"] != prev_term):
+                return {"term": self.term, "ok": False,
+                        "have": min(len(self.log), prev_idx)}
+            if entries:
+                # drop conflicting suffix, append the leader's entries
+                self.log = self.log[:prev_idx] + list(entries)
+                self._persist_locked()
+            if commit_idx > self.commit_idx:
+                self.commit_idx = min(commit_idx, len(self.log))
+                self._apply_locked()
+            return {"term": self.term, "ok": True}
+
+    # ---- replication ----
+    def _broadcast_heartbeat(self) -> None:
+        self._replicate(block=False)
+
+    def _replicate(self, block: bool) -> bool:
+        """Push log tails to every follower; recompute commit_idx.
+        Returns True when a majority matches the leader's log."""
+        with self._lock:
+            if self.role != LEADER:
+                return False
+            term = self.term
+            total = len(self.log)
+        acked = 1
+        for pid in self.peer_ids:
+            tr = self.transports.get(pid)
+            if tr is None:
+                continue
+            for _ in range(8):   # walk next_idx back on mismatch
+                with self._lock:
+                    if self.role != LEADER or self.term != term:
+                        return False
+                    nxt = self.next_idx.get(pid, total)
+                    prev_idx = nxt
+                    prev_term = self.log[nxt - 1]["term"] if nxt else 0
+                    entries = self.log[nxt:total]
+                    commit = self.commit_idx
+                try:
+                    resp = tr.append_entries(
+                        term=term, leader=self.node_id, prev_idx=prev_idx,
+                        prev_term=prev_term, entries=entries,
+                        commit_idx=commit)
+                except Exception:
+                    break
+                with self._lock:
+                    if resp["term"] > self.term:
+                        self._step_down(resp["term"])
+                        return False
+                    if resp.get("ok"):
+                        self.next_idx[pid] = total
+                        acked += 1
+                        break
+                    self.next_idx[pid] = min(
+                        resp.get("have", max(nxt - 1, 0)), total)
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        with self._lock:
+            if self.role != LEADER or self.term != term:
+                return False
+            # only an index whose entry is from the current term may
+            # advance the commit point (raft §5.4.2); the election no-op
+            # guarantees such an entry exists promptly
+            if acked >= quorum and total > self.commit_idx and total > 0 \
+                    and self.log[total - 1]["term"] == self.term:
+                self.commit_idx = total
+                self._apply_locked()
+            return acked >= quorum
+
+    # ---- state machine ----
+    def _apply_locked(self) -> None:
+        while self.applied_idx < self.commit_idx:
+            entry = self.log[self.applied_idx]
+            entry["result"] = self._apply_op(entry["op"])
+            self.applied_idx += 1
+        self._applied.notify_all()
+
+    def _apply_op(self, op: dict):
+        kind = op["kind"]
+        key = op.get("key")
+        if kind == "put":
+            self.state[key] = op["value"].encode()
+            return True
+        if kind == "delete":
+            return self.state.pop(key, None) is not None
+        if kind == "cap":                      # compare_and_put
+            expect = op["expect"].encode() if op["expect"] is not None \
+                else None
+            if self.state.get(key) != expect:
+                return False
+            self.state[key] = op["value"].encode()
+            return True
+        if kind == "cad":                      # compare_and_delete
+            if self.state.get(key) != op["expect"].encode():
+                return False
+            del self.state[key]
+            return True
+        if kind == "incr":
+            cur = int(self.state.get(key, str(op["start"]).encode()))
+            nxt = cur + 1
+            self.state[key] = str(nxt).encode()
+            return nxt
+        if kind == "noop":
+            return None
+        raise GreptimeError(f"unknown raft op {kind!r}")
+
+    # ---- client entry ----
+    def propose(self, op: dict):
+        """Append on the leader, replicate to a majority, apply, return
+        the op result. Raises NotLeaderError elsewhere."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_id)
+            self.log.append({"term": self.term, "op": op})
+            idx = len(self.log)
+            self._persist_locked()
+        if not self._replicate(block=True):
+            with self._lock:
+                raise NotLeaderError(self.leader_id
+                                     if self.leader_id != self.node_id
+                                     else None)
+        with self._lock:
+            deadline = time.monotonic() + 10
+            while self.applied_idx < idx:
+                if not self._applied.wait(timeout=deadline -
+                                          time.monotonic()):
+                    raise GreptimeError("raft apply timeout")
+            return self.log[idx - 1].get("result")
+
+    def read_state(self) -> Dict[str, bytes]:
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_id)
+            return dict(self.state)
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == LEADER
+
+
+class LocalTransport:
+    """Direct in-process transport (the MemKv of transports)."""
+
+    def __init__(self, node: RaftNode):
+        self.node = node
+
+    def request_vote(self, **kw) -> dict:
+        return self.node.handle_request_vote(**kw)
+
+    def append_entries(self, **kw) -> dict:
+        return self.node.handle_append_entries(**kw)
+
+
+def connect_local(nodes: List[RaftNode]) -> None:
+    for a in nodes:
+        for b in nodes:
+            if a is not b:
+                a.transports[b.node_id] = LocalTransport(b)
+
+
+class FlightTransport:
+    """Raft RPCs over the meta Flight plane (meta/flight.py actions
+    raft_request_vote / raft_append_entries) for multi-process meta."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._client = None
+
+    def _action(self, kind: str, body: dict) -> dict:
+        import json as _json
+
+        import pyarrow.flight as flight
+        if self._client is None:
+            self._client = flight.FlightClient(self.address)
+        results = list(self._client.do_action(
+            flight.Action(kind, _json.dumps(body).encode())))
+        resp = _json.loads(results[0].body.to_pybytes())
+        if not resp.get("ok", False):
+            raise GreptimeError(resp.get("error", "meta raft rpc failed"))
+        return resp
+
+    def request_vote(self, **kw) -> dict:
+        return self._action("raft_request_vote", kw)
+
+    def append_entries(self, **kw) -> dict:
+        return self._action("raft_append_entries", kw)
+
+
+class HaMetaClient:
+    """MetaClient facade over several replicated MetaSrv instances:
+    every call retries across servers until it lands on the leader
+    (reference clients iterate etcd endpoints the same way)."""
+
+    def __init__(self, srvs, *, retry_delay: float = 0.15,
+                 max_rounds: int = 40):
+        from .service import MetaClient
+        self.clients = [MetaClient(s) for s in srvs]
+        self._cur = 0
+        self._delay = retry_delay
+        self._rounds = max_rounds
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            last: Optional[Exception] = None
+            for _ in range(self._rounds):
+                client = self.clients[self._cur % len(self.clients)]
+                try:
+                    return getattr(client, name)(*args, **kwargs)
+                except NotLeaderError as e:
+                    last = e
+                    self._cur += 1
+                    time.sleep(self._delay)
+            raise last if last is not None else GreptimeError(
+                "no meta leader reachable")
+        return call
+
+
+class ReplicatedKv:
+    """MemKv-interface facade over a RaftNode, so MetaSrv mounts a
+    replicated store exactly like MemKv/FileKv (meta/kv.py)."""
+
+    def __init__(self, node: RaftNode):
+        self.node = node
+
+    # reads (leader-local, linearizable after majority-committed writes)
+    def get(self, key: str) -> Optional[bytes]:
+        return self.node.read_state().get(key)
+
+    def range(self, prefix: str) -> List[Tuple[str, bytes]]:
+        state = self.node.read_state()
+        return sorted((k, v) for k, v in state.items()
+                      if k.startswith(prefix))
+
+    # writes (consensus round-trips)
+    def put(self, key: str, value: bytes) -> None:
+        self.node.propose({"kind": "put", "key": key,
+                           "value": value.decode()})
+
+    def delete(self, key: str) -> bool:
+        return bool(self.node.propose({"kind": "delete", "key": key}))
+
+    def compare_and_put(self, key: str, expect: Optional[bytes],
+                        value: bytes) -> bool:
+        return bool(self.node.propose({
+            "kind": "cap", "key": key,
+            "expect": expect.decode() if expect is not None else None,
+            "value": value.decode()}))
+
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        return bool(self.node.propose({
+            "kind": "cad", "key": key, "expect": expect.decode()}))
+
+    def incr(self, key: str, start: int = 0) -> int:
+        return int(self.node.propose({"kind": "incr", "key": key,
+                                      "start": start}))
