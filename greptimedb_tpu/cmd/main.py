@@ -150,6 +150,14 @@ def _block_until_signal(on_shutdown) -> None:
     on_shutdown()
 
 
+def _meta_client(addr_arg: str):
+    """--metasrv-addr accepts a comma-separated replica list; the
+    failover client walks it until a leader answers."""
+    from ..meta.flight import FailoverFlightMetaClient
+    addrs = [a.strip() for a in addr_arg.split(",") if a.strip()]
+    return FailoverFlightMetaClient([f"grpc://{a}" for a in addrs])
+
+
 def metasrv_start(args) -> None:
     """Run the metadata server role (reference: greptime metasrv start;
     etcd is replaced by a file-backed KV snapshot)."""
@@ -161,15 +169,21 @@ def metasrv_start(args) -> None:
     init_logging(args.log_level or "info")
     raft_node = None
     if args.peers:
-        # replicated meta: this node + --peers form a raft group; routes
-        # survive a metasrv loss (reference: etcd cluster,
-        # store/etcd.rs:762). --node-id indexes into the sorted peer
-        # set; transports ride the same Flight plane.
+        # replicated meta: --peers is the FULL replica set (including
+        # this node) and must be IDENTICAL on every node — raft ids come
+        # from its sorted order, so a divergent list (extra/missing
+        # entry, different host spelling) would misattribute votes.
+        # Routes survive a metasrv loss (reference: etcd cluster,
+        # store/etcd.rs:762); transports ride the same Flight plane.
         from ..meta.replication import (
             FlightTransport, RaftNode, ReplicatedKv)
-        peer_addrs = dict(
-            enumerate(sorted(set(args.peers.split(",")) |
-                             {args.bind_addr}), start=1))
+        peers = sorted({a.strip() for a in args.peers.split(",")
+                        if a.strip()})
+        if args.bind_addr not in peers:
+            raise SystemExit(
+                f"--peers must list every replica including this node's "
+                f"--bind-addr {args.bind_addr!r} verbatim; got {peers}")
+        peer_addrs = dict(enumerate(peers, start=1))
         my_id = next(i for i, a in peer_addrs.items()
                      if a == args.bind_addr)
         raft_node = RaftNode(
@@ -252,7 +266,7 @@ def datanode_start(args) -> None:
     dn.start()
     server = FlightDatanodeServer(dn, f"grpc://{args.rpc_addr}")
     server.serve_in_background()
-    meta = FlightMetaClient(f"grpc://{args.metasrv_addr}")
+    meta = _meta_client(args.metasrv_addr)
     meta.register(Peer(args.node_id, server.address))
     dn.start_heartbeat(meta, interval_s=args.heartbeat_interval)
     logging.info("datanode %d ready on %s (meta %s)", args.node_id,
@@ -278,7 +292,7 @@ def frontend_start(args) -> None:
     from ..servers.auth import NoopUserProvider
 
     init_logging(args.log_level or "info")
-    meta = FlightMetaClient(f"grpc://{args.metasrv_addr}")
+    meta = _meta_client(args.metasrv_addr)
     clients = PeerClientRegistry(meta)
     fe = DistInstance(meta, clients)
     servers = [HttpServer(fe, NoopUserProvider(), args.http_addr)]

@@ -130,6 +130,9 @@ class FlightMetaClient:
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
         if not resp.get("ok", False):
+            if resp.get("error_type") == "NotLeaderError":
+                from .replication import NotLeaderError
+                raise NotLeaderError(None)
             raise GreptimeError(resp.get("error", "meta error"))
         return resp
 
@@ -214,3 +217,54 @@ class PeerClientRegistry(dict):
             return self[node_id]
         except KeyError:
             return default
+
+
+class FailoverFlightMetaClient:
+    """MetaClient surface over a metasrv replica set: every call walks
+    the address list until one answers as the leader (reference clients
+    iterate etcd endpoints the same way). Accepts one address too, so
+    callers can always construct it from --metasrv-addr."""
+
+    def __init__(self, addresses: List[str], *, retry_delay: float = 0.2,
+                 max_rounds: int = 25):
+        self.clients = [FlightMetaClient(a) for a in addresses]
+        self._cur = 0
+        self._delay = retry_delay
+        self._rounds = max_rounds
+
+    @property
+    def address(self) -> str:
+        return self.clients[self._cur % len(self.clients)].address
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            from .replication import NotLeaderError
+            import time as _time
+            last: Optional[Exception] = None
+            for attempt in range(self._rounds * len(self.clients)):
+                client = self.clients[self._cur % len(self.clients)]
+                try:
+                    return getattr(client, name)(*args, **kwargs)
+                except (NotLeaderError, ConnectionError) as e:
+                    last = e
+                except GreptimeError as e:
+                    # unreachable replica (connection refused rides in as
+                    # a generic flight error) — try the next one; real
+                    # application errors don't mention leadership
+                    if "refused" not in str(e).lower() \
+                            and "unavailable" not in str(e).lower():
+                        raise
+                    last = e
+                self._cur += 1
+                if (attempt + 1) % len(self.clients) == 0:
+                    _time.sleep(self._delay)
+            raise last if last is not None else GreptimeError(
+                "no metasrv replica reachable")
+        return call

@@ -48,6 +48,15 @@ class NotLeaderError(GreptimeError):
         self.leader_id = leader_id
 
 
+class ProposeUncertainError(GreptimeError):
+    """Commit could not be confirmed before the deadline. The entry may
+    still commit later; retrying a non-idempotent op can double-apply."""
+
+    def __init__(self):
+        super().__init__("meta propose result unknown (no quorum ack "
+                         "within the deadline); retry only idempotent ops")
+
+
 class RaftNode:
     """One meta replica: consensus state + the applied KV dict."""
 
@@ -223,9 +232,23 @@ class RaftNode:
                 return {"term": self.term, "ok": False,
                         "have": min(len(self.log), prev_idx)}
             if entries:
-                # drop conflicting suffix, append the leader's entries
-                self.log = self.log[:prev_idx] + list(entries)
-                self._persist_locked()
+                # truncate only from the first genuinely conflicting
+                # entry (term mismatch): a delayed, shorter AppendEntries
+                # must not erase newer entries a later RPC already
+                # appended (raft §5.3 — committed suffixes survive)
+                changed = False
+                for i, ent in enumerate(entries):
+                    idx = prev_idx + i
+                    if idx >= len(self.log):
+                        self.log.extend(entries[i:])
+                        changed = True
+                        break
+                    if self.log[idx]["term"] != ent["term"]:
+                        self.log = self.log[:idx] + list(entries[i:])
+                        changed = True
+                        break
+                if changed:
+                    self._persist_locked()
             if commit_idx > self.commit_idx:
                 self.commit_idx = min(commit_idx, len(self.log))
                 self._apply_locked()
@@ -233,9 +256,9 @@ class RaftNode:
 
     # ---- replication ----
     def _broadcast_heartbeat(self) -> None:
-        self._replicate(block=False)
+        self._replicate()
 
-    def _replicate(self, block: bool) -> bool:
+    def _replicate(self) -> bool:
         """Push log tails to every follower; recompute commit_idx.
         Returns True when a majority matches the leader's log."""
         with self._lock:
@@ -325,33 +348,57 @@ class RaftNode:
         raise GreptimeError(f"unknown raft op {kind!r}")
 
     # ---- client entry ----
-    def propose(self, op: dict):
+    def propose(self, op: dict, timeout: float = 10.0):
         """Append on the leader, replicate to a majority, apply, return
-        the op result. Raises NotLeaderError elsewhere."""
+        the op result. Raises NotLeaderError elsewhere, and
+        ProposeUncertainError when commit cannot be confirmed in time —
+        the entry may still commit later, so blind retries of
+        non-idempotent ops (CAS, incr) are not safe on that error."""
         with self._lock:
             if self.role != LEADER:
                 raise NotLeaderError(self.leader_id)
-            self.log.append({"term": self.term, "op": op})
+            entry = {"term": self.term, "op": op}
+            self.log.append(entry)
             idx = len(self.log)
             self._persist_locked()
-        if not self._replicate(block=True):
-            with self._lock:
-                raise NotLeaderError(self.leader_id
-                                     if self.leader_id != self.node_id
-                                     else None)
+        self._replicate()   # best effort; heartbeats keep pushing
         with self._lock:
-            deadline = time.monotonic() + 10
-            while self.applied_idx < idx:
-                if not self._applied.wait(timeout=deadline -
-                                          time.monotonic()):
-                    raise GreptimeError("raft apply timeout")
-            return self.log[idx - 1].get("result")
+            deadline = time.monotonic() + timeout
+            while True:
+                lost = idx > len(self.log) or self.log[idx - 1] is not entry
+                if lost:
+                    # a new leader overwrote the uncommitted entry
+                    raise NotLeaderError(self.leader_id
+                                         if self.leader_id != self.node_id
+                                         else None)
+                if self.applied_idx >= idx:
+                    return entry.get("result")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._applied.wait(
+                        timeout=min(remaining, self._hb_every)):
+                    if time.monotonic() >= deadline:
+                        raise ProposeUncertainError()
 
     def read_state(self) -> Dict[str, bytes]:
         with self._lock:
             if self.role != LEADER:
                 raise NotLeaderError(self.leader_id)
             return dict(self.state)
+
+    def get_value(self, key: str) -> Optional[bytes]:
+        """Single-key leader read without copying the state dict."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_id)
+            return self.state.get(key)
+
+    def range_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        """Prefix scan on the leader, materializing only the matches."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_id)
+            return sorted((k, v) for k, v in self.state.items()
+                          if k.startswith(prefix))
 
     @property
     def is_leader(self) -> bool:
@@ -448,12 +495,10 @@ class ReplicatedKv:
 
     # reads (leader-local, linearizable after majority-committed writes)
     def get(self, key: str) -> Optional[bytes]:
-        return self.node.read_state().get(key)
+        return self.node.get_value(key)
 
     def range(self, prefix: str) -> List[Tuple[str, bytes]]:
-        state = self.node.read_state()
-        return sorted((k, v) for k, v in state.items()
-                      if k.startswith(prefix))
+        return self.node.range_prefix(prefix)
 
     # writes (consensus round-trips)
     def put(self, key: str, value: bytes) -> None:

@@ -45,7 +45,13 @@ def expr_name(e: Expr) -> str:
         inner = ", ".join(expr_name(a) for a in e.args)
         if e.distinct:
             inner = "DISTINCT " + inner
-        return f"{e.name}({inner})"
+        base = f"{e.name}({inner})"
+        if e.over is not None:
+            # distinct OVER specs are distinct expressions: the window
+            # rewriter dedups by this name, and projections of two
+            # windows of the same function must not collide
+            return f"{base} OVER ({e.over})"
+        return base
     if isinstance(e, Literal):
         return str(e)
     if isinstance(e, BinaryOp):

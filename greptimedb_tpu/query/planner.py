@@ -294,7 +294,8 @@ def analyze(query: Query) -> Analysis:
     for e, asc in query.order_by:
         e = resolve_ref(e)
         a.order_by.append((rewrite_top(e)
-                           if (rw.calls or a.group_exprs or wrw.calls)
+                           if (rw.calls or a.group_exprs or wrw.calls
+                               or _contains_window(e))
                            else e, asc))
     a.agg_calls = rw.calls
     a.window_calls = wrw.calls

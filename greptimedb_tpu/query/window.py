@@ -317,7 +317,3 @@ def _window_extreme(op: str, ser: pd.Series, pid: pd.Series, lo, hi,
     comb = np.fmin(back, fwd) if op == "min" else np.fmax(back, fwd)
     out = np.where(empty, np.nan, comb)
     return pd.Series(out, index=index)
-
-
-_WHOLE = {"sum": "sum", "avg": "mean", "min": "min", "max": "max",
-          "count": "count", "stddev": "std", "variance": "var"}
