@@ -88,9 +88,18 @@ _AFFECTED_SCHEMA = pa.schema([("affected_rows", pa.int64())],
                              metadata={b"gdb.kind": b"affected_rows"})
 
 
-def _affected_stream(n: int) -> flight.GeneratorStream:
+def _affected_stream(n: int,
+                     proto_metadata: bool = False) -> flight.GeneratorStream:
     batch = pa.RecordBatch.from_arrays([pa.array([n], pa.int64())],
                                        schema=_AFFECTED_SCHEMA)
+    if proto_metadata:
+        # greptime-proto clients read the row count from
+        # FlightData.app_metadata (FlightMetadata{affected_rows},
+        # reference common/grpc/src/flight.rs:84-120)
+        from ..api.v1 import encode_affected_rows_metadata
+        meta = pa.py_buffer(encode_affected_rows_metadata(n))
+        return flight.GeneratorStream(_AFFECTED_SCHEMA,
+                                      iter([(batch, meta)]))
     return flight.GeneratorStream(_AFFECTED_SCHEMA, iter([batch]))
 
 
@@ -217,7 +226,15 @@ class FlightFrontendServer(flight.FlightServerBase):
         return t
 
     def do_get(self, context, ticket):
-        cmd = json.loads(ticket.ticket)
+        raw = ticket.ticket
+        try:
+            cmd = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            # greptime-proto plane: reference SDKs serialize a
+            # GreptimeRequest protobuf into the ticket
+            # (src/client/src/database.rs:209-231, decoded by the
+            # server at src/servers/src/grpc/flight.rs:87-96)
+            return self._do_get_proto(raw)
         if cmd.get("type") != "sql":
             raise GreptimeError(f"unsupported ticket {cmd.get('type')!r}")
         outputs = self.frontend.do_query(cmd["sql"])
@@ -225,6 +242,39 @@ class FlightFrontendServer(flight.FlightServerBase):
         if last.is_batches:
             return _batches_stream(last.batches)
         return _affected_stream(last.affected_rows or 0)
+
+    def _do_get_proto(self, raw: bytes):
+        from ..api import v1 as proto
+        req = proto.decode_greptime_request(bytes(raw))
+        if req.query is not None and req.query.sql is not None:
+            outputs = self.frontend.do_query(req.query.sql)
+            last = outputs[-1]
+            if last.is_batches:
+                return _batches_stream(last.batches)
+            return _affected_stream(last.affected_rows or 0,
+                                    proto_metadata=True)
+        if req.insert is not None:
+            n = self._apply_proto_insert(req.insert)
+            return _affected_stream(n, proto_metadata=True)
+        what = req.other or "empty"
+        raise GreptimeError(
+            f"unsupported GreptimeRequest variant {what!r} on do_get "
+            "(use SQL DDL over the query plane)")
+
+    def _apply_proto_insert(self, ins) -> int:
+        from ..api.v1 import SemanticType
+        columns = {}
+        tag_columns = []
+        timestamp_column = "greptime_timestamp"
+        for c in ins.columns:
+            columns[c.column_name] = c.rows(ins.row_count)
+            if c.semantic_type == SemanticType.TAG:
+                tag_columns.append(c.column_name)
+            elif c.semantic_type == SemanticType.TIMESTAMP:
+                timestamp_column = c.column_name
+        return self.frontend.handle_row_insert(
+            ins.table_name, columns, tag_columns=tag_columns,
+            timestamp_column=timestamp_column)
 
     def do_put(self, context, descriptor, reader, writer):
         cmd = json.loads(descriptor.command)
