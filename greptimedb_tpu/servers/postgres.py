@@ -43,6 +43,37 @@ def _pg_oid(dtype) -> int:
     return OID_INT8
 
 
+#: microseconds between the PG epoch (2000-01-01) and the Unix epoch
+_PG_EPOCH_US = 946_684_800_000_000
+
+
+def _decode_binary_param(raw: bytes, oid: int) -> str:
+    """Binary-format Bind parameter → the text form the $N substitution
+    consumes (reference pgwire accepts both formats, handler.rs:648).
+    Decoding keys off the Parse-declared OID; length disambiguates when
+    the driver declared none."""
+    n = len(raw)
+    if oid in (21, 23, 20) or (oid == 0 and n in (2, 4, 8)):  # int2/4/8
+        return str(int.from_bytes(raw, "big", signed=True))
+    if oid == 700 and n == 4:                                  # float4
+        return repr(struct.unpack("!f", raw)[0])
+    if oid == 701 and n == 8:                                  # float8
+        return repr(struct.unpack("!d", raw)[0])
+    if oid == OID_BOOL and n == 1:
+        return "true" if raw[0] else "false"
+    if oid in (1114, 1184) and n == 8:       # timestamp[tz]: µs since 2000
+        us = int.from_bytes(raw, "big", signed=True) + _PG_EPOCH_US
+        import datetime as _dt
+        dt = _dt.datetime.fromtimestamp(us / 1e6, _dt.timezone.utc)
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
+    if oid == 1082 and n == 4:               # date: days since 2000-01-01
+        days = int.from_bytes(raw, "big", signed=True)
+        import datetime as _dt
+        return str(_dt.date(2000, 1, 1) + _dt.timedelta(days=days))
+    # text/varchar/unknown: binary representation is the utf8 bytes
+    return raw.decode("utf-8", errors="replace")
+
+
 def _pg_text(v, dtype) -> Optional[bytes]:
     if v is None:
         return None
@@ -123,6 +154,7 @@ class _PgConnection:
         self.conn_id = conn_id
         self.ctx = QueryContext(channel=Channel.POSTGRES)
         self.stmts: Dict[str, str] = {}       # name -> sql with $N params
+        self.stmt_param_oids: Dict[str, List[int]] = {}
         self.portals: Dict[str, _PgPortal] = {}
         # v3 protocol: after an error in the extended protocol, discard
         # messages until Sync (a pipelined Execute after a failed Bind must
@@ -297,7 +329,20 @@ class _PgConnection:
         name = body[:end].decode()
         end2 = body.index(b"\x00", end + 1)
         sql = body[end + 1:end2].decode()
+        # optional parameter-type OIDs: binary Bind values decode by them
+        # (reference pgwire accepts both formats, handler.rs:648)
+        pos = end2 + 1
+        oids: List[int] = []
+        if pos + 2 <= len(body):
+            (noids,) = struct.unpack_from("!H", body, pos)
+            pos += 2
+            for _ in range(noids):
+                if pos + 4 > len(body):
+                    break
+                oids.append(struct.unpack_from("!I", body, pos)[0])
+                pos += 4
         self.stmts[name] = sql
+        self.stmt_param_oids[name] = oids
         self.io.send(b"1")                              # ParseComplete
 
     def handle_bind(self, body: bytes) -> None:
@@ -307,23 +352,34 @@ class _PgConnection:
         stmt_name = body[pos + 1:end].decode()
         pos = end + 1
         nfmt = struct.unpack_from("!H", body, pos)[0]
-        pos += 2 + 2 * nfmt
+        pos += 2
+        fmts = list(struct.unpack_from(f"!{nfmt}H", body, pos)) \
+            if nfmt else []
+        pos += 2 * nfmt
         nparams = struct.unpack_from("!H", body, pos)[0]
         pos += 2
-        params: List[Optional[str]] = []
-        for _ in range(nparams):
-            plen = struct.unpack_from("!i", body, pos)[0]
-            pos += 4
-            if plen == -1:
-                params.append(None)
-            else:
-                params.append(body[pos:pos + plen].decode())
-                pos += plen
         sql = self.stmts.get(stmt_name)
         if sql is None:
             self.ext_error(
                 f"prepared statement {stmt_name!r} does not exist", "26000")
             return
+        oids = self.stmt_param_oids.get(stmt_name, [])
+        params: List[Optional[str]] = []
+        for i in range(nparams):
+            plen = struct.unpack_from("!i", body, pos)[0]
+            pos += 4
+            if plen == -1:
+                params.append(None)
+                continue
+            raw = body[pos:pos + plen]
+            pos += plen
+            # per-protocol: 0 codes = all text, 1 code = applies to all
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
+            if fmt == 1:
+                oid = oids[i] if i < len(oids) else 0
+                params.append(_decode_binary_param(raw, oid))
+            else:
+                params.append(raw.decode())
         self.portals[portal] = _PgPortal(_substitute_pg_params(sql, params))
         self.io.send(b"2")                              # BindComplete
 

@@ -171,6 +171,45 @@ class MiniPgClient:
             return names, rows
         return tag_str
 
+    def extended_query_binary(self, sql, params, oids):
+        """Extended flow with ALL parameters in binary format, declaring
+        per-parameter type OIDs in Parse (JDBC/psycopg3 style)."""
+        parse = b"\x00" + sql.encode() + b"\x00" \
+            + struct.pack("!H", len(oids))
+        for oid in oids:
+            parse += struct.pack("!I", oid)
+        self._send(b"P", parse)
+        bind = b"\x00\x00" + struct.pack("!H", 1) + struct.pack("!H", 1)
+        bind += struct.pack("!H", len(params))
+        for raw in params:
+            if raw is None:
+                bind += struct.pack("!i", -1)
+            else:
+                bind += struct.pack("!i", len(raw)) + raw
+        bind += struct.pack("!H", 0)
+        self._send(b"B", bind)
+        self._send(b"D", b"P\x00")
+        self._send(b"E", b"\x00" + struct.pack("!I", 0))
+        self._send(b"S")
+        names, rows, tag_str = None, [], None
+        while True:
+            tag, payload = self._read_message()
+            if tag == "T":
+                names = self._parse_row_description(payload)
+            elif tag == "D":
+                rows.append(self._parse_data_row(payload))
+            elif tag == "C":
+                tag_str = payload.rstrip(b"\x00").decode()
+            elif tag == "E":
+                err = self._error_message(payload)
+                self._sync_to_ready()
+                raise RuntimeError(err)
+            elif tag == "Z":
+                break
+        if names is not None:
+            return names, rows
+        return tag_str
+
     def close(self):
         try:
             self._send(b"X")
@@ -315,6 +354,29 @@ class TestPostgresProtocol:
         tags = self._collect_until_ready(c)
         got = sorted(r[0] for r in map(c._parse_data_row, tags.get("D", [])))
         assert got == ["1.0", "2.0"], got
+
+    def test_binary_format_parameters(self, client):
+        """JDBC/psycopg3 send binary params with OIDs declared in Parse
+        (reference pgwire handles both formats, handler.rs:648)."""
+        import struct as st
+        client.query("CREATE TABLE binp (host STRING, ts TIMESTAMP TIME"
+                     " INDEX, v DOUBLE, n BIGINT, ok BOOLEAN,"
+                     " PRIMARY KEY(host))")
+        tag = client.extended_query_binary(
+            "INSERT INTO binp VALUES ($1, $2, $3, $4, $5)",
+            [b"h1", st.pack("!q", 5000), st.pack("!d", 2.75),
+             st.pack("!q", -12), b"\x01"],
+            oids=[25, 20, 701, 20, 16])
+        assert tag == "INSERT 0 1"
+        names, rows = client.query(
+            "SELECT host, ts, v, n, ok FROM binp")
+        assert rows[0][0] == "h1"
+        assert rows[0][2] == "2.75" and rows[0][3] == "-12"
+        # int4 binary param in a predicate
+        names, rows = client.extended_query_binary(
+            "SELECT count(*) FROM binp WHERE n = $1",
+            [st.pack("!i", -12)], oids=[23])
+        assert rows == [["1"]]
 
     def test_bind_unknown_statement_errors(self, client):
         c = client
