@@ -115,9 +115,11 @@ def build_servers(opts: StandaloneOptions):
 
 def standalone_start(args) -> None:
     opts = load_options(args)
+    from ..common.jax_cache import enable_compile_cache
     from ..common.telemetry import init_logging, install_panic_hook
     init_logging(opts.log_level, opts.log_dir)
     install_panic_hook()
+    enable_compile_cache(opts.data_home)
     fe, servers = build_servers(opts)
     for s in servers:
         s.start()
@@ -253,6 +255,7 @@ def metasrv_start(args) -> None:
 def datanode_start(args) -> None:
     """Run a region-hosting worker: Flight data plane + meta heartbeats
     (reference: greptime datanode start)."""
+    from ..common.jax_cache import enable_compile_cache
     from ..common.telemetry import init_logging
     from ..datanode import DatanodeInstance, DatanodeOptions
     from ..meta import Peer
@@ -260,6 +263,7 @@ def datanode_start(args) -> None:
     from ..servers.flight import FlightDatanodeServer
 
     init_logging(args.log_level or "info")
+    enable_compile_cache(args.data_home or "./greptimedb_data")
     dn = DatanodeInstance(DatanodeOptions(
         data_home=args.data_home or "./greptimedb_data",
         node_id=args.node_id, register_numbers_table=False))

@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache for server processes.
+
+First jit compile of a kernel family costs ~10-40 s on TPU; a restarted
+server (or a fresh maintenance-job process) pays it again. JAX ships a
+persistent on-disk cache — this enables it under the node's data_home so
+restarts and short-lived jobs reuse compiled executables. The reference
+has no analogue (no JIT), so this is a TPU-first operational concern:
+cold-start latency is compile-bound, not IO-bound.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def enable_compile_cache(data_home: str) -> bool:
+    """Best-effort: point JAX's persistent compilation cache under
+    data_home. Safe to call before or after backend init; failures are
+    logged and ignored (the cache is an optimization, never required)."""
+    try:
+        import jax
+        cache_dir = os.path.join(data_home, "xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that took XLA real work; tiny kernels skip
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return True
+    except Exception as e:  # noqa: BLE001 — optional accelerator feature
+        logger.debug("compile cache unavailable: %s", e)
+        return False
