@@ -1,0 +1,9 @@
+CREATE TABLE dist_win (host STRING, n BIGINT, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host, n)) PARTITION BY RANGE COLUMNS (n) (PARTITION w0 VALUES LESS THAN (10), PARTITION w1 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO dist_win VALUES ('a', 1, 1000, 5.0), ('a', 15, 2000, 3.0), ('b', 2, 3000, 8.0), ('b', 20, 4000, 1.0);
+
+SELECT host, ts, v, row_number() OVER (PARTITION BY host ORDER BY ts) AS rn, sum(v) OVER (PARTITION BY host ORDER BY ts) AS cs FROM dist_win ORDER BY host, ts;
+
+SELECT host, sum(v) AS total, rank() OVER (ORDER BY sum(v) DESC) AS rk FROM dist_win GROUP BY host ORDER BY host;
+
+DROP TABLE dist_win;
