@@ -16,9 +16,9 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from .v1 import (
-    Column, ColumnDataType, GreptimeRequest, InsertRequest, QueryRequest,
-    SemanticType, decode_flight_metadata_affected_rows,
-    encode_greptime_request)
+    Column, ColumnDataType, ColumnDef, CreateTableExpr, DdlRequest,
+    GreptimeRequest, InsertRequest, QueryRequest, SemanticType,
+    decode_flight_metadata_affected_rows, encode_greptime_request)
 
 
 def _infer_datatype(values: Sequence) -> int:
@@ -80,6 +80,27 @@ class GreptimeDatabase:
                 affected = int(table.column(0)[0].as_py())
             table = None
         return table, affected
+
+    def create(self, table_name: str,
+               columns: Sequence[Tuple[str, int]], *,
+               time_index: str, primary_keys: Sequence[str] = (),
+               if_not_exists: bool = True) -> None:
+        """DDL over the proto plane (reference Database::create).
+        columns: (name, ColumnDataType) pairs."""
+        expr = CreateTableExpr(
+            table_name=table_name,
+            column_defs=[ColumnDef(n, dt, is_nullable=(n != time_index))
+                         for n, dt in columns],
+            time_index=time_index, primary_keys=list(primary_keys),
+            create_if_not_exists=if_not_exists)
+        reader = self._do_get(GreptimeRequest(
+            ddl=DdlRequest(create_table=expr)))
+        reader.read_all()
+
+    def drop_table(self, table_name: str) -> None:
+        reader = self._do_get(GreptimeRequest(ddl=DdlRequest(
+            drop_table=(self.catalog, self.schema, table_name))))
+        reader.read_all()
 
     def insert(self, table_name: str, columns: Dict[str, Sequence], *,
                tag_columns: Sequence[str] = (),

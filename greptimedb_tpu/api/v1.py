@@ -151,13 +151,51 @@ class QueryRequest:
 
 
 @dataclass
+class ColumnDef:
+    """greptime-proto ColumnDef { string name = 1;
+    ColumnDataType datatype = 2; bool is_nullable = 3;
+    bytes default_constraint = 4; }"""
+    name: str
+    datatype: int
+    is_nullable: bool = True
+
+
+@dataclass
+class CreateTableExpr:
+    """CreateTableExpr { catalog_name = 1; schema_name = 2;
+    table_name = 3; desc = 4; repeated ColumnDef column_defs = 5;
+    string time_index = 6; repeated string primary_keys = 7;
+    bool create_if_not_exists = 8; map table_options = 9;
+    TableId table_id = 10; repeated uint32 region_ids = 11;
+    string engine = 12; }"""
+    table_name: str
+    column_defs: List[ColumnDef] = field(default_factory=list)
+    time_index: str = ""
+    primary_keys: List[str] = field(default_factory=list)
+    create_if_not_exists: bool = False
+    catalog_name: str = ""
+    schema_name: str = ""
+
+
+@dataclass
+class DdlRequest:
+    """DdlRequest oneof: create_database = 1; create_table = 2;
+    alter = 3; drop_table = 4; flush_table = 5."""
+    create_table: Optional[CreateTableExpr] = None
+    drop_table: Optional[Tuple[str, str, str]] = None   # catalog,schema,table
+    create_database: Optional[str] = None
+    other: Optional[str] = None
+
+
+@dataclass
 class GreptimeRequest:
     catalog: str = ""
     schema: str = ""
     dbname: str = ""
     insert: Optional[InsertRequest] = None
     query: Optional[QueryRequest] = None
-    other: Optional[str] = None      # "ddl" / "delete" (decoded as stubs)
+    ddl: Optional[DdlRequest] = None
+    other: Optional[str] = None      # "delete" (decoded as a stub)
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +254,50 @@ def encode_insert(req: InsertRequest) -> bytes:
     return out
 
 
+def encode_column_def(cd: ColumnDef) -> bytes:
+    out = field_bytes(1, cd.name.encode())
+    if cd.datatype:
+        out += field_varint(2, cd.datatype)
+    if cd.is_nullable:
+        out += field_varint(3, 1)
+    return out
+
+
+def encode_create_table(ct: CreateTableExpr) -> bytes:
+    out = b""
+    if ct.catalog_name:
+        out += field_bytes(1, ct.catalog_name.encode())
+    if ct.schema_name:
+        out += field_bytes(2, ct.schema_name.encode())
+    out += field_bytes(3, ct.table_name.encode())
+    for cd in ct.column_defs:
+        out += field_bytes(5, encode_column_def(cd))
+    if ct.time_index:
+        out += field_bytes(6, ct.time_index.encode())
+    for pk in ct.primary_keys:
+        out += field_bytes(7, pk.encode())
+    if ct.create_if_not_exists:
+        out += field_varint(8, 1)
+    return out
+
+
+def encode_ddl(ddl: DdlRequest) -> bytes:
+    if ddl.create_table is not None:
+        return field_bytes(2, encode_create_table(ddl.create_table))
+    if ddl.drop_table is not None:
+        cat, sch, tbl = ddl.drop_table
+        body = b""
+        if cat:
+            body += field_bytes(1, cat.encode())
+        if sch:
+            body += field_bytes(2, sch.encode())
+        body += field_bytes(3, tbl.encode())
+        return field_bytes(4, body)
+    if ddl.create_database is not None:
+        return field_bytes(1, field_bytes(1, ddl.create_database.encode()))
+    raise ValueError("empty DdlRequest")
+
+
 def encode_greptime_request(req: GreptimeRequest) -> bytes:
     header = b""
     if req.catalog:
@@ -229,6 +311,8 @@ def encode_greptime_request(req: GreptimeRequest) -> bytes:
         out += field_bytes(2, encode_insert(req.insert))
     elif req.query is not None and req.query.sql is not None:
         out += field_bytes(3, field_bytes(1, req.query.sql.encode()))
+    elif req.ddl is not None:
+        out += field_bytes(4, encode_ddl(req.ddl))
     return out
 
 
@@ -333,6 +417,65 @@ def decode_insert(data: bytes) -> InsertRequest:
     return req
 
 
+def decode_column_def(data: bytes) -> ColumnDef:
+    cd = ColumnDef(name="", datatype=ColumnDataType.FLOAT64,
+                   is_nullable=False)
+    for fnum, _, payload in iter_fields(memoryview(data)):
+        if fnum == 1:
+            cd.name = bytes(payload).decode()
+        elif fnum == 2:
+            cd.datatype = payload
+        elif fnum == 3:
+            cd.is_nullable = bool(payload)
+    return cd
+
+
+def decode_create_table(data: bytes) -> CreateTableExpr:
+    ct = CreateTableExpr(table_name="")
+    for fnum, _, payload in iter_fields(memoryview(data)):
+        if fnum == 1:
+            ct.catalog_name = bytes(payload).decode()
+        elif fnum == 2:
+            ct.schema_name = bytes(payload).decode()
+        elif fnum == 3:
+            ct.table_name = bytes(payload).decode()
+        elif fnum == 5:
+            ct.column_defs.append(decode_column_def(bytes(payload)))
+        elif fnum == 6:
+            ct.time_index = bytes(payload).decode()
+        elif fnum == 7:
+            ct.primary_keys.append(bytes(payload).decode())
+        elif fnum == 8:
+            ct.create_if_not_exists = bool(payload)
+    return ct
+
+
+def decode_ddl(data: bytes) -> DdlRequest:
+    ddl = DdlRequest()
+    for fnum, _, payload in iter_fields(memoryview(data)):
+        if fnum == 1:
+            for df, _, dp in iter_fields(memoryview(bytes(payload))):
+                if df == 1:
+                    ddl.create_database = bytes(dp).decode()
+        elif fnum == 2:
+            ddl.create_table = decode_create_table(bytes(payload))
+        elif fnum == 4:
+            cat = sch = tbl = ""
+            for df, _, dp in iter_fields(memoryview(bytes(payload))):
+                if df == 1:
+                    cat = bytes(dp).decode()
+                elif df == 2:
+                    sch = bytes(dp).decode()
+                elif df == 3:
+                    tbl = bytes(dp).decode()
+            ddl.drop_table = (cat, sch, tbl)
+        elif fnum == 3:
+            ddl.other = "alter"
+        elif fnum == 5:
+            ddl.other = "flush_table"
+    return ddl
+
+
 def decode_greptime_request(data: bytes) -> GreptimeRequest:
     req = GreptimeRequest()
     for fnum, wire, payload in iter_fields(memoryview(data)):
@@ -351,10 +494,54 @@ def decode_greptime_request(data: bytes) -> GreptimeRequest:
                 if qf == 1:
                     req.query = QueryRequest(sql=bytes(qp).decode())
         elif fnum == 4:
-            req.other = "ddl"
+            req.ddl = decode_ddl(bytes(payload))
         elif fnum == 5:
             req.other = "delete"
     return req
+
+
+#: ColumnDataType → SQL type name (the DDL translation the server runs)
+SQL_TYPE_NAMES = {
+    ColumnDataType.BOOLEAN: "BOOLEAN",
+    ColumnDataType.INT8: "TINYINT",
+    ColumnDataType.INT16: "SMALLINT",
+    ColumnDataType.INT32: "INT",
+    ColumnDataType.INT64: "BIGINT",
+    ColumnDataType.UINT8: "TINYINT UNSIGNED",
+    ColumnDataType.UINT16: "SMALLINT UNSIGNED",
+    ColumnDataType.UINT32: "INT UNSIGNED",
+    ColumnDataType.UINT64: "BIGINT UNSIGNED",
+    ColumnDataType.FLOAT32: "FLOAT",
+    ColumnDataType.FLOAT64: "DOUBLE",
+    ColumnDataType.BINARY: "BLOB",
+    ColumnDataType.STRING: "STRING",
+    ColumnDataType.DATE: "DATE",
+    ColumnDataType.DATETIME: "DATETIME",
+    ColumnDataType.TIMESTAMP_SECOND: "TIMESTAMP(0)",
+    ColumnDataType.TIMESTAMP_MILLISECOND: "TIMESTAMP(3)",
+    ColumnDataType.TIMESTAMP_MICROSECOND: "TIMESTAMP(6)",
+    ColumnDataType.TIMESTAMP_NANOSECOND: "TIMESTAMP(9)",
+}
+
+
+def create_table_to_sql(ct: CreateTableExpr) -> str:
+    """CreateTableExpr → CREATE TABLE statement (the server-side DDL
+    translation; reference grpc handlers build table requests directly,
+    src/common/grpc-expr/src/)."""
+    cols = []
+    for cd in ct.column_defs:
+        ty = SQL_TYPE_NAMES.get(cd.datatype, "DOUBLE")
+        null = "" if cd.is_nullable or cd.name == ct.time_index \
+            else " NOT NULL"
+        entry = f'"{cd.name}" {ty}{null}'
+        if cd.name == ct.time_index:
+            entry += " TIME INDEX"
+        cols.append(entry)
+    if ct.primary_keys:
+        keys = ", ".join(f'"{k}"' for k in ct.primary_keys)
+        cols.append(f"PRIMARY KEY({keys})")
+    ine = "IF NOT EXISTS " if ct.create_if_not_exists else ""
+    return f'CREATE TABLE {ine}"{ct.table_name}" ({", ".join(cols)})'
 
 
 def decode_flight_metadata_affected_rows(data: bytes) -> Optional[int]:

@@ -256,10 +256,27 @@ class FlightFrontendServer(flight.FlightServerBase):
         if req.insert is not None:
             n = self._apply_proto_insert(req.insert)
             return _affected_stream(n, proto_metadata=True)
+        if req.ddl is not None:
+            return self._apply_proto_ddl(req.ddl)
         what = req.other or "empty"
         raise GreptimeError(
             f"unsupported GreptimeRequest variant {what!r} on do_get "
             "(use SQL DDL over the query plane)")
+
+    def _apply_proto_ddl(self, ddl):
+        from ..api.v1 import create_table_to_sql
+        if ddl.create_table is not None:
+            sql = create_table_to_sql(ddl.create_table)
+        elif ddl.drop_table is not None:
+            sql = f'DROP TABLE "{ddl.drop_table[2]}"'
+        elif ddl.create_database is not None:
+            sql = f'CREATE DATABASE "{ddl.create_database}"'
+        else:
+            raise GreptimeError(
+                f"unsupported DdlRequest variant {ddl.other!r}")
+        outputs = self.frontend.do_query(sql)
+        return _affected_stream(outputs[-1].affected_rows or 0,
+                                proto_metadata=True)
 
     def _apply_proto_insert(self, ins) -> int:
         from ..api.v1 import SemanticType

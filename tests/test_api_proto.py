@@ -66,9 +66,12 @@ class TestCodec:
 
     def test_unknown_variant_flagged(self):
         from greptimedb_tpu.utils.protowire import field_bytes
-        data = field_bytes(4, b"")     # DdlRequest stub
+        data = field_bytes(5, b"")     # DeleteRequest stub
         got = proto.decode_greptime_request(data)
-        assert got.other == "ddl"
+        assert got.other == "delete"
+        # unsupported DDL variants surface by name
+        alter = field_bytes(4, field_bytes(3, b""))
+        assert proto.decode_greptime_request(alter).ddl.other == "alter"
 
 
 @pytest.fixture(scope="module")
@@ -131,10 +134,53 @@ class TestInteropServer:
         table = reader.read_all()
         assert table.column(0)[0].as_py() == 4
 
+
+    def test_proto_ddl_create_insert_drop(self, served):
+        """The reference Database::create flow: DdlRequest(CreateTable)
+        -> typed insert -> query -> drop, all over protobuf tickets."""
+        fe, db = served
+        db.create(
+            "proto_ddl_t",
+            [("host", proto.ColumnDataType.STRING),
+             ("ts", proto.ColumnDataType.TIMESTAMP_MILLISECOND),
+             ("n", proto.ColumnDataType.INT64),
+             ("v", proto.ColumnDataType.FLOAT64)],
+            time_index="ts", primary_keys=["host"])
+        n = db.insert("proto_ddl_t",
+                      {"host": ["a"], "ts": [1000], "n": [7],
+                       "v": [0.5]},
+                      tag_columns=["host"], timestamp_column="ts")
+        assert n == 1
+        table, _ = db.sql("SELECT host, n, v FROM proto_ddl_t")
+        assert table.column("n").to_pylist() == [7]
+        db.drop_table("proto_ddl_t")
+        with pytest.raises(Exception):
+            db.sql("SELECT 1 FROM proto_ddl_t")
+
+    def test_ddl_round_trip_codec(self):
+        expr = proto.CreateTableExpr(
+            table_name="t", time_index="ts", primary_keys=["h"],
+            create_if_not_exists=True,
+            column_defs=[
+                proto.ColumnDef("h", proto.ColumnDataType.STRING, True),
+                proto.ColumnDef("ts",
+                                proto.ColumnDataType.TIMESTAMP_MILLISECOND,
+                                False)])
+        req = proto.GreptimeRequest(ddl=proto.DdlRequest(create_table=expr))
+        got = proto.decode_greptime_request(
+            proto.encode_greptime_request(req))
+        ct = got.ddl.create_table
+        assert ct.table_name == "t" and ct.time_index == "ts"
+        assert ct.primary_keys == ["h"] and ct.create_if_not_exists
+        assert [c.name for c in ct.column_defs] == ["h", "ts"]
+        sql = proto.create_table_to_sql(ct)
+        assert "TIME INDEX" in sql and "PRIMARY KEY" in sql
+
     def test_ddl_variant_rejected_with_clear_error(self, served):
         import pyarrow.flight as flight
 
         from greptimedb_tpu.utils.protowire import field_bytes
         fe, db = served
-        with pytest.raises(flight.FlightError, match="GreptimeRequest"):
-            db.conn.do_get(flight.Ticket(field_bytes(4, b""))).read_all()
+        with pytest.raises(flight.FlightError, match="DdlRequest"):
+            db.conn.do_get(flight.Ticket(
+                field_bytes(4, field_bytes(3, b"")))).read_all()
