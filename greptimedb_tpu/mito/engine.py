@@ -213,24 +213,32 @@ class MitoTable(Table):
                 if c.name in projection]
 
     def _scan_data_to_batch(self, data, schema: Schema) -> RecordBatch:
-        cols = {}
+        """SoA scan arrays → RecordBatch with zero per-value Python: the
+        scan already holds numpy columns + validity bitmaps, so vectors
+        wrap them directly (small-query latency is conversion-bound)."""
+        from ..datatypes.vector import Vector
+        import numpy as np
         sd = data.series_dict
+        vectors = []
         for c in schema.column_schemas:
             if c.is_tag:
                 tag_idx = self.schema.tag_names().index(c.name)
-                cols[c.name] = sd.decode_tag_column(data.series_ids, tag_idx)
+                decoded = sd.decode_tag_column(data.series_ids, tag_idx)
+                arr = np.empty(len(decoded), dtype=object)
+                arr[:] = decoded
+                vectors.append(Vector(c.dtype, arr))
             elif c.is_time_index:
-                cols[c.name] = data.ts
-            else:
-                if c.name in data.fields:
-                    vals, valid = data.fields[c.name]
-                    if valid is not None:
-                        vals = [None if not ok else v
-                                for v, ok in zip(vals.tolist(), valid.tolist())]
-                    cols[c.name] = vals
+                vectors.append(Vector.from_numpy(data.ts, c.dtype))
+            elif c.name in data.fields:
+                vals, valid = data.fields[c.name]
+                if vals.dtype == object:
+                    vectors.append(Vector(c.dtype, vals, valid))
                 else:
-                    cols[c.name] = [None] * data.num_rows
-        return RecordBatch.from_pydict(schema, cols)
+                    vectors.append(Vector.from_numpy(vals, c.dtype,
+                                                     validity=valid))
+            else:
+                vectors.append(Vector.nulls(data.num_rows, c.dtype))
+        return RecordBatch(schema, vectors)
 
     def flush(self) -> None:
         for region in self.regions.values():
