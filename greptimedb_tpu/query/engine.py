@@ -143,20 +143,28 @@ class QueryEngine:
         if result is not None:
             return self._finish_aggregate_frame(result, a, query, table)
 
-        # CPU fallback: scan the needed columns
-        needed = None
-        if a.column_refs and not self._needs_all(a, query):
-            refs = set(a.column_refs)
-            if any(c.op in ("first", "last") for c in a.agg_calls):
-                # _aggregate sorts by the time index so first/last are
-                # time-ordered — keep it in the projection even when the
-                # query doesn't reference it
-                tc = table.schema.timestamp_column
-                if tc is not None:
-                    refs.add(tc.name)
-            needed = [c for c in table.schema.names() if c in refs]
-        batches = table.scan_batches(projection=needed)
-        df = _batches_to_df(batches)
+        # CPU fallback: the per-version cached frame when the table is
+        # region-backed (repeat queries skip scan+convert entirely),
+        # else scan the needed columns
+        df = None
+        try:
+            df = tpu_exec.cached_table_frame(table)
+        except Exception:  # noqa: BLE001 — cache is an optimization
+            df = None
+        if df is None:
+            needed = None
+            if a.column_refs and not self._needs_all(a, query):
+                refs = set(a.column_refs)
+                if any(c.op in ("first", "last") for c in a.agg_calls):
+                    # _aggregate sorts by the time index so first/last
+                    # are time-ordered — keep it in the projection even
+                    # when the query doesn't reference it
+                    tc = table.schema.timestamp_column
+                    if tc is not None:
+                        refs.add(tc.name)
+                needed = [c for c in table.schema.names() if c in refs]
+            batches = table.scan_batches(projection=needed)
+            df = _batches_to_df(batches)
         return self._run_on_frame(df, a, query, table)
 
     # ---- UNION [ALL] ----

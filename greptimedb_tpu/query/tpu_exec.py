@@ -584,6 +584,35 @@ def _match_field_pred(e: Expr, field_names: set) -> Optional[FieldFilter]:
 #: (src/query/src/datafusion.rs).
 TPU_DISPATCH_MIN_ROWS = 131072
 
+#: assumed CPU columnar throughput for break-even estimation (pandas
+#: groupby sustains ~8-25 Mrows/s on simple aggregates; be conservative)
+_CPU_ROWS_PER_SEC = 15e6
+#: fastest observed device-path query (seconds) — a lower bound on the
+#: per-query fixed cost (dispatch chain + transfers + result fetch);
+#: ~1-2ms on local PCIe, 100ms+ behind a tunneled chip
+_observed_min_dt = [None]
+
+
+def _dispatch_min_rows() -> int:
+    """Latency-adaptive dispatch floor.
+
+    The static floor (131072 rows) is right when a device query's fixed
+    cost is ~1-2 ms (local PCIe). Behind a remote device link the same
+    chain costs 100 ms+ — time the CPU path would spend on millions of
+    rows — so the floor adapts to the fastest device-path query seen
+    this process (a fixed-cost lower bound; warm compile caches make it
+    representative after the first few queries)."""
+    dt = _observed_min_dt[0]
+    if dt is None:
+        return TPU_DISPATCH_MIN_ROWS
+    return max(TPU_DISPATCH_MIN_ROWS, int(dt * _CPU_ROWS_PER_SEC))
+
+
+def _note_device_query_time(dt: float) -> None:
+    cur = _observed_min_dt[0]
+    if cur is None or dt < cur:
+        _observed_min_dt[0] = dt
+
 
 def _estimated_table_rows(table) -> Optional[int]:
     """Cheap upper-bound row estimate from memtable counters + SST metas —
@@ -604,6 +633,51 @@ def _estimated_table_rows(table) -> Optional[int]:
     return total
 
 
+def cached_table_frame(table) -> Optional[pd.DataFrame]:
+    """Columnar pandas frame for the CPU fallback, memoized per region
+    version on the merged-scan cache — the fallback otherwise re-reads
+    and re-converts the whole table on every query (the role of
+    DataFusion's MemTable caching for hot tables). Nulls follow the
+    fallback's frame conventions: NaN for numerics, None for objects."""
+    regions = getattr(table, "regions", None)
+    if not regions:
+        return None
+    schema = table.schema
+    ts_name = schema.timestamp_column.name \
+        if schema.timestamp_column is not None else None
+    frames = []
+    for region in regions.values():
+        scan = SCAN_CACHE.get(region)
+        df = scan.device.get("__host_df")
+        if df is None:
+            cols = {}
+            sd = scan.series_dict
+            for i, tag in enumerate(sd.tag_names):
+                cols[tag] = sd.decode_tag_column(scan.series_ids, i)
+            if ts_name is not None:
+                cols[ts_name] = scan.ts
+            for name, (vals, valid) in scan.fields.items():
+                if valid is None:
+                    cols[name] = vals
+                elif vals.dtype == object:
+                    arr = vals.copy()
+                    arr[~valid] = None
+                    cols[name] = arr
+                else:
+                    arr = vals.astype(np.float64)
+                    arr[~valid] = np.nan
+                    cols[name] = arr
+            # schema column order
+            df = pd.DataFrame({n: cols[n] for n in schema.names()
+                               if n in cols})
+            scan.device["__host_df"] = df
+        frames.append(df)
+    if not frames:
+        return pd.DataFrame()
+    return frames[0] if len(frames) == 1 else \
+        pd.concat(frames, ignore_index=True)
+
+
 def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     plan = plan_for(table, a, query)
     if plan is None:
@@ -613,7 +687,7 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
         # rows over the wire); local tables route small scans to the CPU
         # columnar path, which is faster and float64-exact.
         est = _estimated_table_rows(table)
-        if est is not None and est < TPU_DISPATCH_MIN_ROWS:
+        if est is not None and est < _dispatch_min_rows():
             return None
     try:
         if hasattr(table, "execute_tpu_plan"):
@@ -622,7 +696,10 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
             frames = [f for f in table.execute_tpu_plan(plan)
                       if f is not None and len(f)]
         else:
+            import time as _time
+            t0 = _time.perf_counter()
             frames = region_moment_frames(table, plan)
+            _note_device_query_time(_time.perf_counter() - t0)
     except UnsupportedError:
         return None
     if not frames:
@@ -766,8 +843,12 @@ def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
         num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
         ends=run_ends)
-    counts = np.asarray(counts)[:nruns]
-    res_np = [np.asarray(r)[:nruns] for r in results]
+    # ONE batched fetch: each separate np.asarray is a full device round
+    # trip (~100ms behind a tunneled chip), and queries fetch 1+len(ops)
+    # arrays
+    counts, res_np = jax.device_get((counts, list(results)))
+    counts = counts[:nruns]
+    res_np = [r[:nruns] for r in res_np]
 
     # ---- host: fold runs into final groups ----
     live = counts > 0

@@ -275,3 +275,37 @@ class TestConcurrentProposals:
         finally:
             for nd in nodes:
                 nd.stop()
+
+
+class TestRestartRejoin:
+    def test_restarted_node_rejoins_with_word_kept(self, tmp_path):
+        """A node restarted from its persisted (term, vote, log) rejoins
+        and catches up; a full-cluster restart recovers all state by
+        replay (the reference's etcd equivalent: raft snapshot + WAL)."""
+        nodes = make_cluster(3, tmp_path)
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            for i in range(4):
+                kv.put(f"r{i}", f"x{i}".encode())
+            follower = next(nd for nd in nodes if nd is not leader)
+            fid = follower.node_id
+            wait_for(lambda: follower.state.get("r3") == b"x3",
+                     what="follower sync")
+            # stop the follower, write more, restart it from disk
+            follower.stop()
+            partition_away(nodes, follower)
+            kv.put("during_outage", b"yes")
+            revived = RaftNode(fid, [nd.node_id for nd in nodes],
+                               store_path=str(tmp_path / f"raft-{fid}.json"),
+                               **FAST)
+            assert len(revived.log) >= 4, "persisted log must reload"
+            live = [nd for nd in nodes if nd is not follower] + [revived]
+            connect_local(live)
+            revived.start()
+            wait_for(lambda: revived.state.get("during_outage") == b"yes",
+                     what="revived catch-up")
+            assert revived.state.get("r0") == b"x0"
+        finally:
+            for nd in nodes:
+                nd.stop()
