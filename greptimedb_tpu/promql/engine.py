@@ -50,6 +50,15 @@ _RANGE_FUNCS = {
 _KEEP_NAME_RANGE_FUNCS = {"last_over_time"}
 
 
+def _fetch_pair(v, ok):
+    """One batched device fetch for a (values, ok) kernel result — two
+    sequential np.asarray calls each pay a full device round trip."""
+    import jax
+    if hasattr(v, "addressable_shards") or hasattr(ok, "addressable_shards"):
+        v, ok = jax.device_get((v, ok))
+    return _from_device_f32(v), np.asarray(ok)
+
+
 def _from_device_f32(v) -> np.ndarray:
     """Bring device results to host float64, honestly.
 
@@ -503,8 +512,9 @@ class _Eval:
             if t < dmin or t - win_ms > dmax:
                 return VectorVal(selection.labels, out_vals, out_ok)
             v, ok = kernel(selection.matrix, np.int64(t), 1)
-            v = _from_device_f32(v)[:, :1]
-            ok = np.asarray(ok)[:, :1]
+            v, ok = _fetch_pair(v, ok)
+            v = v[:, :1]
+            ok = ok[:, :1]
             out_vals[:] = np.repeat(v, self.nsteps, axis=1)
             out_ok[:] = np.repeat(ok, self.nsteps, axis=1)
             return VectorVal(selection.labels, out_vals, out_ok)
@@ -519,8 +529,9 @@ class _Eval:
         n_pad = 1 << (n_eval - 1).bit_length() if n_eval > 1 else 1
         v, ok = kernel(selection.matrix, np.int64(t0 + j0 * self.step),
                        n_pad)
-        v = _from_device_f32(v)[:, :n_eval]
-        ok = np.asarray(ok)[:, :n_eval]
+        v, ok = _fetch_pair(v, ok)
+        v = v[:, :n_eval]
+        ok = ok[:, :n_eval]
         out_vals[:, j0:j1 + 1] = v
         out_ok[:, j0:j1 + 1] = ok
         return VectorVal(selection.labels, out_vals, out_ok)
