@@ -162,3 +162,49 @@ class TestValidation:
                        "(PARTITION BY host ORDER BY v DESC) AS rn FROM w "
                        "WHERE v IS NOT NULL ORDER BY host, rn")
         assert [r[2] for r in got] == [1, 2, 3, 4, 1, 2]
+
+
+class TestEdgeCases:
+    def test_null_order_keys_sort_last_and_are_peers(self, fe):
+        # v is NULL at ts=3000 for host a: NULL sorts last; rank treats
+        # NULLs as peers of each other
+        got = rows(fe, "SELECT ts, rank() OVER (ORDER BY v) FROM w "
+                       "WHERE host = 'a' ORDER BY ts")
+        # values: 3,1,4,NULL,5 -> ranks 2,1,3,5,4
+        assert [r[1] for r in got] == [2, 1, 3, 5, 4]
+
+    def test_desc_order(self, fe):
+        got = col(fe, "SELECT ts, row_number() OVER (ORDER BY v DESC) "
+                      "FROM w WHERE host = 'a' AND v IS NOT NULL "
+                      "ORDER BY ts")
+        # v: 3,1,4,5 -> desc row_numbers 3,4,2,1
+        assert got == [3, 4, 2, 1]
+
+    def test_multi_partition_keys(self, fe):
+        got = col(fe, "SELECT ts, count(*) OVER (PARTITION BY host, k) "
+                      "FROM w ORDER BY host, ts")
+        # host a: k=1 twice, k=2 twice, k=3 once; host b: k=1, k=2
+        assert got == [2, 2, 2, 2, 1, 1, 1]
+
+    def test_window_with_limit(self, fe):
+        got = rows(fe, "SELECT ts, sum(v) OVER (ORDER BY ts) AS s FROM w "
+                       "WHERE host = 'a' ORDER BY ts LIMIT 2")
+        # LIMIT applies after the window computes over ALL rows
+        assert [r[1] for r in got] == [3.0, 4.0]
+
+    def test_window_sees_where_filtered_rows_only(self, fe):
+        got = col(fe, "SELECT ts, count(*) OVER () FROM w "
+                      "WHERE host = 'a' AND v > 2 ORDER BY ts")
+        assert got == [3, 3, 3]     # v in (3,4,5)
+
+    def test_lead_offset_two(self, fe):
+        got = col(fe, "SELECT ts, lead(v, 2) OVER (PARTITION BY host "
+                      "ORDER BY ts) FROM w WHERE host = 'b' ORDER BY ts")
+        assert got == [None, None]
+
+    def test_explain_window_query(self, fe):
+        out = fe.do_query("EXPLAIN SELECT row_number() OVER "
+                          "(ORDER BY ts) FROM w")
+        if isinstance(out, list):
+            out = out[0]
+        assert out.batches is not None
