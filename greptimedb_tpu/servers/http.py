@@ -83,6 +83,7 @@ class HttpServer:
         r.add_get("/status", self.handle_status)
         r.add_post("/v1/admin/flush", self.handle_flush)
         r.add_post("/v1/admin/compact", self.handle_compact)
+        r.add_post("/v1/admin/downsample", self.handle_downsample)
         r.add_post("/v1/scripts", self.handle_scripts)
         r.add_post("/v1/run-script", self.handle_run_script)
         r.add_get("/v1/prof/mem", self.handle_mem_prof)
@@ -460,6 +461,60 @@ class HttpServer:
 
         await loop.run_in_executor(None, work)
         return web.json_response({"code": 0})
+
+    async def handle_downsample(self, request):
+        """POST /v1/admin/downsample?src=raw&dst=agg&stride=60s[&agg=avg]
+        — aggregate src's rows into stride buckets and append to dst (the
+        device-resident maintenance job, storage/downsample.py). This
+        build's extension over the reference (v0.2 compaction only
+        merges files)."""
+        from ..common.time import parse_duration_ms
+        from ..storage.downsample import downsample_region
+        ctx = self._ctx(request)
+        src_name = request.query.get("src")
+        dst_name = request.query.get("dst")
+        stride = request.query.get("stride", "60s")
+        agg = request.query.get("agg", "avg")
+        if not src_name or not dst_name:
+            return web.json_response(
+                {"code": 1004, "error": "src and dst are required"},
+                status=400)
+        try:
+            stride_ms = parse_duration_ms(stride)
+        except (ValueError, TypeError):
+            return web.json_response(
+                {"code": 1004, "error": f"bad stride {stride!r}"},
+                status=400)
+        cat = self.frontend.catalog
+        src = cat.table(ctx.current_catalog, ctx.current_schema, src_name)
+        dst = cat.table(ctx.current_catalog, ctx.current_schema, dst_name)
+        if src is None or dst is None:
+            return web.json_response(
+                {"code": 4001, "error": "src or dst table not found"},
+                status=404)
+        loop = asyncio.get_running_loop()
+
+        def work():
+            total = 0
+            src_regions = list(getattr(src, "regions", {}).values())
+            dst_regions = list(getattr(dst, "regions", {}).values())
+            if not src_regions or not dst_regions:
+                raise ValueError("downsample needs region-backed tables")
+            fields = [c.name for c in src.schema.field_columns()
+                      if not src.schema.column_schema(c.name)
+                      .dtype.is_string]
+            aggs = {f: agg for f in fields}
+            for region in src_regions:
+                total += downsample_region(region, dst_regions[0],
+                                           stride_ms=stride_ms, aggs=aggs)
+            return total
+
+        try:
+            rows = await loop.run_in_executor(None, work)
+        except Exception as e:  # noqa: BLE001 — surface as API error
+            return web.json_response({"code": 1004, "error": str(e)},
+                                     status=400)
+        return web.json_response({"code": 0, "rows_written": rows})
 
     # ---- Prometheus HTTP API (prom.rs) ----
     async def handle_prom_api_query(self, request):

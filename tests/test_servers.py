@@ -331,3 +331,36 @@ class TestAdminCompact:
         assert len(region.version_control.current.ssts.levels[1]) == 1
         out = sql(server, "SELECT cpu FROM ac")
         assert out["output"][0]["records"]["rows"] == [[1.0]]
+
+
+class TestAdminDownsample:
+    def test_downsample_endpoint(self, server):
+        sql(server, "CREATE TABLE ds_raw (host STRING, ts TIMESTAMP TIME"
+                    " INDEX, v DOUBLE, PRIMARY KEY(host))")
+        sql(server, "CREATE TABLE ds_agg (host STRING, ts TIMESTAMP TIME"
+                    " INDEX, v DOUBLE, PRIMARY KEY(host))")
+        rows = ",".join(f"('h{i % 2}', {i * 1000}, {float(i)})"
+                        for i in range(240))
+        sql(server, f"INSERT INTO ds_raw VALUES {rows}")
+        status, body = req(server,
+                           "/v1/admin/downsample?src=ds_raw&dst=ds_agg"
+                           "&stride=60s&agg=avg", "POST", b"")
+        assert status == 200, body
+        data = json.loads(body)
+        assert data["code"] == 0
+        assert data["rows_written"] == 8      # 2 hosts x 4 minutes
+        out = sql(server, "SELECT count(*) FROM ds_agg")
+        assert out["output"][0]["records"]["rows"][0][0] == 8
+        out = sql(server, "SELECT v FROM ds_agg WHERE host = 'h0'"
+                          " ORDER BY ts LIMIT 1")
+        # first minute of h0: even i in [0, 60) -> mean 29
+        assert out["output"][0]["records"]["rows"][0][0] == 29.0
+
+    def test_downsample_bad_args(self, server):
+        status, body = req(server,
+                           "/v1/admin/downsample?src=nope&dst=nope"
+                           "&stride=60s", "POST", b"")
+        assert status == 404
+        status, body = req(server, "/v1/admin/downsample?src=a",
+                           "POST", b"")
+        assert status == 400
