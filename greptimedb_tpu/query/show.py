@@ -120,6 +120,28 @@ def show_create_table(engine, stmt, ctx: QueryContext) -> Output:
         defs.append(f"  PRIMARY KEY ({', '.join(pks)})")
     lines.append(",\n".join(defs))
     lines.append(")")
+    rule = getattr(table, "partition_rule", None)
+    if rule is not None and getattr(rule, "bounds", None):
+        # render the partition clause (reference SHOW CREATE TABLE
+        # includes it, src/sql/src/statements/create.rs)
+        cols = ", ".join(rule.partition_columns())
+
+        def bound_text(b):
+            vals = b if isinstance(b, tuple) else (b,)
+            parts = []
+            for v in vals:
+                if v is None or (isinstance(v, str) and
+                                 v.upper() == "MAXVALUE"):
+                    parts.append("MAXVALUE")
+                elif isinstance(v, str):
+                    parts.append(f"'{v}'")
+                else:
+                    parts.append(str(v))
+            return ", ".join(parts)
+        entries = ",\n".join(
+            f"  PARTITION p{i} VALUES LESS THAN ({bound_text(b)})"
+            for i, b in enumerate(rule.bounds))
+        lines.append(f"PARTITION BY RANGE COLUMNS ({cols}) (\n{entries}\n)")
     lines.append(f"ENGINE={info.meta.engine}")
     if info.meta.options:
         opts = ", ".join(f"{k}={v!r}" for k, v in info.meta.options.items())
