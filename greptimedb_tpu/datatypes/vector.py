@@ -55,6 +55,32 @@ class Vector:
             # numeric ndarray fast path: no per-value cast, no nulls
             return Vector(dtype,
                           np.ascontiguousarray(values, dtype=dtype.np_dtype))
+        if isinstance(values, np.ndarray) and values.dtype.kind == "U" \
+                and dtype.is_string:
+            # fixed-width unicode arrays (np.repeat of str lists) carry
+            # no nulls; store as object for Arrow interop
+            return Vector(dtype, values.astype(object))
+        if isinstance(values, np.ndarray) and values.dtype == object \
+                and dtype.is_string:
+            # string object-array fast path: vectorized null scan, cast
+            # only the (rare) non-str entries
+            import pandas as pd
+            isnull = pd.isnull(values)
+            if not isnull.any():
+                if all(type(v) is str for v in values[:64]):
+                    data = values
+                    if not all(type(v) is str for v in values):
+                        data = np.array([v if type(v) is str else
+                                         dtype.cast_value(v)
+                                         for v in values], dtype=object)
+                    return Vector(dtype, data)
+            else:
+                data = np.array([dtype.default_value() if m else
+                                 (v if type(v) is str
+                                  else dtype.cast_value(v))
+                                 for v, m in zip(values, isnull)],
+                                dtype=object)
+                return Vector(dtype, data, ~isnull)
         n = len(values)
         validity = np.ones(n, dtype=bool)
         if dtype.is_string or dtype.is_binary:

@@ -61,14 +61,17 @@ class Dictionary:
             arr = values if isinstance(values, np.ndarray) \
                 else np.asarray(values, dtype=object)
             try:
-                uniq, inv = np.unique(arr, return_inverse=True)
-            except TypeError:
-                uniq = None      # unorderable values (e.g. None vs str)
+                # hash-based dedup: ~5x faster than sorting on strings
+                import pandas as pd
+                inv, uniq = pd.factorize(arr, use_na_sentinel=False)
+            except (TypeError, ValueError):
+                uniq = None      # unhashable values
             if uniq is not None:
                 ids_u = np.empty(len(uniq), dtype=np.int32)
                 for i, v in enumerate(uniq.tolist()):
                     ids_u[i] = self.get_or_insert(v)
-                return ids_u[inv.reshape(-1)].astype(np.int32, copy=False)
+                return ids_u[np.asarray(inv).reshape(-1)] \
+                    .astype(np.int32, copy=False)
         out = np.empty(n, dtype=np.int32)
         get = self._value_to_id.get
         for i, v in enumerate(values):
