@@ -83,7 +83,7 @@ def evaluate_insert_rows(stmt: ast.Insert, columns, query_engine, ctx
         out = query_engine.execute_query(stmt.select, ctx)
         rows = [list(r) for b in out.batches for r in b.rows()]
     else:
-        ev = Evaluator(pd.DataFrame(index=[0]))
+        ev = None
         rows = []
         for row in stmt.rows:
             if len(row) != len(columns):
@@ -92,6 +92,13 @@ def evaluate_insert_rows(stmt: ast.Insert, columns, query_engine, ctx
                     f"{len(columns)}")
             vals = []
             for e in row:
+                # literal fast path: bulk VALUES lists are literals;
+                # only expressions (now(), 1+2, ...) hit the evaluator
+                if type(e) is ast.Literal:
+                    vals.append(e.value)
+                    continue
+                if ev is None:
+                    ev = Evaluator(pd.DataFrame(index=[0]))
                 v = ev.eval(e)
                 if isinstance(v, pd.Series):
                     v = v.iloc[0]

@@ -871,18 +871,74 @@ class Parser:
             return Insert(table, columns, select=self.parse_query())
         self.expect_kw("VALUES")
         rows: List[List[Expr]] = []
+        toks = self.toks
         while True:
-            self.expect_op("(")
-            row: List[Expr] = []
-            if not (self.peek().kind == OP and self.peek().value == ")"):
-                row.append(self.parse_expr())
-                while self.match_op(","):
+            row = self._fast_values_row()
+            if row is None:
+                self.expect_op("(")
+                row = []
+                if not (self.peek().kind == OP and
+                        self.peek().value == ")"):
                     row.append(self.parse_expr())
-            self.expect_op(")")
+                    while self.match_op(","):
+                        row.append(self.parse_expr())
+                self.expect_op(")")
             rows.append(row)
             if not self.match_op(","):
                 break
         return Insert(table, columns, rows)
+
+    def _fast_values_row(self) -> Optional[List[Expr]]:
+        """Direct token walk for the all-literal VALUES tuple (the bulk
+        INSERT hot path); bails to the expression grammar on anything
+        fancier (functions, arithmetic, placeholders)."""
+        toks = self.toks
+        i = self.i
+        t = toks[i]
+        if not (t.kind == OP and t.value == "("):
+            return None
+        i += 1
+        row: List[Expr] = []
+        while True:
+            t = toks[i]
+            k = t.kind
+            neg = False
+            if k == OP and t.value in ("-", "+"):
+                neg = t.value == "-"
+                i += 1
+                t = toks[i]
+                k = t.kind
+                if k != NUMBER:
+                    return None
+            if k == NUMBER:
+                txt = t.value
+                if txt.lower().startswith("0x"):
+                    v = int(txt, 16)
+                else:
+                    v = float(txt) if ("." in txt or "e" in txt.lower()) \
+                        else int(txt)
+                row.append(Literal(-v if neg else v, "number"))
+            elif k == STRING:
+                row.append(Literal(t.value, "string"))
+            elif k == IDENT:
+                kw = t.value.upper()
+                if kw == "NULL":
+                    row.append(Literal(None, "null"))
+                elif kw in ("TRUE", "FALSE"):
+                    row.append(Literal(kw == "TRUE", "bool"))
+                else:
+                    return None
+            else:
+                return None
+            i += 1
+            t = toks[i]
+            if t.kind == OP and t.value == ",":
+                i += 1
+                continue
+            if t.kind == OP and t.value == ")":
+                self.i = i + 1
+                return row
+            return None
 
     def parse_delete(self) -> Delete:
         self.expect_kw("DELETE")

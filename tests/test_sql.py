@@ -78,8 +78,14 @@ def test_insert_values():
 
 
 def test_insert_negative_number():
+    # the bulk-VALUES fast path folds the sign into the literal
     stmt = parse_sql("INSERT INTO t VALUES (-5, -1.5)")
-    assert isinstance(stmt.rows[0][0], UnaryOp)
+    assert stmt.rows[0][0] == Literal(-5, "number")
+    assert stmt.rows[0][1] == Literal(-1.5, "number")
+    # non-literal rows still carry the expression form
+    stmt = parse_sql("INSERT INTO t VALUES (-5 + 1, now())")
+    assert isinstance(stmt.rows[0][0], BinaryOp) or \
+        isinstance(stmt.rows[0][0], UnaryOp)
 
 
 def test_select_full():
