@@ -42,6 +42,7 @@ class GreptimeDatabase:
 
     def __init__(self, address: str, *, catalog: str = "greptime",
                  schema: str = "public"):
+        self.address = address
         self.conn = flight.FlightClient(address)
         self.catalog = catalog
         self.schema = schema

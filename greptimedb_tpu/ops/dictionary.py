@@ -69,6 +69,11 @@ class Dictionary:
             if uniq is not None:
                 ids_u = np.empty(len(uniq), dtype=np.int32)
                 for i, v in enumerate(uniq.tolist()):
+                    if isinstance(v, float) and v != v:
+                        # factorize surfaces None as NaN; store the real
+                        # None so ids stay stable across batches and the
+                        # per-value path
+                        v = None
                     ids_u[i] = self.get_or_insert(v)
                 return ids_u[np.asarray(inv).reshape(-1)] \
                     .astype(np.int32, copy=False)

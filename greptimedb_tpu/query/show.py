@@ -134,7 +134,7 @@ def show_create_table(engine, stmt, ctx: QueryContext) -> Output:
                                  v.upper() == "MAXVALUE"):
                     parts.append("MAXVALUE")
                 elif isinstance(v, str):
-                    parts.append(f"'{v}'")
+                    parts.append("'" + v.replace("'", "''") + "'")
                 else:
                     parts.append(str(v))
             return ", ".join(parts)

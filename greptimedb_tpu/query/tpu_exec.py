@@ -609,6 +609,13 @@ def _dispatch_min_rows() -> int:
 
 
 def _note_device_query_time(dt: float) -> None:
+    # cap what one observation may contribute: a cold query includes
+    # 10-40s of XLA compile, and an uncapped floor would route every
+    # later mid-size query to the CPU path, so no device query would
+    # ever run again to correct the estimate. The cap keeps tables
+    # >7.5M rows on the device, whose warm queries then pull the
+    # minimum down to the true fixed cost.
+    dt = min(dt, 0.5)
     cur = _observed_min_dt[0]
     if cur is None or dt < cur:
         _observed_min_dt[0] = dt

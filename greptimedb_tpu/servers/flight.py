@@ -246,24 +246,45 @@ class FlightFrontendServer(flight.FlightServerBase):
     def _do_get_proto(self, raw: bytes):
         from ..api import v1 as proto
         req = proto.decode_greptime_request(bytes(raw))
+        ctx = self._proto_ctx(req)
         if req.query is not None and req.query.sql is not None:
-            outputs = self.frontend.do_query(req.query.sql)
+            outputs = self.frontend.do_query(req.query.sql, ctx)
             last = outputs[-1]
             if last.is_batches:
                 return _batches_stream(last.batches)
             return _affected_stream(last.affected_rows or 0,
                                     proto_metadata=True)
         if req.insert is not None:
-            n = self._apply_proto_insert(req.insert)
+            n = self._apply_proto_insert(req.insert, ctx)
             return _affected_stream(n, proto_metadata=True)
         if req.ddl is not None:
-            return self._apply_proto_ddl(req.ddl)
+            return self._apply_proto_ddl(req.ddl, ctx)
         what = req.other or "empty"
         raise GreptimeError(
             f"unsupported GreptimeRequest variant {what!r} on do_get "
             "(use SQL DDL over the query plane)")
 
-    def _apply_proto_ddl(self, ddl):
+    @staticmethod
+    def _proto_ctx(req):
+        """RequestHeader catalog/schema/dbname → QueryContext (reference:
+        every handler resolves names through the header's context,
+        src/servers/src/grpc/handler.rs). dbname may carry
+        'catalog-schema' form."""
+        from ..session import QueryContext
+        ctx = QueryContext()
+        catalog, schema = req.catalog, req.schema
+        if req.dbname:
+            if "-" in req.dbname:
+                catalog, _, schema = req.dbname.partition("-")
+            else:
+                schema = req.dbname
+        if catalog:
+            ctx.current_catalog = catalog
+        if schema:
+            ctx.current_schema = schema
+        return ctx
+
+    def _apply_proto_ddl(self, ddl, ctx):
         from ..api.v1 import create_table_to_sql
         if ddl.create_table is not None:
             sql = create_table_to_sql(ddl.create_table)
@@ -274,11 +295,11 @@ class FlightFrontendServer(flight.FlightServerBase):
         else:
             raise GreptimeError(
                 f"unsupported DdlRequest variant {ddl.other!r}")
-        outputs = self.frontend.do_query(sql)
+        outputs = self.frontend.do_query(sql, ctx)
         return _affected_stream(outputs[-1].affected_rows or 0,
                                 proto_metadata=True)
 
-    def _apply_proto_insert(self, ins) -> int:
+    def _apply_proto_insert(self, ins, ctx) -> int:
         from ..api.v1 import SemanticType
         columns = {}
         tag_columns = []
@@ -291,7 +312,7 @@ class FlightFrontendServer(flight.FlightServerBase):
                 timestamp_column = c.column_name
         return self.frontend.handle_row_insert(
             ins.table_name, columns, tag_columns=tag_columns,
-            timestamp_column=timestamp_column)
+            timestamp_column=timestamp_column, ctx=ctx)
 
     def do_put(self, context, descriptor, reader, writer):
         cmd = json.loads(descriptor.command)

@@ -500,6 +500,12 @@ class HttpServer:
             dst_regions = list(getattr(dst, "regions", {}).values())
             if not src_regions or not dst_regions:
                 raise ValueError("downsample needs region-backed tables")
+            if len(dst_regions) > 1:
+                # writing into one region of a partitioned table would
+                # strand rows outside their partition's region
+                raise ValueError(
+                    "downsample into a partitioned destination is not "
+                    "supported; use an unpartitioned dst table")
             fields = [c.name for c in src.schema.field_columns()
                       if not src.schema.column_schema(c.name)
                       .dtype.is_string]

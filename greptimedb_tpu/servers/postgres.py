@@ -53,7 +53,7 @@ def _decode_binary_param(raw: bytes, oid: int) -> str:
     Decoding keys off the Parse-declared OID; length disambiguates when
     the driver declared none."""
     n = len(raw)
-    if oid in (21, 23, 20) or (oid == 0 and n in (2, 4, 8)):  # int2/4/8
+    if oid in (21, 23, 20):                                    # int2/4/8
         return str(int.from_bytes(raw, "big", signed=True))
     if oid == 700 and n == 4:                                  # float4
         return repr(struct.unpack("!f", raw)[0])

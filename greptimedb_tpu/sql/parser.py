@@ -871,7 +871,6 @@ class Parser:
             return Insert(table, columns, select=self.parse_query())
         self.expect_kw("VALUES")
         rows: List[List[Expr]] = []
-        toks = self.toks
         while True:
             row = self._fast_values_row()
             if row is None:

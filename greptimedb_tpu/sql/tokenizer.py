@@ -44,7 +44,7 @@ _MASTER = _re.compile(
   | (?P<bcomment>/\*.*?\*/)
   | (?P<number>(?:0[xX][0-9a-fA-F]+)
         |(?:(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?))
-  | (?P<ident>[A-Za-z_@$][A-Za-z0-9_$@]*)
+  | (?P<ident>[\w@$][\w$@]*)
   | (?P<sstr>'(?:[^'\\]|''|\\.)*')
   | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
   | (?P<op><=>|<>|<=|>=|!=|::|\|\||[<>=+\-*/%(),;.?~!\[\]{}:])

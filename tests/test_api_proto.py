@@ -184,3 +184,46 @@ class TestInteropServer:
         with pytest.raises(flight.FlightError, match="DdlRequest"):
             db.conn.do_get(flight.Ticket(
                 field_bytes(4, field_bytes(3, b"")))).read_all()
+
+
+class TestRegressionFindings:
+    def test_null_tag_ids_stable_across_batch_sizes(self):
+        """pd.factorize surfaces None as NaN; the dictionary must store
+        the real None so ids agree between the bulk and per-value
+        paths and across batches."""
+        import numpy as np
+
+        from greptimedb_tpu.ops.dictionary import Dictionary
+        d = Dictionary()
+        big = np.array(["a", None] * 300, dtype=object)
+        ids1 = d.encode(big)
+        ids2 = d.encode(big)
+        assert (ids1 == ids2).all() and len(d) == 2
+        assert d.encode(["a", None]).tolist() == [ids1[0], ids1[1]]
+        assert d.value(int(ids1[1])) is None
+
+    def test_unicode_identifiers_tokenize(self):
+        from greptimedb_tpu.sql.parser import parse_sql
+        q = parse_sql("SELECT tempé FROM températures")
+        assert q.from_.name.table == "températures"
+
+    def test_proto_header_schema_respected(self, served):
+        """The RequestHeader's schema routes every request (reference:
+        handlers resolve names through the header context,
+        src/servers/src/grpc/handler.rs)."""
+        fe, db = served
+        fe.do_query("CREATE DATABASE protodb")
+        other = GreptimeDatabase(db.address, schema="protodb")
+        try:
+            n = other.insert("hdr_t", {"host": ["x"], "ts": [1000],
+                                       "v": [1.0]},
+                             tag_columns=["host"], timestamp_column="ts")
+            assert n == 1
+            table, _ = other.sql("SELECT count(*) FROM hdr_t")
+            assert table.column(0)[0].as_py() == 1
+            # default-schema client cannot see it
+            import pyarrow.flight as flight
+            with pytest.raises(flight.FlightError):
+                db.sql("SELECT count(*) FROM hdr_t")
+        finally:
+            other.close()
