@@ -60,6 +60,33 @@ class MemKv:
             self._data[key] = str(nxt).encode()
             return nxt
 
+    def batch(self, ops: List[Tuple[str, str, Optional[bytes]]],
+              guard: Optional[Tuple[str, Optional[bytes]]] = None) -> bool:
+        """Apply [(op, key, value)] atomically; op is "put" or "delete".
+        `guard` = (key, expect) aborts the whole batch unless the key's
+        current value equals expect (None = absent) — the etcd-txn shape
+        multi-key moves (table rename) need so a crash can't leave a
+        half-renamed route."""
+        with self._lock:
+            if guard is not None and self._data.get(guard[0]) != guard[1]:
+                return False
+            self._apply_batch_locked(ops)
+            return True
+
+    def _apply_batch_locked(self, ops) -> None:
+        # validate before mutating: a bad op mid-list must not leave the
+        # batch half-applied (all-or-nothing contract)
+        for op, key, value in ops:
+            if op not in ("put", "delete"):
+                raise ValueError(f"unknown batch op {op!r}")
+            if op == "put" and not isinstance(value, bytes):
+                raise ValueError(f"batch put needs bytes for {key!r}")
+        for op, key, value in ops:
+            if op == "put":
+                self._data[key] = value
+            else:
+                self._data.pop(key, None)
+
 
 class FileKv(MemKv):
     """MemKv with a JSON snapshot on every mutation — the etcd stand-in
@@ -127,3 +154,11 @@ class FileKv(MemKv):
             self._data[key] = str(nxt).encode()
             self._persist_locked()
             return nxt
+
+    def batch(self, ops, guard=None):
+        with self._lock:
+            if guard is not None and self._data.get(guard[0]) != guard[1]:
+                return False
+            self._apply_batch_locked(ops)
+            self._persist_locked()
+            return True

@@ -343,6 +343,23 @@ class RaftNode:
             nxt = cur + 1
             self.state[key] = str(nxt).encode()
             return nxt
+        if kind == "batch":
+            guard = op.get("guard")
+            if guard is not None:
+                expect = guard["expect"].encode() \
+                    if guard["expect"] is not None else None
+                if self.state.get(guard["key"]) != expect:
+                    return False
+            for sub, k, v in op["ops"]:
+                if sub == "put":
+                    self.state[k] = v.encode()
+                elif sub == "delete":
+                    self.state.pop(k, None)
+                else:
+                    # mirrors MemKv._apply_batch_locked; ReplicatedKv.batch
+                    # validates at propose time so this can't enter the log
+                    raise GreptimeError(f"unknown batch sub-op {sub!r}")
+            return True
         if kind == "noop":
             return None
         raise GreptimeError(f"unknown raft op {kind!r}")
@@ -522,3 +539,19 @@ class ReplicatedKv:
     def incr(self, key: str, start: int = 0) -> int:
         return int(self.node.propose({"kind": "incr", "key": key,
                                       "start": start}))
+
+    def batch(self, ops, guard=None) -> bool:
+        for op, k, v in ops:        # reject bad ops BEFORE they hit the log
+            if op not in ("put", "delete"):
+                raise ValueError(f"unknown batch op {op!r}")
+            if op == "put" and not isinstance(v, bytes):
+                raise ValueError(f"batch put needs bytes for {k!r}")
+        g = None
+        if guard is not None:
+            g = {"key": guard[0],
+                 "expect": guard[1].decode() if guard[1] is not None
+                 else None}
+        return bool(self.node.propose({
+            "kind": "batch", "guard": g,
+            "ops": [(op, k, v.decode() if v is not None else None)
+                    for op, k, v in ops]}))

@@ -225,15 +225,18 @@ class MetaSrv:
         if route is None:
             return None
         route.table_name = new_full_name
-        key = f"{ROUTE_PREFIX}{new_full_name}"
-        if not self.kv.compare_and_put(
-                key, None, json.dumps(route.to_dict()).encode()):
-            raise GreptimeError(f"table route exists: {new_full_name}")
-        self.kv.delete(f"{ROUTE_PREFIX}{old_full_name}")
+        new_key = f"{ROUTE_PREFIX}{new_full_name}"
+        # one guarded multi-op (etcd-txn shape): route + info move together
+        # or not at all, so a crash can't leave the table under both names
+        ops = [("put", new_key, json.dumps(route.to_dict()).encode()),
+               ("delete", f"{ROUTE_PREFIX}{old_full_name}", None)]
         info = self.table_info(old_full_name)
         if info is not None:
-            self.put_table_info(new_full_name, info)
-            self.delete_table_info(old_full_name)
+            ops += [("put", f"{TINFO_PREFIX}{new_full_name}",
+                     json.dumps(info).encode()),
+                    ("delete", f"{TINFO_PREFIX}{old_full_name}", None)]
+        if not self.kv.batch(ops, guard=(new_key, None)):
+            raise GreptimeError(f"table route exists: {new_full_name}")
         return route
 
     def all_table_routes(self) -> List[TableRoute]:

@@ -154,11 +154,23 @@ def _grouped_aggregate(
             c = seg_count(m, i)
             results.append(jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan))
         elif op in ("stddev", "variance"):
-            s = seg_sum(col, i, m)
-            sq = jax.ops.segment_sum(
-                jnp.where(m, col * col, 0), safe_gids, num_segments=seg)[:num_groups]
-            c = jnp.maximum(seg_count(m, i), 1)
-            var = jnp.maximum(sq / c - (s / c) ** 2, 0.0)
+            # Shifted one-pass moments: center on the column's global mean
+            # before squaring (variance is shift-invariant). Squaring raw
+            # values wraps int columns and loses the variance of large,
+            # tight distributions to f32 cancellation; centering fixes both.
+            colf = col.astype(jnp.promote_types(col.dtype, jnp.float32))
+            c = seg_count(m, i)
+            gc = jnp.maximum(jnp.sum(c), 1)
+            shift = jnp.sum(jnp.where(m, colf, 0.0)) / gc
+            d = jnp.where(m, colf - shift, 0.0)
+            s = jax.ops.segment_sum(d, safe_gids,
+                                    num_segments=seg)[:num_groups]
+            sq = jax.ops.segment_sum(d * d, safe_gids,
+                                     num_segments=seg)[:num_groups]
+            cc = jnp.maximum(c, 1)
+            # sample variance (ddof=1, DataFusion convention); <2 rows → NaN
+            var = jnp.maximum(sq - (s / cc) * s, 0.0) / jnp.maximum(c - 1, 1)
+            var = jnp.where(c >= 2, var, jnp.nan)
             results.append(jnp.sqrt(var) if op == "stddev" else var)
         elif op == "min":
             filled = jnp.where(m, col, _max_ident(col.dtype))
@@ -690,7 +702,12 @@ def _sga_body(gids, mask, ts, values, col_masks, starts, ends, bs, be,
     def seg_sum(col, m, key, square=False):
         ck = (key, square)
         if ck not in cache:
-            v = col * col if square else col
+            if square:
+                # square in float: col*col wraps int columns past ~46k
+                colf = col.astype(jnp.promote_types(col.dtype, jnp.float32))
+                v = colf * colf
+            else:
+                v = col
             cache[ck] = _sorted_seg_sum(jnp.where(m, v, 0), starts, ends, bs,
                                         be, has_inner, n)
         return cache[ck]
@@ -718,10 +735,20 @@ def _sga_body(gids, mask, ts, values, col_masks, starts, ends, bs, be,
             s, c = seg_sum(col, m, i), seg_count(m, i)
             results.append(jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan))
         elif op in ("stddev", "variance"):
-            s = seg_sum(col, m, i)
-            sq = seg_sum(col, m, i, square=True)
-            c = jnp.maximum(seg_count(m, i), 1)
-            var = jnp.maximum(sq / c - (s / c) ** 2, 0.0)
+            # Shifted one-pass moments (see the scatter twin): center on
+            # the global mean before squaring — avoids int wraparound and
+            # f32 cancellation on large, tight value distributions.
+            colf = col.astype(jnp.promote_types(col.dtype, jnp.float32))
+            c = seg_count(m, i)
+            gc = jnp.maximum(jnp.sum(c), 1)
+            shift = jnp.sum(jnp.where(m, colf, 0.0)) / gc
+            d = jnp.where(m, colf - shift, 0.0)
+            s = _sorted_seg_sum(d, starts, ends, bs, be, has_inner, n)
+            sq = _sorted_seg_sum(d * d, starts, ends, bs, be, has_inner, n)
+            cc = jnp.maximum(c, 1)
+            # sample variance (ddof=1, DataFusion convention); <2 rows → NaN
+            var = jnp.maximum(sq - (s / cc) * s, 0.0) / jnp.maximum(c - 1, 1)
+            var = jnp.where(c >= 2, var, jnp.nan)
             results.append(jnp.sqrt(var) if op == "stddev" else var)
         elif op in ("min", "max"):
             is_min = op == "min"

@@ -58,8 +58,13 @@ def _partial_aggregate(gids, mask, ts, row_idx, values, col_masks, *,
     def g_sum(col, i, m, square=False):
         k = ("sumsq" if square else "sum", i)
         if k not in cache:
-            v = col * col if square else col
-            local = jax.ops.segment_sum(jnp.where(m, v, 0).astype(col.dtype),
+            if square:
+                # square in float: col*col wraps int columns past ~46k
+                colf = col.astype(jnp.promote_types(col.dtype, jnp.float32))
+                v, dt = colf * colf, colf.dtype
+            else:
+                v, dt = col, col.dtype
+            local = jax.ops.segment_sum(jnp.where(m, v, 0).astype(dt),
                                         safe_gids, num_segments=seg)[:num_groups]
             cache[k] = jax.lax.psum(local, axes)
         return cache[k]
@@ -79,10 +84,23 @@ def _partial_aggregate(gids, mask, ts, row_idx, values, col_masks, *,
             s, c = g_sum(col, i, m), g_count(i, m)
             results.append(jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan))
         elif op in ("stddev", "variance"):
-            s = g_sum(col, i, m)
-            sq = g_sum(col, i, m, square=True)
-            c = jnp.maximum(g_count(i, m), 1)
-            var = jnp.maximum(sq / c - (s / c) ** 2, 0.0)
+            # Shifted one-pass moments: center on the GLOBAL (psum'd) mean
+            # so every shard shifts identically — avoids int wraparound
+            # and f32 cancellation on large, tight value distributions.
+            colf = col.astype(jnp.promote_types(col.dtype, jnp.float32))
+            c = g_count(i, m)
+            gc = jnp.maximum(jax.lax.psum(jnp.sum(jnp.where(m, 1.0, 0.0)),
+                                          axes), 1.0)
+            shift = jax.lax.psum(jnp.sum(jnp.where(m, colf, 0.0)), axes) / gc
+            d = jnp.where(m, colf - shift, 0.0)
+            s = jax.lax.psum(jax.ops.segment_sum(
+                d, safe_gids, num_segments=seg)[:num_groups], axes)
+            sq = jax.lax.psum(jax.ops.segment_sum(
+                d * d, safe_gids, num_segments=seg)[:num_groups], axes)
+            cc = jnp.maximum(c, 1)
+            # sample variance (ddof=1), matching the finalize in tpu_exec
+            var = jnp.maximum(sq - (s / cc) * s, 0.0) / jnp.maximum(c - 1, 1)
+            var = jnp.where(c >= 2, var, jnp.nan)
             results.append(jnp.sqrt(var) if op == "stddev" else var)
         elif op == "min":
             local = jax.ops.segment_min(

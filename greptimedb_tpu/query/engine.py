@@ -398,8 +398,8 @@ class QueryEngine:
         return grouped
 
     #: ops pandas can run as vectorized groupby reductions with matching
-    #: NULL semantics (sum over all-null = NULL via min_count, population
-    #: stddev/variance via ddof=0, first/last skip nulls in row order)
+    #: NULL semantics (sum over all-null = NULL via min_count, sample
+    #: stddev/variance via ddof=1, first/last skip nulls in row order)
     _FAST_GROUP_OPS = frozenset(
         {"count", "sum", "avg", "min", "max", "stddev", "variance",
          "first", "last"})
@@ -437,9 +437,9 @@ class QueryEngine:
             elif op == "max":
                 r = s.max()
             elif op == "stddev":
-                r = s.std(ddof=0)
+                r = s.std(ddof=1)
             elif op == "variance":
-                r = s.var(ddof=0)
+                r = s.var(ddof=1)
             elif op == "first":
                 r = s.first()
             else:

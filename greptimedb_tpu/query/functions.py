@@ -258,10 +258,11 @@ AGGREGATE_FUNCTIONS: Dict[str, Callable] = {
     "mean": lambda a: AGGREGATE_FUNCTIONS["avg"](a),
     "min": lambda a: (lambda v: v.min() if v.size else None)(_valid(a)),
     "max": lambda a: (lambda v: v.max() if v.size else None)(_valid(a)),
-    "stddev": lambda a: (lambda v: float(v.astype(np.float64).std())
-                         if v.size else None)(_valid(a)),
-    "variance": lambda a: (lambda v: float(v.astype(np.float64).var())
-                           if v.size else None)(_valid(a)),
+    # sample (ddof=1) to match DataFusion and the window path; <2 rows → NULL
+    "stddev": lambda a: (lambda v: float(v.astype(np.float64).std(ddof=1))
+                         if v.size >= 2 else None)(_valid(a)),
+    "variance": lambda a: (lambda v: float(v.astype(np.float64).var(ddof=1))
+                           if v.size >= 2 else None)(_valid(a)),
     "argmax": _agg_argmax,
     "argmin": _agg_argmin,
     "percentile": _agg_percentile,

@@ -939,8 +939,11 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
             out[slot] = np.where(c > 0, s / np.maximum(c, 1), np.nan)
         elif op in ("stddev", "variance"):
             s, sq, c = (merged[m] for m in mslots)
-            c = np.maximum(c, 1)
-            var = np.maximum(sq / c - (s / c) ** 2, 0.0)
+            cc = np.maximum(c, 1)
+            # sample variance (ddof=1) to match DataFusion; <2 rows → NULL;
+            # s/cc promotes to float BEFORE the square — s*s wraps int cols
+            var = np.maximum(sq - (s / cc) * s, 0.0) / np.maximum(c - 1, 1)
+            var = np.where(c >= 2, var, np.nan)
             out[slot] = np.sqrt(var) if op == "stddev" else var
     # null out empty-count aggregates (kernel yields NaN already for floats)
     for slot, op, mslots in plan.finals:

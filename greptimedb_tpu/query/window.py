@@ -12,7 +12,8 @@ Semantics notes:
   peer rows (ties on the order key) share the frame, so running aggregates
   are adjusted to the value at the last peer of each tie group.
 - ROWS frames use exact row offsets (rolling windows).
-- NULL order keys sort last and are peers of each other.
+- NULL order keys sort as the largest value (Postgres default: NULLS LAST
+  for ASC, NULLS FIRST for DESC) and are peers of each other.
 """
 
 from __future__ import annotations
@@ -66,11 +67,19 @@ def _one_window(df: pd.DataFrame, ev: Evaluator, wc: WindowCall) -> pd.Series:
         work[f"__a{j}"] = ev.series(ev.eval(arg))
 
     # order within partitions: stable sort by (partition, order) so rows of
-    # one partition are contiguous and ordered; NULLs last
+    # one partition are contiguous and ordered. NULL order keys follow the
+    # Postgres default (NULLS LAST for ASC, NULLS FIRST for DESC): pandas
+    # has one global na_position, so each order key gets an isna flag key
+    # sorted in the key's own direction (nulls sort as the "largest" value).
     if pkeys or okeys:
-        work = work.sort_values(
-            pkeys + okeys, ascending=[True] * len(pkeys) + asc,
-            kind="stable", na_position="last")
+        sort_cols = pkeys[:]
+        sort_asc = [True] * len(pkeys)
+        for j, up in enumerate(asc):
+            work[f"__on{j}"] = work[f"__o{j}"].isna()
+            sort_cols += [f"__on{j}", f"__o{j}"]
+            sort_asc += [up, up]
+        work = work.sort_values(sort_cols, ascending=sort_asc,
+                                kind="stable", na_position="last")
     n = len(work)
     pos = np.arange(n)
 

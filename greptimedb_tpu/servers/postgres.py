@@ -64,7 +64,11 @@ def _decode_binary_param(raw: bytes, oid: int) -> str:
     if oid in (1114, 1184) and n == 8:       # timestamp[tz]: µs since 2000
         us = int.from_bytes(raw, "big", signed=True) + _PG_EPOCH_US
         import datetime as _dt
-        dt = _dt.datetime.fromtimestamp(us / 1e6, _dt.timezone.utc)
+        # integer µs math: float-seconds rounds the last digit at
+        # current-epoch magnitudes (float64 resolution ~0.24µs there)
+        sec, us_rem = divmod(us, 1_000_000)
+        dt = _dt.datetime.fromtimestamp(sec, _dt.timezone.utc) \
+            + _dt.timedelta(microseconds=us_rem)
         return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
     if oid == 1082 and n == 4:               # date: days since 2000-01-01
         days = int.from_bytes(raw, "big", signed=True)

@@ -61,8 +61,6 @@ def tokenize(sql: str) -> List[Token]:
         m = _MASTER.match(sql, i)
         if m is None:
             c = sql[i]
-            if sql.startswith("/*", i):
-                raise TokenizeError(f"unterminated block comment at {i}")
             if c in "'\"`":
                 # unterminated quote (the regex only matches closed ones)
                 _read_quoted(sql, i, c)
@@ -90,6 +88,10 @@ def tokenize(sql: str) -> List[Token]:
                 body = body.replace(q + q, q)
             append(Token(QIDENT, body, i))
         else:
+            if text == "/" and sql.startswith("/*", i):
+                # bcomment branch only matches *closed* comments; an open
+                # one falls through to the op branch as '/' then '*'
+                raise TokenizeError(f"unterminated block comment at {i}")
             append(Token(OP, text, i))
         i = j
     toks.append(Token(EOF, "", n))

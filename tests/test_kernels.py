@@ -94,6 +94,25 @@ class TestGroupedAggregate:
         assert counts[1] == 0 and counts[2] == 0
         assert np.isnan(a[1])
 
+    def test_variance_large_tight_values(self):
+        """Shifted-moment regression: int columns must not wrap on
+        squaring, and f32 cancellation must not floor the variance of
+        large, tight distributions (review r4)."""
+        from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
+        gids = np.zeros(3, np.int32)
+        mask = np.ones(3, bool)
+        ts = np.arange(3, dtype=np.int32)
+        for vals in (np.array([100000, 100000, 100001], np.int32),
+                     np.array([100000.0, 100000.0, 100001.0], np.float32)):
+            (v1,), _ = grouped_aggregate(
+                jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(ts),
+                (jnp.asarray(vals),), num_groups=1, ops=("variance",))
+            (v2,), _ = sorted_grouped_aggregate(
+                gids, mask, ts, (jnp.asarray(vals),), num_groups=1,
+                ops=("variance",))
+            np.testing.assert_allclose(float(v1[0]), 1 / 3, rtol=1e-3)
+            np.testing.assert_allclose(float(v2[0]), 1 / 3, rtol=1e-3)
+
     def test_stddev(self):
         gids, mask, ts, vals, G = self._data(seed=3)
         (sd,), counts = grouped_aggregate(
@@ -102,7 +121,8 @@ class TestGroupedAggregate:
         for g in range(G):
             sel = (gids == g) & mask
             if sel.sum() > 1:
-                np.testing.assert_allclose(sd[g], vals[sel].std(), rtol=1e-6)
+                np.testing.assert_allclose(sd[g], vals[sel].std(ddof=1),
+                                           rtol=1e-6)
 
     def test_time_bucket_combine(self):
         ts = jnp.array([0, 999, 1000, 2500], dtype=jnp.int32)

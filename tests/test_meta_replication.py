@@ -104,6 +104,55 @@ class TestElection:
                 nd.stop()
 
 
+class TestBatch:
+    def test_batch_atomic_and_guarded(self):
+        """rename_table_route's multi-op: all-or-nothing under a guard,
+        replicated as ONE raft command (advisor r3: the old two-step CAS
+        + delete could crash half-renamed)."""
+        nodes = make_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            kv.put("route/old", b"r1")
+            kv.put("tinfo/old", b"i1")
+            ok = kv.batch(
+                [("put", "route/new", b"r1"), ("delete", "route/old", None),
+                 ("put", "tinfo/new", b"i1"), ("delete", "tinfo/old", None)],
+                guard=("route/new", None))
+            assert ok
+            assert kv.get("route/new") == b"r1"
+            assert kv.get("route/old") is None
+            assert kv.get("tinfo/new") == b"i1"
+            # guard failure: nothing applied
+            kv.put("route/back", b"x")
+            ok = kv.batch(
+                [("put", "route/clobber", b"y"),
+                 ("delete", "route/new", None)],
+                guard=("route/back", None))     # exists -> guard fails
+            assert not ok
+            assert kv.get("route/clobber") is None
+            assert kv.get("route/new") == b"r1"
+            # the whole move is one log entry on every replica
+            follower = next(nd for nd in nodes if nd is not leader)
+            wait_for(lambda: follower.applied_idx == leader.applied_idx,
+                     what="follower apply")
+            assert follower.state.get("route/new") == b"r1"
+            assert "route/old" not in follower.state
+        finally:
+            for nd in nodes:
+                nd.stop()
+
+    def test_memkv_batch_guard(self):
+        from greptimedb_tpu.meta.kv import MemKv
+        kv = MemKv()
+        kv.put("a", b"1")
+        assert kv.batch([("put", "b", b"2"), ("delete", "a", None)],
+                        guard=("b", None))
+        assert kv.get("a") is None and kv.get("b") == b"2"
+        assert not kv.batch([("put", "c", b"3")], guard=("b", None))
+        assert kv.get("c") is None
+
+
 class TestReplication:
     def test_writes_survive_leader_kill(self, tmp_path):
         nodes = make_cluster(3, tmp_path)

@@ -248,6 +248,11 @@ def test_review_regressions():
     # a column named `time` coexists with the TIME INDEX constraint
     st = parse_sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, time BIGINT)")
     assert [c.name for c in st.columns] == ["ts", "time"]
+    # an unterminated block comment must error, not parse as division
+    # (advisor r3: the master-regex bcomment branch only matches closed
+    # comments, so '/*' fell through to the op branch as '/' then '*')
+    with pytest.raises((ParserError, ValueError), match="unterminated"):
+        parse_sql("SELECT a /* b FROM t")
     st2 = parse_sql("CREATE TABLE t (ts TIMESTAMP, TIMESTAMP_INDEX(ts))")
     assert st2.time_index == "ts"
     # leading-zero ints parse as base 10; bad ints raise ParserError

@@ -173,6 +173,14 @@ class TestEdgeCases:
         # values: 3,1,4,NULL,5 -> ranks 2,1,3,5,4
         assert [r[1] for r in got] == [2, 1, 3, 5, 4]
 
+    def test_desc_order_nulls_first(self, fe):
+        # Postgres default: NULLS FIRST when the order key is DESC
+        # (advisor r3: na_position='last' applied regardless of direction)
+        got = rows(fe, "SELECT ts, rank() OVER (ORDER BY v DESC) FROM w "
+                       "WHERE host = 'a' ORDER BY ts")
+        # values by ts: 3,1,4,NULL,5; desc order is NULL,5,4,3,1
+        assert [r[1] for r in got] == [4, 5, 3, 1, 2]
+
     def test_desc_order(self, fe):
         got = col(fe, "SELECT ts, row_number() OVER (ORDER BY v DESC) "
                       "FROM w WHERE host = 'a' AND v IS NOT NULL "
