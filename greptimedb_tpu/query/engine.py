@@ -19,12 +19,12 @@ from ..datatypes import data_type as dt
 from ..datatypes.record_batch import RecordBatch
 from ..datatypes.schema import ColumnSchema, Schema, SemanticType
 from ..errors import (
-    PlanError, TableNotFoundError, UnsupportedError)
+    ColumnNotFoundError, PlanError, TableNotFoundError, UnsupportedError)
 from ..session import QueryContext
 from ..sql.ast import (
-    Column, DescribeTable, Explain, FunctionCall, Query, SetQuery,
-    ShowCreateTable, ShowDatabases, ShowTables, ShowVariable, Star,
-    Statement, TableRef)
+    Column, DescribeTable, Explain, Expr, FunctionCall, InList, Literal,
+    Query, SetQuery, ShowCreateTable, ShowDatabases, ShowTables,
+    ShowVariable, Star, Statement, TableRef, WindowSpec)
 from ..table.table import Table
 from .expr import Evaluator, expr_name, like_to_regex
 from .functions import AGGREGATE_FUNCTIONS
@@ -118,6 +118,9 @@ class QueryEngine:
 
     # ---- SELECT ----
     def execute_query(self, query: Query, ctx: QueryContext) -> Output:
+        if isinstance(query, SetQuery):     # e.g. a UNION-bodied CTE /
+            return self.execute_set_query(query, ctx)  # derived table
+        self._rewrite_query_subqueries(query, ctx)
         a = analyze(query)
         if query.joins:
             return self._execute_join(query, a, ctx)
@@ -314,6 +317,183 @@ class QueryEngine:
 
     def _needs_all(self, a: Analysis, query: Query) -> bool:
         return any(isinstance(p.expr, Star) for p in query.projections)
+
+    # ---- expression subqueries (IN / EXISTS / scalar) ----
+    def _rewrite_query_subqueries(self, query: Query,
+                                  ctx: QueryContext) -> None:
+        """Execute uncorrelated expression subqueries up front and
+        substitute their results as literals. The reference gets these
+        from DataFusion's subquery decorrelation; the literal form also
+        lets the TPU plan see IN lists as ordinary tag predicates."""
+        if query.where is not None:
+            query.where = self._rewrite_subqueries(query.where, ctx)
+        if query.having is not None:
+            query.having = self._rewrite_subqueries(query.having, ctx)
+        for item in query.projections:
+            item.expr = self._rewrite_subqueries(item.expr, ctx)
+        query.group_by = [self._rewrite_subqueries(e, ctx)
+                          for e in query.group_by]
+        query.order_by = [(self._rewrite_subqueries(e, ctx), asc)
+                          for e, asc in query.order_by]
+
+    def _rewrite_subqueries(self, e, ctx: QueryContext):
+        from ..sql.ast import Subquery
+        if e is None or isinstance(e, (Literal, Column, Star)):
+            return e
+        if isinstance(e, Subquery):        # scalar subquery
+            vals = self._subquery_values(e.query, ctx, what="scalar")
+            if len(vals) > 1:
+                raise PlanError(
+                    "more than one row returned by a scalar subquery")
+            return Literal(vals[0] if vals else None)
+        if isinstance(e, InList) and any(
+                isinstance(i, Subquery) for i in e.items):
+            # expand every subquery item in place, keeping literal items
+            items: list = []
+            has_null = False
+            for i in e.items:
+                if isinstance(i, Subquery):
+                    for v in self._subquery_values(i.query, ctx, what="IN"):
+                        if v is None:
+                            has_null = True
+                        else:
+                            items.append(Literal(v))
+                else:
+                    items.append(self._rewrite_subqueries(i, ctx))
+            e.expr = self._rewrite_subqueries(e.expr, ctx)
+            if not items and not has_null:
+                # IN (empty) is FALSE, NOT IN (empty) is TRUE
+                return Literal(bool(e.negated))
+            if has_null:
+                # three-valued logic: a NULL in the list means "no match"
+                # is UNKNOWN, never FALSE — so IN is TRUE-or-NULL and
+                # NOT IN is FALSE-or-NULL (kills the whole NOT IN filter)
+                from ..sql.ast import Case
+                match = InList(e.expr, items, negated=False) if items \
+                    else Literal(False)
+                hit = Literal(not e.negated)
+                return Case(operand=None, whens=[(match, hit)],
+                            else_=Literal(None))
+            e.items = items
+            return e
+        if isinstance(e, FunctionCall) and e.name == "exists" and \
+                e.args and isinstance(e.args[0], Subquery):
+            import copy as _copy
+            q = _copy.deepcopy(e.args[0].query)
+            self._reject_correlated(q, "EXISTS")
+            if isinstance(q, Query) and q.limit is None:
+                q.limit = 1                # existence needs one row, but
+            out = self.execute_query(q, ctx)  # honor an explicit LIMIT 0
+            return Literal(out.num_rows > 0)
+        for name, v in vars(e).items():
+            if isinstance(v, Expr):
+                setattr(e, name, self._rewrite_subqueries(v, ctx))
+            elif isinstance(v, WindowSpec):
+                v.partition_by = [self._rewrite_subqueries(x, ctx)
+                                  for x in v.partition_by]
+                v.order_by = [(self._rewrite_subqueries(x, ctx), asc)
+                              for x, asc in v.order_by]
+            elif isinstance(v, list):
+                setattr(e, name, [
+                    self._rewrite_subqueries(x, ctx) if isinstance(x, Expr)
+                    else tuple(self._rewrite_subqueries(y, ctx)
+                               if isinstance(y, Expr) else y for y in x)
+                    if isinstance(x, tuple) else x
+                    for x in v])
+        return e
+
+    def _reject_correlated(self, q, what: str) -> None:
+        """Refuse subqueries whose qualified column refs name a table or
+        alias not defined inside the subquery itself — those are outer
+        references, and running them against inner scope silently drops
+        the correlation (the bare-name case resolves innermost-first,
+        which matches SQL scoping and needs no check)."""
+        defined: set = set()
+        quals: set = set()
+
+        def walk_expr(e) -> None:
+            if e is None or isinstance(e, (Literal, Star)):
+                return
+            if isinstance(e, Column):
+                if e.table:
+                    quals.add(e.table.lower())
+                return
+            from ..sql.ast import Subquery
+            if isinstance(e, Subquery):
+                walk_query(e.query)
+                return
+            for v in vars(e).values():
+                if isinstance(v, Expr):
+                    walk_expr(v)
+                elif isinstance(v, WindowSpec):
+                    for x in v.partition_by:
+                        walk_expr(x)
+                    for x, _ in v.order_by:
+                        walk_expr(x)
+                elif isinstance(v, list):
+                    for x in v:
+                        if isinstance(x, Expr):
+                            walk_expr(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, Expr):
+                                    walk_expr(y)
+
+        def walk_query(node) -> None:
+            if isinstance(node, SetQuery):
+                walk_query(node.left)
+                walk_query(node.right)
+                for e, _ in node.order_by:
+                    walk_expr(e)
+                return
+            if not isinstance(node, Query):
+                return
+            for ref in [node.from_] + [j.table for j in node.joins]:
+                if ref is None:
+                    continue
+                if ref.alias:
+                    defined.add(ref.alias.lower())
+                if ref.name is not None:
+                    defined.add(ref.name.table.lower())
+                if ref.subquery is not None:
+                    walk_query(ref.subquery)
+            for item in node.projections:
+                walk_expr(item.expr)
+            for e in (node.where, node.having):
+                walk_expr(e)
+            for e in node.group_by:
+                walk_expr(e)
+            for e, _ in node.order_by:
+                walk_expr(e)
+            for j in node.joins:
+                walk_expr(j.on)
+
+        walk_query(q)
+        outer = quals - defined
+        if outer:
+            raise UnsupportedError(
+                f"correlated {what} subqueries are not supported "
+                f"(outer reference{'s' if len(outer) > 1 else ''}: "
+                f"{', '.join(sorted(outer))})")
+
+    def _subquery_values(self, q: Query, ctx: QueryContext,
+                         what: str) -> list:
+        """Run an uncorrelated subquery, returning its single column."""
+        self._reject_correlated(q, what)
+        try:
+            out = self.execute_query(q, ctx)
+        except ColumnNotFoundError as err:
+            raise UnsupportedError(
+                f"correlated {what} subqueries are not supported") from err
+        cols = out.batches[0].columns if out.batches else []
+        if out.batches and len(cols) != 1:
+            raise PlanError(
+                f"{what} subquery must return exactly one column, "
+                f"got {len(cols)}")
+        vals: list = []
+        for rb in out.batches:
+            vals.extend(rb.columns[0].to_pylist())
+        return vals
 
     # ---- fallback execution over a DataFrame ----
     def _run_on_frame(self, df: pd.DataFrame, a: Analysis, query: Query,

@@ -104,7 +104,7 @@ class Evaluator:
         if isinstance(e, UnaryOp):
             v = self.eval(e.operand)
             if e.op == "not":
-                return ~self._as_bool(v)
+                return self._negate(self._as_bool(v))
             if e.op == "-":
                 return -self._num(v)
             return v
@@ -115,7 +115,7 @@ class Evaluator:
             lo = self.eval(e.low)
             hi = self.eval(e.high)
             out = (v >= lo) & (v <= hi)
-            return ~self._as_bool(out) if e.negated else out
+            return self._negate(self._as_bool(out)) if e.negated else out
         if isinstance(e, InList):
             if any(isinstance(i, Subquery) for i in e.items):
                 raise UnsupportedError("IN (subquery) is not supported yet")
@@ -146,6 +146,12 @@ class Evaluator:
         if isinstance(v, pd.Series):
             return v.fillna(False).astype(bool)
         return bool(v)
+
+    @staticmethod
+    def _negate(b):
+        """Boolean NOT that is safe for scalars: ~True is -2 (truthy!),
+        so Python bools must use `not`, Series use `~`."""
+        return ~b if isinstance(b, pd.Series) else (not b)
 
     def _num(self, v):
         return v

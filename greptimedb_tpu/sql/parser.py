@@ -138,7 +138,7 @@ class Parser:
         if kw == "SELECT" or (t.kind == OP and t.value == "("):
             return self.parse_query()
         if kw == "WITH":
-            raise ParserError("WITH (CTE) queries are not supported yet")
+            return self.parse_with()
         if kw == "CREATE":
             return self.parse_create()
         if kw == "DROP":
@@ -171,6 +171,48 @@ class Parser:
             self.match_kw("TABLE")
             return TruncateTable(name=self.parse_object_name())
         raise ParserError(f"unsupported statement start: {t.value!r} at {t.pos}")
+
+    # ---- WITH (CTE) ----
+    def parse_with(self) -> Statement:
+        """WITH name [(cols)] AS (query) [, ...] SELECT ...
+
+        CTEs are inlined as derived tables (the FROM-subquery form the
+        planner already executes); each reference gets its own deep copy,
+        so a CTE used twice behaves like two subqueries — the reference
+        gets the same semantics from sqlparser-rs + DataFusion
+        (src/sql/src/parsers/query_parser.rs via sqlparser::parse_query).
+        """
+        self.expect_kw("WITH")
+        if self.match_kw("RECURSIVE"):
+            raise ParserError("recursive CTEs are not supported")
+        ctes: dict = {}
+        while True:
+            name = self.parse_identifier()
+            cols: List[str] = []
+            if self.match_op("("):
+                cols.append(self.parse_identifier())
+                while self.match_op(","):
+                    cols.append(self.parse_identifier())
+                self.expect_op(")")
+            self.expect_kw("AS")
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            _inline_ctes(q, ctes)       # earlier CTEs visible to later ones
+            if cols:
+                _apply_cte_column_aliases(q, cols, name)
+            if name.lower() in ctes:
+                raise ParserError(f"duplicate CTE name {name!r}")
+            ctes[name.lower()] = q
+            if not self.match_op(","):
+                break
+        t = self.peek()
+        if not (self.at_kw("SELECT") or (t.kind == OP and t.value == "(")):
+            raise ParserError(
+                f"expected SELECT after WITH clause, found {t.value!r}")
+        body = self.parse_query()
+        _inline_ctes(body, ctes)
+        return body
 
     # ---- SELECT ----
     def parse_query(self) -> Query:
@@ -1086,3 +1128,88 @@ class Parser:
         if neg:
             raise ParserError(f"expected number after '-' at {t.pos}")
         return t.value
+
+
+# --------------------------------------------------------------------------
+# CTE inlining (parse_with): rewrite CTE references into derived tables
+# --------------------------------------------------------------------------
+
+def _inline_ctes(node, ctes: dict) -> None:
+    """Replace every TableRef naming a CTE with a deep copy of the CTE's
+    query as a derived table, recursing through set ops, joins, derived
+    tables, and expression subqueries (EXISTS / IN / scalar)."""
+    if not ctes:
+        return
+    import copy as _copy
+    if isinstance(node, SetQuery):
+        _inline_ctes(node.left, ctes)
+        _inline_ctes(node.right, ctes)
+        for e, _ in node.order_by:
+            _inline_expr(e, ctes)
+        return
+    if not isinstance(node, Query):
+        return
+    for ref in [node.from_] + [j.table for j in node.joins]:
+        if ref is None:
+            continue
+        if ref.subquery is not None:
+            _inline_ctes(ref.subquery, ctes)
+        elif (ref.name is not None and len(ref.name.parts) == 1
+                and ref.name.table.lower() in ctes):
+            cte_q = ctes[ref.name.table.lower()]
+            ref.alias = ref.alias or ref.name.table
+            ref.name = None
+            ref.subquery = _copy.deepcopy(cte_q)
+    for item in node.projections:
+        _inline_expr(item.expr, ctes)
+    for e in (node.where, node.having):
+        if e is not None:
+            _inline_expr(e, ctes)
+    for e in node.group_by:
+        _inline_expr(e, ctes)
+    for e, _ in node.order_by:
+        _inline_expr(e, ctes)
+    for j in node.joins:
+        if j.on is not None:
+            _inline_expr(j.on, ctes)
+
+
+def _inline_expr(e, ctes: dict) -> None:
+    """Walk an expression tree, inlining CTEs inside embedded queries."""
+    if isinstance(e, Subquery):
+        _inline_ctes(e.query, ctes)
+        return
+    for v in vars(e).values():
+        if isinstance(v, Expr):
+            _inline_expr(v, ctes)
+        elif isinstance(v, WindowSpec):
+            for pe in v.partition_by:
+                _inline_expr(pe, ctes)
+            for oe, _ in v.order_by:
+                _inline_expr(oe, ctes)
+        elif isinstance(v, list):
+            for x in v:
+                if isinstance(x, Expr):
+                    _inline_expr(x, ctes)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, Expr):
+                            _inline_expr(y, ctes)
+
+
+def _apply_cte_column_aliases(q, cols: List[str], name: str) -> None:
+    """WITH t(a, b) AS (...) renames the CTE's output columns: alias each
+    branch's projections positionally (Postgres semantics)."""
+    if isinstance(q, SetQuery):
+        _apply_cte_column_aliases(q.left, cols, name)
+        _apply_cte_column_aliases(q.right, cols, name)
+        return
+    if any(isinstance(p.expr, Star) for p in q.projections):
+        raise ParserError(
+            f"CTE {name!r}: a column list cannot rename SELECT *")
+    if len(q.projections) != len(cols):
+        raise ParserError(
+            f"CTE {name!r} has {len(cols)} column names but its SELECT "
+            f"returns {len(q.projections)} columns")
+    for p, c in zip(q.projections, cols):
+        p.alias = c

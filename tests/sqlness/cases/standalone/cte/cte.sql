@@ -1,0 +1,23 @@
+CREATE TABLE m (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1000, 1.0), ('a', 2000, 3.0), ('b', 1000, 5.0), ('b', 2000, 7.0);
+
+WITH hot AS (SELECT host, avg(cpu) AS c FROM m GROUP BY host) SELECT * FROM hot ORDER BY host;
+
+WITH hot AS (SELECT host, avg(cpu) AS c FROM m GROUP BY host) SELECT max(c) FROM hot;
+
+WITH hot AS (SELECT host, avg(cpu) AS c FROM m GROUP BY host) SELECT x.host, x.c + y.c AS s FROM hot x JOIN hot y ON x.host = y.host ORDER BY x.host;
+
+WITH a(h, c) AS (SELECT host, avg(cpu) FROM m GROUP BY host), b AS (SELECT h FROM a WHERE c > 3) SELECT * FROM b;
+
+WITH hot AS (SELECT host FROM m WHERE cpu > 6) SELECT count(*) FROM hot;
+
+WITH u AS (SELECT host FROM m WHERE cpu < 2 UNION ALL SELECT host FROM m WHERE cpu > 6) SELECT host FROM u ORDER BY host;
+
+WITH lim AS (SELECT host, cpu FROM m ORDER BY cpu DESC LIMIT 2) SELECT host, cpu FROM lim ORDER BY host, cpu;
+
+WITH RECURSIVE r AS (SELECT 1) SELECT * FROM r;
+
+WITH dup AS (SELECT 1), dup AS (SELECT 2) SELECT * FROM dup;
+
+DROP TABLE m;

@@ -231,6 +231,30 @@ def test_string_escapes_and_comments():
     assert q.projections[1].expr.name == "quoted col"
 
 
+def test_cte_inlining():
+    # CTEs inline as derived tables; each reference is an independent copy
+    q = parse_sql("WITH a AS (SELECT host, avg(cpu) c FROM m GROUP BY host)"
+                  " SELECT x.host FROM a x JOIN a y ON x.host = y.host")
+    assert q.from_.subquery is not None and q.from_.alias == "x"
+    assert q.joins[0].table.subquery is not None
+    assert q.from_.subquery is not q.joins[0].table.subquery
+    # column list renames projections positionally
+    q2 = parse_sql("WITH a(h, c) AS (SELECT host, avg(cpu) FROM m "
+                   "GROUP BY host) SELECT h FROM a")
+    assert [p.alias for p in q2.from_.subquery.projections] == ["h", "c"]
+    # chained CTEs: later ones see earlier ones
+    q3 = parse_sql("WITH a AS (SELECT host FROM m), b AS (SELECT host "
+                   "FROM a) SELECT * FROM b")
+    assert q3.from_.subquery.from_.subquery is not None
+    # CTE names are not visible outside their statement / inside exprs
+    with pytest.raises(ParserError, match="recursive"):
+        parse_sql("WITH RECURSIVE r AS (SELECT 1) SELECT * FROM r")
+    with pytest.raises(ParserError, match="duplicate"):
+        parse_sql("WITH d AS (SELECT 1), d AS (SELECT 2) SELECT * FROM d")
+    with pytest.raises(ParserError, match="column names"):
+        parse_sql("WITH a(x, y) AS (SELECT host FROM m) SELECT * FROM a")
+
+
 def test_error_reporting():
     with pytest.raises(ParserError):
         parse_sql("SELECT FROM")
