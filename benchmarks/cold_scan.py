@@ -1,0 +1,149 @@
+"""Cold-scan benchmark: TSBS-shaped queries over REAL stored SSTs.
+
+Unlike the kernel microbenches (suite.py configs 2/3) this measures the
+whole database path: Parquet decode → slice merge/dedup → H2D → device
+moment kernel → fold, via the block-streaming executor
+(query/stream_exec.py), against a region ingested and flushed through
+the real write path. Reports cold (streamed, nothing resident) and warm
+(device scan cache) throughput side by side.
+
+Usage:
+    python benchmarks/cold_scan.py --rows 50000000 [--hosts 4000]
+                                   [--slice-rows 16000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+
+def _p(name, value, unit, extra=None):
+    doc = {"bench": name, "value": round(value, 2), "unit": unit}
+    if extra:
+        doc.update(extra)
+    print(json.dumps(doc), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=50_000_000)
+    ap.add_argument("--hosts", type=int, default=4000)
+    ap.add_argument("--ssts", type=int, default=8)
+    ap.add_argument("--slice-rows", type=int, default=16_000_000)
+    ap.add_argument("--keep-dir", default=None,
+                    help="reuse/keep the data dir (skips ingest when the "
+                         "row count matches)")
+    args = ap.parse_args()
+
+    from greptimedb_tpu.common.jax_cache import enable_compile_cache
+    enable_compile_cache("/tmp/coldscan-xla-cache")
+    from greptimedb_tpu.datanode.instance import (
+        DatanodeInstance, DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    from greptimedb_tpu.query import stream_exec, tpu_exec
+    from greptimedb_tpu.session import QueryContext
+
+    tmpdir = args.keep_dir or tempfile.mkdtemp(prefix="coldscan-")
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=tmpdir, register_numbers_table=False))
+    dn.start()
+    fe = FrontendInstance(dn)
+    fe.start()
+    ctx = QueryContext()
+
+    existing = None
+    try:
+        existing = fe.catalog.table("greptime", "public", "cpu")
+    except Exception:
+        existing = None
+
+    if existing is None:
+        fe.do_query("CREATE TABLE cpu (hostname STRING, ts TIMESTAMP TIME "
+                    "INDEX, usage_user DOUBLE, usage_system DOUBLE, "
+                    "PRIMARY KEY(hostname))")
+    table = fe.catalog.table("greptime", "public", "cpu")
+    region = next(iter(table.regions.values()))
+    have = stream_exec.region_estimated_rows(region)
+
+    n = args.rows
+    if have < n:
+        # TSBS devops shape: H hosts, one point per host per 10s interval
+        rng = np.random.default_rng(42)
+        per_sst = n // args.ssts
+        points_per_host = max(per_sst // args.hosts, 1)
+        hostnames = np.array([f"host_{i}" for i in range(args.hosts)])
+        t_load = time.perf_counter()
+        for s in range(args.ssts):
+            base = s * points_per_host * 10_000
+            ts = np.tile(np.arange(points_per_host, dtype=np.int64)
+                         * 10_000 + base, args.hosts)
+            host = np.repeat(hostnames, points_per_host)
+            k = len(ts)
+            table.insert({
+                "hostname": host, "ts": ts,
+                "usage_user": (rng.random(k) * 100).round(2),
+                "usage_system": (rng.random(k) * 100).round(2)})
+            table.flush()
+            print(f"  ingested sst {s + 1}/{args.ssts} "
+                  f"({(s + 1) * k:,} rows)", flush=True)
+        load_dt = time.perf_counter() - t_load
+        n = args.ssts * args.hosts * points_per_host
+        _p("ingest_bulk", n / load_dt / 1e6, "Mrows/s",
+           {"rows": n, "seconds": round(load_dt, 1)})
+    else:
+        n = have
+
+    queries = {
+        "single_groupby": "SELECT hostname, avg(usage_user) FROM cpu "
+                          "GROUP BY hostname",
+        "double_groupby": "SELECT hostname, date_bin(INTERVAL '1 hour', ts)"
+                          " AS bucket, avg(usage_user), avg(usage_system) "
+                          "FROM cpu GROUP BY hostname, bucket",
+    }
+
+    # ---- cold: force streaming, nothing resident ----
+    stream_exec.configure_streaming(threshold_rows=1,
+                                    slice_rows=args.slice_rows)
+    tpu_exec.SCAN_CACHE._entries.clear()
+    for qname, sql in queries.items():
+        # once to absorb XLA compile (reported separately), once timed
+        t0 = time.perf_counter()
+        out = fe.do_query(sql, ctx)
+        first_dt = time.perf_counter() - t0
+        tpu_exec.SCAN_CACHE._entries.clear()
+        t0 = time.perf_counter()
+        out = fe.do_query(sql, ctx)
+        dt = time.perf_counter() - t0
+        if isinstance(out, list):
+            out = out[0]
+        groups = out.num_rows
+        _p(f"cold_stream_{qname}", n / dt / 1e6, "Mrows/s",
+           {"rows": n, "seconds": round(dt, 2), "groups": groups,
+            "first_run_s": round(first_dt, 2)})
+
+    # ---- warm: cached device path (only when the region fits) ----
+    stream_exec.configure_streaming(threshold_rows=1 << 62)
+    if n <= 120_000_000:
+        fe.do_query(queries["single_groupby"], ctx)   # build cache
+        for qname, sql in queries.items():
+            t0 = time.perf_counter()
+            fe.do_query(sql, ctx)
+            dt = time.perf_counter() - t0
+            _p(f"warm_cached_{qname}", n / dt / 1e6, "Mrows/s",
+               {"rows": n, "seconds": round(dt, 3)})
+
+    fe.shutdown()
+    if args.keep_dir is None:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    elif args.keep_dir:
+        print(f"  data kept in {tmpdir}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

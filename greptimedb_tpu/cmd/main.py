@@ -22,6 +22,9 @@ class StandaloneOptions:
     mysql_addr: str = "127.0.0.1:4002"
     postgres_addr: str = "127.0.0.1:4003"
     grpc_addr: str = "127.0.0.1:4001"
+    #: OpenTSDB telnet `put` listener; empty/None = disabled (reference
+    #: serves it on 4242, src/servers/src/opentsdb.rs:60)
+    opentsdb_addr: Optional[str] = None
     user_provider: Optional[str] = None
     enable_mysql: bool = True
     enable_postgres: bool = True
@@ -33,6 +36,9 @@ class StandaloneOptions:
     #: [tls] table: mode=disable|prefer|require + cert/key paths
     #: (reference: TlsOption, servers/src/tls.rs)
     tls: dict = field(default_factory=dict)
+    #: [query] table: stream_threshold_rows / stream_slice_rows (cold-scan
+    #: streaming), scan_cache_budget_mb (device scan cache bound)
+    query: dict = field(default_factory=dict)
     log_dir: Optional[str] = None
 
 
@@ -55,12 +61,16 @@ def load_options(args) -> StandaloneOptions:
         grpc = doc.get("grpc", {})
         opts.grpc_addr = grpc.get("addr", opts.grpc_addr)
         opts.enable_grpc = grpc.get("enable", True)
+        tsdb = doc.get("opentsdb", {})
+        if tsdb.get("enable", False):
+            opts.opentsdb_addr = tsdb.get("addr", "127.0.0.1:4242")
         logging_doc = doc.get("logging", {})
         opts.log_level = logging_doc.get("level", opts.log_level)
         opts.log_dir = logging_doc.get("dir", opts.log_dir)
         opts.tls = doc.get("tls", {})
+        opts.query = doc.get("query", {})
     for name in ("data_home", "http_addr", "mysql_addr", "postgres_addr",
-                 "grpc_addr", "user_provider"):
+                 "grpc_addr", "opentsdb_addr", "user_provider"):
         v = getattr(args, name, None)
         if v is not None:
             setattr(opts, name, v)
@@ -74,6 +84,15 @@ def build_servers(opts: StandaloneOptions):
     from ..servers.auth import NoopUserProvider, StaticUserProvider
     from ..servers.http import HttpServer
 
+    if opts.query:
+        from ..query.stream_exec import configure_streaming
+        configure_streaming(
+            threshold_rows=opts.query.get("stream_threshold_rows"),
+            slice_rows=opts.query.get("stream_slice_rows"))
+        budget_mb = opts.query.get("scan_cache_budget_mb")
+        if budget_mb is not None:
+            from ..query.tpu_exec import SCAN_CACHE
+            SCAN_CACHE.configure(budget_bytes=int(budget_mb) << 20)
     store = None
     if opts.storage and str(opts.storage.get("type", "File")) != "File":
         from ..storage.object_store import build_object_store
@@ -110,6 +129,10 @@ def build_servers(opts: StandaloneOptions):
     if opts.enable_grpc:
         from ..servers.grpc import GrpcServer
         servers.append(GrpcServer(fe, provider, opts.grpc_addr))
+    if opts.opentsdb_addr:
+        from ..servers.opentsdb import OpentsdbServer
+        host, port = split_addr(opts.opentsdb_addr)
+        servers.append(OpentsdbServer(fe, host=host, port=port))
     return fe, servers
 
 
@@ -340,6 +363,7 @@ def main(argv=None) -> int:
     start.add_argument("--mysql-addr")
     start.add_argument("--postgres-addr")
     start.add_argument("--grpc-addr")
+    start.add_argument("--opentsdb-addr")
     start.add_argument("--user-provider")
     start.set_defaults(func=standalone_start)
 

@@ -82,6 +82,15 @@ class Vector:
                                 dtype=object)
                 return Vector(dtype, data, ~isnull)
         n = len(values)
+        if isinstance(values, list) and n and not dtype.is_string \
+                and not dtype.is_binary and dtype.np_dtype is not None:
+            # clean numeric lists convert at C speed; None/mixed content
+            # raises and falls through to the validating per-value loop
+            try:
+                return Vector(dtype, np.asarray(values,
+                                                dtype=dtype.np_dtype))
+            except (ValueError, TypeError):
+                pass
         validity = np.ones(n, dtype=bool)
         if dtype.is_string or dtype.is_binary:
             data = np.empty(n, dtype=object)
@@ -141,11 +150,15 @@ class Vector:
         if arr.null_count:
             validity = np.asarray(arr.is_valid())
         if dtype.is_string or dtype.is_binary:
-            data = np.empty(n, dtype=object)
-            pylist = arr.to_pylist()
-            default = dtype.default_value()
-            for i, v in enumerate(pylist):
-                data[i] = default if v is None else v
+            # zero_copy_only=False yields an object ndarray with None at
+            # nulls — filled vectorized (the per-value loop cost ~0.4s/2M)
+            data = arr.to_numpy(zero_copy_only=False)
+            if data.dtype != object:
+                data = data.astype(object)
+            else:
+                data = data.copy()
+            if validity is not None:
+                data[~validity] = dtype.default_value()
         elif dtype.is_timestamp:
             data = np.asarray(arr.cast(pa.int64()).fill_null(0), dtype=np.int64)
         elif dtype is dt.DATE:
@@ -162,6 +175,11 @@ class Vector:
     def to_arrow(self) -> pa.Array:
         mask = None if self.validity is None else ~self.validity
         if self.dtype.is_string or self.dtype.is_binary:
+            if isinstance(self.data, np.ndarray):
+                # pa.array consumes object/<U ndarrays + mask at C speed;
+                # the list() round trip costs ~0.5s per 2M rows
+                return pa.array(self.data, type=self.dtype.pa_type,
+                                mask=mask)
             vals = list(self.data)
             if mask is not None:
                 vals = [None if m else v for v, m in zip(vals, mask)]

@@ -18,6 +18,8 @@ import json
 import threading
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from .. import MITO_ENGINE
 from ..common.time import TimestampRange
 from ..datatypes.record_batch import RecordBatch
@@ -139,6 +141,34 @@ class MitoTable(Table):
             wb.put(part)
             region.write(wb)
             written += num_rows if idx is None else len(idx)
+        return written
+
+    def bulk_load(self, columns: Dict[str, Sequence]) -> int:
+        """WAL-less bulk ingestion straight to SSTs (COPY FROM / loader
+        path): same routing as insert, ~10x the throughput of the
+        WAL+memtable write path (Region.bulk_ingest)."""
+        if not columns:
+            return 0
+        num_rows = len(next(iter(columns.values())))
+        for name, vals in columns.items():
+            if len(vals) != num_rows:
+                raise InvalidArgumentsError(
+                    f"ragged bulk_load column {name!r}")
+        splits = split_rows(self.partition_rule, columns, num_rows) \
+            if self.partition_rule is not None \
+            else {min(self.regions): None}
+        written = 0
+        for rnum, idx in splits.items():
+            region = self.regions.get(rnum)
+            if region is None:
+                raise RegionNotFoundError(
+                    f"rows target region {rnum}, which this node does not "
+                    f"host for table {self.info.name}")
+            part = columns if idx is None else \
+                {k: np.asarray(v, dtype=object)[idx]
+                 if not isinstance(v, np.ndarray) else v[idx]
+                 for k, v in columns.items()}
+            written += region.bulk_ingest(part)
         return written
 
     def delete(self, key_columns: Dict[str, Sequence]) -> int:

@@ -1,0 +1,354 @@
+"""Block-streamed cold scan: aggregate regions too large to cache in HBM.
+
+The cached fast path (tpu_exec.SCAN_CACHE) materializes a region's merged
+scan in host memory with device-resident mirrors — right for hot regions
+that fit, impossible for regions larger than device (or host) memory.
+This module streams instead:
+
+1. The region's key domain is partitioned into contiguous slices sized
+   by parquet row-group statistics (a row-budget per slice). The
+   partition axis adapts to the file layout: short-window flush files
+   slice on TIME (their row-group time stats are tight); compacted or
+   long-window files slice on SERIES ID — the leading storage sort key,
+   whose row-group stats are tight on every layout (_pick_slice_dim).
+2. Each slice is read with row-group pruning (memtables + SSTs clipped to
+   the slice range), then merged and MVCC-deduped *exactly*: a
+   (series, ts) key lives in exactly one slice on either axis, so
+   slice-local dedup — the same sort-based kernel the cached path uses —
+   is globally exact, including overwrites and tombstones across SSTs.
+3. Each slice reduces to a partial moment frame on the device (padded to
+   shape buckets so XLA compiles once, not once per slice), and
+   tpu_exec._finalize folds the partials — the same decomposable-moment
+   algebra that already merges partials across regions and datanodes.
+4. Host decode of slice i+1 overlaps device compute of slice i (a
+   one-deep prefetch pipeline; parquet decode drops the GIL).
+
+Reference behavior: src/storage/src/chunk.rs:35-218 (streamed merge
+reader) and src/storage/src/sst/parquet.rs:217-330 (row-group readers);
+SURVEY §7 hard part #3 (overlapped Parquet-decode + H2D streaming).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..common.time import TimestampRange
+from ..ops.kernels import OP_PUT, merge_dedup_numpy, shape_bucket
+
+#: stream (instead of caching) any region estimated above this many rows
+_STREAM_THRESHOLD_ROWS = [64_000_000]
+#: target rows per streamed slice (soft: slices track row-group edges)
+_SLICE_ROWS = [16_000_000]
+#: row-count shape bucket floor, so nearby slice sizes share one compile
+_ROW_BUCKET_MIN = 1 << 20
+
+
+def configure_streaming(threshold_rows: Optional[int] = None,
+                        slice_rows: Optional[int] = None) -> None:
+    """Tune the cold-scan streaming knobs (TOML [query] section)."""
+    if threshold_rows is not None:
+        _STREAM_THRESHOLD_ROWS[0] = int(threshold_rows)
+    if slice_rows is not None:
+        _SLICE_ROWS[0] = int(slice_rows)
+
+
+def stream_threshold_rows() -> int:
+    return _STREAM_THRESHOLD_ROWS[0]
+
+
+def region_estimated_rows(region) -> int:
+    """Upper-bound row estimate from memtable counters + SST metas."""
+    vc = getattr(region, "version_control", None)
+    if vc is None:
+        return 0
+    v = vc.current
+    total = 0
+    for mt in v.memtables.all_memtables():
+        total += mt.num_rows
+    for meta in v.ssts.all_files():
+        total += meta.num_rows
+    return total
+
+
+def _plan_slices(stats: List[Tuple[int, int, int]], budget: int,
+                 clip_lo: Optional[int], clip_hi: Optional[int]
+                 ) -> List[Tuple[int, int]]:
+    """Choose contiguous half-open time slices [t0, t1) covering every row.
+
+    `stats` are (min_ts, max_ts_inclusive, rows) per storage chunk (parquet
+    row group or memtable). Cuts land on chunk upper edges, accumulating
+    until the row budget is reached — slices are exact partitions of the
+    time domain regardless of cut quality; the stats only balance sizes.
+    """
+    clipped = []
+    for lo, hi, rows in stats:
+        if clip_lo is not None and hi < clip_lo:
+            continue
+        if clip_hi is not None and lo >= clip_hi:
+            continue
+        clipped.append((lo, hi, rows))
+    if not clipped:
+        return []
+    tmin = min(lo for lo, _, _ in clipped)
+    tmax = max(hi for _, hi, _ in clipped)
+    if clip_lo is not None:
+        tmin = max(tmin, clip_lo)
+    if clip_hi is not None:
+        tmax = min(tmax, clip_hi - 1)
+    if tmin > tmax:
+        return []
+    total = sum(r for _, _, r in clipped)
+    if total <= budget:
+        return [(tmin, tmax + 1)]
+    cuts: List[int] = []
+    acc = 0
+    for lo, hi, rows in sorted(clipped, key=lambda s: (s[1], s[0])):
+        acc += rows
+        if acc >= budget and hi < tmax:
+            cuts.append(hi + 1)
+            acc = 0
+    bounds = [tmin] + sorted(set(cuts)) + [tmax + 1]
+    return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
+            if bounds[i] < bounds[i + 1]]
+
+
+def _region_slice_stats(region, snap, unit
+                        ) -> List[Tuple[int, int, int, int, int]]:
+    """(min_ts, max_ts, min_sid, max_sid, rows) per chunk: SST row
+    groups + memtables."""
+    v = snap._version
+    stats: List[Tuple[int, int, int, int, int]] = []
+    for meta in v.ssts.all_files():
+        rg = region.access_layer.row_group_stats(meta)
+        if rg:
+            stats.extend(rg)
+        else:  # no stats: the whole file is one chunk
+            lo, hi = meta.time_range
+            stats.append((lo, hi, 0, 1 << 30, meta.num_rows))
+    for mt in v.memtables.all_memtables():
+        ms = mt.snapshot()
+        if ms.num_rows:
+            stats.append((int(ms.ts.min()), int(ms.ts.max()),
+                          int(ms.series_ids.min()),
+                          int(ms.series_ids.max()), ms.num_rows))
+    return stats
+
+
+def _pick_slice_dim(stats) -> str:
+    """Choose the slicing dimension with tighter row-group spans.
+
+    SSTs sort by (series, ts): flush files cover short time windows
+    (time stats tight, series stats span everything), while compacted or
+    long-window files cover each series' whole range (series stats
+    tight, time stats useless). Mean span / domain span measures how
+    well cuts on a dimension will prune row groups."""
+    def ratio(lo_i: int, hi_i: int) -> float:
+        los = [s[lo_i] for s in stats]
+        his = [s[hi_i] for s in stats]
+        domain = max(his) - min(los) + 1
+        if domain <= 0:
+            return 1.0
+        spans = [h - l + 1 for l, h in zip(los, his)]
+        return (sum(spans) / len(spans)) / domain
+
+    return "series" if ratio(2, 3) < ratio(0, 1) else "time"
+
+
+def _slice_dedup(data) -> Optional[np.ndarray]:
+    """Kept-row indices for a slice — or None when EVERY row survives
+    (append-only data, the common case), letting the caller skip the
+    per-column fancy-index copies entirely.
+
+    Skips the O(n log n) sort when the concatenated runs are already
+    (sid, ts, seq)-sorted — true whenever a single SST covers the slice
+    — which reduces dedup to a vectorized adjacency scan."""
+    s, t, q = data.series_ids, data.ts, data.seq
+    n = len(s)
+    if n > 1:
+        s_up = s[1:] > s[:-1]
+        s_eq = s[1:] == s[:-1]
+        t_up = t[1:] > t[:-1]
+        t_eq = t[1:] == t[:-1]
+        sorted_ok = bool(np.all(
+            s_up | (s_eq & (t_up | (t_eq & (q[1:] >= q[:-1]))))))
+        if sorted_ok:
+            dup = s_eq & t_eq
+            deletes = data.op_types != OP_PUT
+            if not dup.any() and not deletes.any():
+                return None                  # keep everything, zero copies
+            nxt_same = np.concatenate([dup, [False]])
+            keep = ~nxt_same & ~deletes
+            return np.nonzero(keep)[0]
+    return merge_dedup_numpy(s, t, q, data.op_types)
+
+
+def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
+                series_dict, row_bucket_min: int,
+                time_range: Optional[TimestampRange]):
+    """Read + merge + dedup one slice into a padded transient MergedScan.
+
+    `dim` selects the partition axis: "time" slices [lo, hi) on the time
+    index, "series" on __series_id (with the query's time filter still
+    pruning files and row groups)."""
+    from .tpu_exec import MergedScan
+
+    if dim == "series":
+        data = snap.scan(projection=needed_fields, series_range=(lo, hi),
+                         time_range=time_range, synthetic_seq=True)
+    else:
+        data = snap.scan(projection=needed_fields,
+                         time_range=TimestampRange(lo, hi, unit),
+                         synthetic_seq=True)
+    if data.num_rows == 0:
+        return None
+    kept = _slice_dedup(data)
+    n = data.num_rows if kept is None else len(kept)
+    if n == 0:
+        return None
+
+    # pad to a shape bucket so every slice shares one XLA compile; padded
+    # rows repeat the last (sid, ts) — they extend the final run, stay
+    # sorted, and are masked out via valid_rows. take + device-dtype cast
+    # + pad fuse into ONE pass per column (each was a full copy).
+    import jax
+    x64 = jax.config.jax_enable_x64
+    target = shape_bucket(n, minimum=row_bucket_min)
+
+    def prepare(a, dtype=None, pad_fill=None):
+        dtype = dtype or a.dtype
+        if kept is None and target == n and a.dtype == dtype:
+            return a
+        out = np.empty(target, dtype)
+        if kept is None:
+            out[:n] = a
+        elif a.dtype == dtype:
+            np.take(a, kept, out=out[:n])
+        else:
+            out[:n] = a[kept]
+        if target != n:
+            out[n:] = pad_fill if pad_fill is not None else out[n - 1]
+        return out
+
+    sids = prepare(data.series_ids, np.int32)
+    ts = prepare(data.ts)
+    fields = {}
+    for name, (d, vd) in data.fields.items():
+        if d.dtype == object:
+            d2 = d if kept is None else d[kept]
+            if target != n:
+                d2 = np.concatenate(
+                    [d2, np.full(target - n, None, dtype=object)])
+        else:
+            want = np.float32 if d.dtype == np.float64 and not x64 \
+                else d.dtype
+            d2 = prepare(d, want)
+        v2 = prepare(vd, np.bool_, pad_fill=False) \
+            if vd is not None else None
+        fields[name] = (d2, v2)
+    base = int(ts[:n].min())
+    scan = MergedScan(sids, ts, fields, series_dict, base)
+    scan.valid_rows = n if target != n else None
+    # start the H2D transfers here, on the prefetch thread: device_put is
+    # asynchronous, so the copies stream while the next slice decodes and
+    # the launch thread stays free for mask/run construction. Only dtypes
+    # device_put maps 1:1 are staged — int64 fields keep device_field's
+    # narrowing logic; anything else falls back to lazy upload at launch.
+    try:
+        rel = ts - base
+        if not rel.size or int(rel.max()) < 2 ** 31:
+            scan.device["__ts"] = jax.device_put(rel.astype(np.int32))
+        for name, (d2, v2) in fields.items():
+            if d2.dtype in (np.float32, np.bool_, np.int32) or \
+                    (d2.dtype == np.float64 and x64):
+                scan.device[f"f:{name}"] = jax.device_put(d2)
+            if v2 is not None:
+                scan.device[f"v:{name}"] = jax.device_put(v2)
+        if target != n:
+            pm = np.zeros(target, np.bool_)
+            pm[:n] = True
+            scan.device["__pad_mask"] = jax.device_put(pm)
+    except Exception:  # noqa: BLE001 — staging is an optimization
+        scan.device.clear()
+    return scan
+
+
+def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
+    """Partial moment frames for one region via slice streaming.
+
+    Returns the same frame shape tpu_exec._execute_region produces, so
+    tpu_exec._finalize folds slices exactly like regions.
+
+    Pipelining: XLA dispatch is asynchronous, so each slice's reduction
+    is *launched* and left in flight while the next slice decodes on the
+    prefetch thread; device results are fetched in ONE round trip at the
+    end (per-slice fetches would each pay the device-link latency, which
+    dominates on tunneled chips). Only run-level context is kept per
+    launched slice — full slice arrays are freed as the pipeline advances.
+    """
+    import jax
+
+    from .tpu_exec import _collect_moment_frame, _launch_scan_kernel
+
+    snap = region.snapshot()
+    schema = snap.schema
+    tc = schema.timestamp_column
+    unit = tc.dtype.time_unit if tc is not None else None
+    stats = _region_slice_stats(region, snap, unit)
+    if not stats:
+        return []
+    dim = _pick_slice_dim(stats)
+    if dim == "series":
+        dstats = [(s[2], s[3], s[4]) for s in stats]
+        clip_lo = clip_hi = None
+        query_range = None
+        if plan.time_lo is not None or plan.time_hi is not None:
+            query_range = TimestampRange(plan.time_lo, plan.time_hi, unit)
+    else:
+        dstats = [(s[0], s[1], s[4]) for s in stats]
+        clip_lo, clip_hi = plan.time_lo, plan.time_hi
+        query_range = None
+    slices = _plan_slices(dstats, _SLICE_ROWS[0], clip_lo, clip_hi)
+    if not slices:
+        return []
+    needed = sorted({m.column for m in plan.moments if m.column is not None}
+                    | {ff.column for ff in plan.field_filters})
+    sd = region.series_dict
+
+    launched = []
+    # two-deep prefetch: decode slices i+1, i+2 while slice i launches
+    # (decode is the cold-path bottleneck; two workers keep parquet
+    # threads busy without unbounded slice residency)
+    depth = 2
+    with ThreadPoolExecutor(max_workers=depth,
+                            thread_name_prefix="stream-scan") as pool:
+        futs = [pool.submit(_load_slice, snap, dim, lo, hi, unit, needed,
+                            sd, _ROW_BUCKET_MIN, query_range)
+                for lo, hi in slices[:depth]]
+        for i in range(len(slices)):
+            scan = futs[i].result()
+            if i + depth < len(slices):
+                lo, hi = slices[i + depth]
+                futs.append(pool.submit(_load_slice, snap, dim, lo, hi,
+                                        unit, needed, sd, _ROW_BUCKET_MIN,
+                                        query_range))
+            futs[i] = None                   # free the slice as we go
+            if scan is None:
+                continue
+            ln = _launch_scan_kernel(scan, schema, plan)
+            if ln is not None:
+                launched.append(ln)
+            del scan
+    if not launched:
+        return []
+    fetched = jax.device_get([(ln.counts, list(ln.results))
+                              for ln in launched])
+    frames: List[pd.DataFrame] = []
+    for ln, (counts, res_np) in zip(launched, fetched):
+        part = _collect_moment_frame(ln, plan, counts, res_np)
+        if part is not None and len(part):
+            frames.append(part)
+    return frames

@@ -2,13 +2,16 @@
 
 Reference behavior: src/servers/src/opentsdb/codec.rs:291 — a DataPoint
 (metric, ts, value, tags) stored as table=metric, tags→tags,
-greptime_timestamp/greptime_value columns.
+greptime_timestamp/greptime_value columns — and opentsdb.rs:60-120, the
+line-based TCP listener on its own port (`OpentsdbServer` below).
 """
 
 from __future__ import annotations
 
+import socketserver
+import threading
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..errors import InvalidArgumentsError
 
@@ -60,6 +63,80 @@ def parse_http_put(body) -> List[DataPoint]:
         except (KeyError, TypeError, ValueError) as e:
             raise InvalidArgumentsError(f"bad datapoint: {it!r}") from e
     return out
+
+
+class OpentsdbServer:
+    """Telnet-style TCP listener: one `put` line per data point.
+
+    Reference behavior: src/servers/src/opentsdb.rs:60-120 — accept
+    connections, read lines, insert each `put`, answer errors as text
+    lines (classic OpenTSDB only replies on error), close on `exit`/
+    `quit`, answer `version`.
+    """
+
+    def __init__(self, instance, host: str = "127.0.0.1", port: int = 0):
+        self.instance = instance
+        server_self = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    raw = self.rfile.readline()
+                    if not raw:
+                        return
+                    try:
+                        line = raw.decode("utf-8").strip()
+                    except UnicodeDecodeError:
+                        self.wfile.write(b"error: invalid utf-8\n")
+                        continue
+                    if not line:
+                        continue
+                    cmd = line.split(None, 1)[0].lower()
+                    if cmd in ("exit", "quit"):
+                        return
+                    if cmd == "version":
+                        self.wfile.write(b"net.opentsdb tsd built from "
+                                         b"greptimedb-tpu\n")
+                        continue
+                    try:
+                        server_self._ingest_line(line)
+                    except Exception as e:  # noqa: BLE001 — answer as text
+                        msg = str(e).split("\n")[0][:200]
+                        self.wfile.write(f"error: {msg}\n".encode())
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, port), Handler)
+        self.port = self._tcp.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _ingest_line(self, line: str) -> None:
+        from ..session import Channel, QueryContext
+        point = parse_telnet_put(line)
+        inserts, tag_cols = points_to_inserts([point])
+        ctx = QueryContext(channel=Channel.OPENTSDB)
+        for table, cols in inserts.items():
+            self.instance.handle_row_insert(
+                table, cols, tag_columns=tag_cols[table],
+                timestamp_column=GREPTIME_TIMESTAMP, ctx=ctx)
+
+    def serve_in_background(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="opentsdb-server")
+        self._thread.start()
+        return self._thread
+
+    start = serve_in_background
+
+    @property
+    def host(self) -> str:
+        return self._tcp.server_address[0]
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
 
 
 def points_to_inserts(points: List[DataPoint]):

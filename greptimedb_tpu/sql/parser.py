@@ -53,7 +53,102 @@ def parse_sql(sql: str) -> Statement:
 
 
 def parse_statements(sql: str) -> List[Statement]:
+    stmt = _fast_parse_insert(sql)
+    if stmt is not None:
+        return [stmt]
     return Parser(sql).parse_statements()
+
+
+# bulk INSERT ... VALUES hot path: one C-speed regex scan instead of the
+# general tokenizer (which builds ~9 Token objects per row — tokenize alone
+# cost 31ms per 2000-row statement; this scanner takes ~2ms)
+import re as _re2  # noqa: E402
+
+_INS_HEAD = _re2.compile(
+    r"""\s*INSERT\s+INTO\s+
+        (?P<name>[A-Za-z_$][\w$]*(?:\.[A-Za-z_$][\w$]*){0,2}
+         |"[^"]+"|`[^`]+`)\s*
+        (?:\(\s*(?P<cols>[^)]*?)\s*\)\s*)?
+        VALUES\s*""", _re2.I | _re2.X)
+_INS_VALUE = _re2.compile(
+    r"""\s*(?:
+        (?P<str>'(?:[^'\\]|''|\\.)*')
+      | (?P<num>[-+]?(?:0[xX][0-9a-fA-F]+|(?:\d+\.?\d*|\.\d+)
+                      (?:[eE][+-]?\d+)?))
+      | (?P<kw>[Nn][Uu][Ll][Ll]|[Tt][Rr][Uu][Ee]|[Ff][Aa][Ll][Ss][Ee])
+        )\s*(?P<sep>[,)])""", _re2.X)
+_INS_ROW_SEP = _re2.compile(r"\s*(?:,\s*\(|\(|;?\s*$)")
+_SIMPLE_INS_STR = _re2.compile(r"'[^'\\]*'\Z")
+
+
+def _fast_parse_insert(sql: str):
+    """Parse `INSERT INTO t [(cols)] VALUES (...), ...` without the
+    tokenizer. Returns None (fall back to the grammar) on anything
+    fancier: expressions, functions, placeholders, INSERT..SELECT."""
+    m = _INS_HEAD.match(sql)
+    if m is None:
+        return None
+    name = m.group("name")
+    if name[0] in "\"`":
+        parts = [name[1:-1]]
+    else:
+        parts = name.split(".")
+    columns: List[str] = []
+    if m.group("cols"):
+        for c in m.group("cols").split(","):
+            c = c.strip()
+            if c and c[0] in "\"`":
+                c = c[1:-1]
+            if not c or not _re2.fullmatch(r"[\w$]+|\S+", c):
+                return None
+            columns.append(c)
+    pos = m.end()
+    n = len(sql)
+    rows: List[List[Expr]] = []
+    match_row = _INS_ROW_SEP.match
+    match_val = _INS_VALUE.match
+    lit = Literal
+    while True:
+        rs = match_row(sql, pos)
+        if rs is None:
+            return None
+        tok = rs.group().strip()
+        if tok in ("", ";"):
+            if rs.end() < n or not rows:
+                return None
+            return Insert(ObjectName(parts), columns, rows)
+        pos = rs.end()
+        row: List[Expr] = []
+        append = row.append
+        while True:
+            vm = match_val(sql, pos)
+            if vm is None:
+                return None          # expression / DEFAULT / empty tuple
+            pos = vm.end()
+            s, num, kw, sep = vm.group("str", "num", "kw", "sep")
+            if num is not None:
+                low = num.lower()
+                if "." in num or "e" in low:
+                    v = float(num)
+                elif "x" in low:
+                    v = int(num, 16)
+                else:
+                    v = int(num)
+                append(lit(v, "number"))
+            elif s is not None:
+                if _SIMPLE_INS_STR.match(s):
+                    append(lit(s[1:-1], "string"))
+                else:
+                    from .tokenizer import _read_quoted
+                    val, _ = _read_quoted(s, 0, "'")
+                    append(lit(val, "string"))
+            else:
+                kw = kw.upper()
+                append(lit(None, "null") if kw == "NULL"
+                       else lit(kw == "TRUE", "bool"))
+            if sep == ")":
+                break
+        rows.append(row)
 
 
 class Parser:

@@ -42,18 +42,22 @@ class FileMeta:
     num_rows: int
     file_size: int
     max_sequence: int = 0
+    #: delete tombstones in the file; None = unknown (pre-upgrade files)
+    num_deletes: Optional[int] = None
 
     def to_dict(self) -> dict:
         return {
             "file_name": self.file_name, "level": self.level,
             "time_range": list(self.time_range), "num_rows": self.num_rows,
             "file_size": self.file_size, "max_sequence": self.max_sequence,
+            "num_deletes": self.num_deletes,
         }
 
     @staticmethod
     def from_dict(d: dict) -> "FileMeta":
         return FileMeta(d["file_name"], d["level"], tuple(d["time_range"]),
-                        d["num_rows"], d["file_size"], d.get("max_sequence", 0))
+                        d["num_rows"], d["file_size"],
+                        d.get("max_sequence", 0), d.get("num_deletes"))
 
 
 class LevelMetas:
@@ -121,6 +125,8 @@ class AccessLayer:
         self.sst_dir = sst_dir.rstrip("/")
         self.schema = schema
         self.row_group_size = row_group_size
+        #: per-file row-group time stats, keyed by (immutable) file name
+        self._rg_stats: Dict[str, List[Tuple[int, int, int]]] = {}
 
     def _key(self, file_name: str) -> str:
         return f"{self.sst_dir}/{file_name}"
@@ -142,8 +148,18 @@ class AccessLayer:
         names: List[str] = []
         for c in schema.column_schemas:
             if c.is_tag:
-                arr = pa.array(tag_columns[c.name], type=c.dtype.pa_type)
-                arrays.append(arr.dictionary_encode())
+                tc = tag_columns[c.name]
+                if isinstance(tc, tuple):
+                    # (per-row value ids, dictionary values) from the
+                    # SeriesDict: build the DictionaryArray directly
+                    idx, vals = tc
+                    arr = pa.DictionaryArray.from_arrays(
+                        pa.array(np.asarray(idx, dtype=np.int32)),
+                        pa.array(list(vals), type=c.dtype.pa_type))
+                else:
+                    arr = pa.array(tc, type=c.dtype.pa_type) \
+                        .dictionary_encode()
+                arrays.append(arr)
                 names.append(c.name)
             elif c.is_time_index:
                 arrays.append(pa.array(ts, type=pa.int64()).cast(c.dtype.pa_type))
@@ -170,13 +186,29 @@ class AccessLayer:
             file_name=file_name, level=level,
             time_range=(int(ts.min()), int(ts.max())),
             num_rows=n, file_size=len(data),
-            max_sequence=int(seq.max()) if n else 0)
+            max_sequence=int(seq.max()) if n else 0,
+            num_deletes=int(np.count_nonzero(op_types)))
 
     # ---- read ----
     def read_sst(self, meta: FileMeta, *,
                  projection: Optional[Sequence[str]] = None,
-                 time_range: Optional[TimestampRange] = None) -> SstData:
-        """Read an SST with column projection and row-group time pruning."""
+                 time_range: Optional[TimestampRange] = None,
+                 series_range: Optional[Tuple[int, int]] = None,
+                 synthetic_seq: bool = False) -> SstData:
+        """Read an SST with column projection and row-group pruning on
+        the time index and/or the series id (`series_range` is a
+        half-open [lo, hi) over __series_id — the storage sort order,
+        so series pruning is tight on every file layout).
+
+        synthetic_seq=True skips decoding the 8-byte __sequence column
+        and fills meta.max_sequence instead: per-file sequence ranges
+        are disjoint (flushes cover consecutive windows; compaction
+        replaces its inputs), so the file rank orders cross-file MVCC
+        versions exactly, and within-file versions are already stored
+        seq-ascending (stable sort keeps them). Only valid for readers
+        that never filter by sequence value (the streamed scan); the
+        incremental cache needs real sequences. When the file records
+        zero deletes the __op_type column is skipped too."""
         key = self._key(meta.file_name)
         path = self.store.local_path(key)
         src = path if path is not None else pa.BufferReader(self.store.read(key))
@@ -184,14 +216,32 @@ class AccessLayer:
         ts_name = self.schema.timestamp_column.name
         ts_idx = pf.schema_arrow.get_field_index(ts_name)
         groups = self._prune_row_groups(pf, ts_idx, time_range)
+        if series_range is not None and groups:
+            sid_idx = pf.schema_arrow.get_field_index(SERIES_COL)
+            s0, s1 = series_range
+            kept = []
+            for g in groups:
+                stats = pf.metadata.row_group(g).column(sid_idx).statistics
+                if stats is None or not stats.has_min_max:
+                    kept.append(g)
+                    continue
+                if int(stats.max) >= s0 and int(stats.min) < s1:
+                    kept.append(g)
+            groups = kept
         field_names = [c.name for c in self.schema.field_columns()
                        if projection is None or c.name in projection]
         # schema-compat: an SST written before an ALTER may lack new columns —
         # absent columns read as nulls (reference: src/storage/src/schema/compat.rs)
         present = set(pf.schema_arrow.names)
         missing = [n for n in field_names if n not in present]
-        cols = [n for n in field_names if n in present] + [ts_name, SERIES_COL,
-                                                           SEQ_COL, OP_COL]
+        skip_seq = synthetic_seq
+        skip_op = synthetic_seq and meta.num_deletes == 0
+        cols = [n for n in field_names if n in present] + [ts_name,
+                                                           SERIES_COL]
+        if not skip_seq:
+            cols.append(SEQ_COL)
+        if not skip_op:
+            cols.append(OP_COL)
         if not groups:
             empty_fields = {
                 name: null_column(self.schema.column_schema(name).dtype, 0)
@@ -202,8 +252,10 @@ class AccessLayer:
         table = pf.read_row_groups(groups, columns=cols, use_threads=True)
         ts = np.asarray(table.column(ts_name).cast(pa.int64()))
         sids = np.asarray(table.column(SERIES_COL))
-        seq = np.asarray(table.column(SEQ_COL))
-        op = np.asarray(table.column(OP_COL))
+        seq = np.full(table.num_rows, meta.max_sequence, np.int64) \
+            if skip_seq else np.asarray(table.column(SEQ_COL))
+        op = np.zeros(table.num_rows, np.int8) \
+            if skip_op else np.asarray(table.column(OP_COL))
         fields = {}
         for name in field_names:
             cs = self.schema.column_schema(name)
@@ -258,6 +310,49 @@ class AccessLayer:
             hi = _ts_stat_to_int(stats.max, unit)
             if time_range.intersects(TimestampRange(lo, hi + 1, time_range.unit)):
                 out.append(g)
+        return out
+
+    def row_group_stats(self, meta: FileMeta
+                        ) -> List[Tuple[int, int, int, int, int]]:
+        """(min_ts, max_ts, min_sid, max_sid, num_rows) per row group,
+        from parquet footer statistics — the density profiles the
+        streamed cold scan uses to cut slices (reference: sst/parquet.rs
+        row-group readers). SSTs sort by (series, ts), so series stats
+        are tight on files that span long time ranges (compaction
+        output) while time stats are tight on short-window flush files;
+        the slice planner picks whichever dimension prunes better.
+        Cached per file name (SSTs are immutable)."""
+        cached = self._rg_stats.get(meta.file_name)
+        if cached is not None:
+            return cached
+        key = self._key(meta.file_name)
+        path = self.store.local_path(key)
+        src = path if path is not None \
+            else pa.BufferReader(self.store.read(key))
+        pf = pq.ParquetFile(src)
+        ts_name = self.schema.timestamp_column.name
+        ts_idx = pf.schema_arrow.get_field_index(ts_name)
+        sid_idx = pf.schema_arrow.get_field_index(SERIES_COL)
+        unit = self.schema.timestamp_column.dtype.time_unit
+        out: List[Tuple[int, int, int, int, int]] = []
+        for g in range(pf.metadata.num_row_groups):
+            rg = pf.metadata.row_group(g)
+            tstats = rg.column(ts_idx).statistics
+            if tstats is None or not tstats.has_min_max:
+                tlo, thi = meta.time_range
+            else:
+                tlo = _ts_stat_to_int(tstats.min, unit)
+                thi = _ts_stat_to_int(tstats.max, unit)
+            sstats = rg.column(sid_idx).statistics \
+                if sid_idx >= 0 else None
+            if sstats is None or not sstats.has_min_max:
+                slo, shi = 0, 1 << 30
+            else:
+                slo, shi = int(sstats.min), int(sstats.max)
+            out.append((tlo, thi, slo, shi, rg.num_rows))
+        if len(self._rg_stats) > 4096:     # bound the footer cache
+            self._rg_stats.clear()
+        self._rg_stats[meta.file_name] = out
         return out
 
     def delete_sst(self, file_name: str) -> None:

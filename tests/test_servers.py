@@ -155,6 +155,42 @@ class TestOpenTsdb:
             ["web01", 18.0], ["web02", 19.5]]
 
 
+class TestOpenTsdbTelnet:
+    def test_telnet_put_over_raw_tcp(self, server):
+        """The reference serves telnet `put` on its own TCP port
+        (src/servers/src/opentsdb.rs:60-120); datapoints land in the
+        metric's table, errors answer as text lines."""
+        import socket
+
+        from greptimedb_tpu.servers.opentsdb import OpentsdbServer
+        tsdb = OpentsdbServer(server.frontend, host="127.0.0.1", port=0)
+        tsdb.start()
+        try:
+            with socket.create_connection(("127.0.0.1", tsdb.port),
+                                          timeout=10) as s:
+                f = s.makefile("rwb")
+                f.write(b"put tsd.cpu 1700000000 41.5 host=web01 dc=east\n"
+                        b"put tsd.cpu 1700000001 43.0 host=web02 dc=west\n")
+                f.flush()
+                # version answers a line; also proves the puts were read
+                f.write(b"version\n")
+                f.flush()
+                assert b"net.opentsdb" in f.readline()
+                # a bad line answers an error line
+                f.write(b"put tsd.cpu not_a_ts 1.0 host=a\n")
+                f.flush()
+                assert f.readline().startswith(b"error:")
+                f.write(b"exit\n")
+                f.flush()
+            # telnet puts are synchronous per line: rows are queryable
+            out = sql(server, 'SELECT host, dc, greptime_value FROM '
+                              '"tsd.cpu" ORDER BY host')
+            assert out["output"][0]["records"]["rows"] == [
+                ["web01", "east", 41.5], ["web02", "west", 43.0]]
+        finally:
+            tsdb.shutdown()
+
+
 class TestPrometheusRemote:
     def test_write_then_read(self, server):
         series = [
