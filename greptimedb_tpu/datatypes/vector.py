@@ -83,8 +83,11 @@ class Vector:
                 return Vector(dtype, data, ~isnull)
         n = len(values)
         if isinstance(values, list) and n and not dtype.is_string \
-                and not dtype.is_binary and dtype.np_dtype is not None:
-            # clean numeric lists convert at C speed; None/mixed content
+                and not dtype.is_binary and dtype.np_dtype is not None \
+                and not any(v is None for v in values):
+            # clean numeric lists convert at C speed; np.asarray silently
+            # coerces None to NaN for float dtypes (no exception), so the
+            # NULL scan above is mandatory — mixed non-None content still
             # raises and falls through to the validating per-value loop
             try:
                 return Vector(dtype, np.asarray(values,
