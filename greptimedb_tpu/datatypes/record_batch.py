@@ -131,6 +131,61 @@ def pretty_print(batches: Sequence[RecordBatch]) -> str:
     return "\n".join(out)
 
 
+def arrow_to_ingest_columns(tbl: pa.Table | pa.RecordBatch,
+                            schema: Schema,
+                            extra: str = "drop") -> Dict[str, Any]:
+    """Arrow table → ingest columns shaped for the bulk-load fast path.
+
+    The raw path in Region.bulk_ingest skips all per-value validation
+    when every column arrives as a typed ndarray, so this converter
+    keeps columns in columnar form end to end: timestamps cast to the
+    schema unit and viewed as int64, numerics handed over zero-copy
+    when null-free, string tags as one object array. Only null-bearing
+    numeric columns fall back to python lists (Nones carry validity
+    through the validating WriteBatch path). Columns absent from the
+    schema are dropped by default (reference: COPY FROM column pruning,
+    src/operator/src/statement/copy_table_from.rs); extra="keep" passes
+    them through as python lists for auto-ALTER ingest paths."""
+    out: Dict[str, Any] = {}
+    for name in tbl.schema.names:
+        col = tbl.column(name)
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if not schema.contains(name):
+            if extra == "keep":
+                # unknown columns survive as python lists so the caller's
+                # auto-ALTER sees them (the Flight bulk path matches
+                # insert()'s create/alter-on-demand contract)
+                out[name] = col.to_pylist()
+            continue
+        cs = schema.column_schema(name)
+        if cs.dtype.is_string or cs.dtype.is_binary:
+            if pa.types.is_dictionary(col.type):
+                col = col.dictionary_decode()
+            out[name] = col.to_numpy(zero_copy_only=False)
+        elif cs.dtype.is_timestamp:
+            # cast to the schema unit FIRST (to_pylist of a timestamp
+            # column yields datetime objects the validating path cannot
+            # cast; int64 epoch values round-trip for both branches)
+            want = cs.dtype.pa_type
+            if col.type != want:
+                col = col.cast(want)
+            ints = col.cast(pa.int64())
+            out[name] = ints.to_pylist() if col.null_count \
+                else np.asarray(ints, dtype=np.int64)
+        elif col.null_count:
+            # Nones must survive into the validating path (numpy would
+            # silently coerce them to NaN for float dtypes)
+            out[name] = col.to_pylist()
+        else:
+            want = cs.dtype.np_dtype
+            arr = col.to_numpy(zero_copy_only=False)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
+            out[name] = arr
+    return out
+
+
 def _fmt(v: Any, col) -> str:
     if col.dtype.is_timestamp:
         from ..common.time import Timestamp

@@ -38,7 +38,14 @@ class ImmutableFileTable(Table):
         self.format = fmt
 
     def _read_arrow(self) -> pa.Table:
+        from ..common.datasource import file_codec
         data = self.store.read(self.location)
+        codec = file_codec(self.location,
+                           self.info.meta.options.get("compression")
+                           if self.info.meta.options else None)
+        if codec is not None and self.format != "parquet":
+            data = pa.CompressedInputStream(
+                pa.BufferReader(data), codec).read()
         if self.format == "parquet":
             return pq.read_table(io.BytesIO(data))
         if self.format == "csv":
@@ -130,7 +137,9 @@ class ImmutableFileTableEngine(TableEngine):
                            engine=self.name,
                            region_numbers=[],
                            next_column_id=len(schema),
-                           options={"location": location, "format": fmt}),
+                           options={"location": location, "format": fmt,
+                                    **({"compression": opts["compression"]}
+                                       if "compression" in opts else {})}),
             catalog_name=request.catalog_name,
             schema_name=request.schema_name)
         self.store.write(self._manifest_key(*key),
@@ -185,9 +194,14 @@ class ImmutableFileTableEngine(TableEngine):
 
 
 def _infer_format(location: str) -> str:
+    base = location
+    for cext in (".gz", ".gzip", ".zst", ".zstd"):
+        if base.lower().endswith(cext):
+            base = base[:-len(cext)]
+            break
     for ext, fmt in ((".parquet", "parquet"), (".csv", "csv"),
                      (".json", "json"), (".ndjson", "json")):
-        if location.endswith(ext):
+        if base.endswith(ext):
             return fmt
     raise InvalidArgumentsError(
         f"cannot infer format from {location!r}; pass WITH (format=...)")

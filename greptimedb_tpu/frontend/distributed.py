@@ -19,6 +19,7 @@ import logging
 import threading
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
 import pandas as pd
 
 from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
@@ -93,6 +94,11 @@ class DistTable(Table):
     def insert(self, columns: Dict[str, Sequence]) -> int:
         return self._split_write(columns, op="put")
 
+    def bulk_load(self, columns: Dict[str, Sequence]) -> int:
+        """Route a WAL-less bulk load to each owning datanode's region
+        (mito write_region op="bulk" → Region.bulk_ingest)."""
+        return self._split_write(columns, op="bulk")
+
     def delete(self, key_columns: Dict[str, Sequence]) -> int:
         return self._split_write(key_columns, op="delete")
 
@@ -108,7 +114,8 @@ class DistTable(Table):
         written = 0
         for rnum, idx in splits.items():
             part = columns if idx is None else \
-                {k: [v[i] for i in idx] for k, v in columns.items()}
+                {k: v[idx] if isinstance(v, np.ndarray)
+                 else [v[i] for i in idx] for k, v in columns.items()}
             written += self._owner(rnum).write_region(
                 self.info.catalog_name, self.info.schema_name,
                 self.info.name, rnum, part, op)
@@ -322,11 +329,26 @@ class DistInstance:
         return None
 
     # ---- protocol ingest: auto create / alter on demand ----
+    def handle_bulk_load(
+        self, table_name: str, columns: Dict[str, Sequence],
+        *, tag_columns: Sequence[str] = (),
+        timestamp_column: str = "greptime_timestamp",
+        types=None, ctx: Optional[QueryContext] = None,
+    ) -> int:
+        """Distributed bulk load: same auto create/alter as row insert,
+        but each datanode ingests its partition WAL-less
+        (DistTable.bulk_load → write_region op="bulk")."""
+        return self.handle_row_insert(
+            table_name, columns, tag_columns=tag_columns,
+            timestamp_column=timestamp_column, types=types, ctx=ctx,
+            _bulk=True)
+
     def handle_row_insert(
         self, table_name: str, columns: Dict[str, Sequence],
         *, tag_columns: Sequence[str] = (),
         timestamp_column: str = "greptime_timestamp",
         types=None, ctx: Optional[QueryContext] = None,
+        _bulk: bool = False,
     ) -> int:
         """Distributed twin of the standalone auto-create/alter ingest
         (reference: DistInstance implements the same handler traits,
@@ -383,7 +405,7 @@ class DistInstance:
                                               table_name)
                 table = self._resolve_table(catalog, schema_name,
                                             table_name)
-        return table.insert(columns)
+        return table.bulk_load(columns) if _bulk else table.insert(columns)
 
     def alter_table(self, stmt: ast.AlterTable, ctx: QueryContext):
         """Distributed ALTER: fan the engine request out to every owning

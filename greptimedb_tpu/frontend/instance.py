@@ -148,6 +148,33 @@ class FrontendInstance:
             table = self.catalog.table(catalog, schema_name, table_name)
         return table.insert(columns)
 
+    def handle_bulk_load(
+        self, table_name: str, columns: Dict[str, Sequence],
+        *, tag_columns: Sequence[str] = (),
+        timestamp_column: str = GREPTIME_TIMESTAMP,
+        types: Optional[Dict[str, ConcreteDataType]] = None,
+        ctx: Optional[QueryContext] = None,
+    ) -> int:
+        """WAL-less bulk ingest (COPY FROM / Flight bulk do_put): same
+        auto create/alter as row insert, but routed through the engine's
+        direct-to-SST load (MitoTable.bulk_load) when available.
+        Durability comes from the SSTs + one manifest edit (reference:
+        direct part writes, src/storage/src/region/writer.rs:394-433)."""
+        ctx = ctx or QueryContext()
+        catalog, schema_name = ctx.current_catalog, ctx.current_schema
+        table = self.catalog.table(catalog, schema_name, table_name)
+        types = types or {}
+        if table is None:
+            table = self._create_on_demand(
+                catalog, schema_name, table_name, columns, tag_columns,
+                timestamp_column, types)
+        else:
+            self._alter_on_demand(table, catalog, schema_name, table_name,
+                                  columns, types, tag_columns)
+            table = self.catalog.table(catalog, schema_name, table_name)
+        bulk = getattr(table, "bulk_load", None)
+        return bulk(columns) if bulk is not None else table.insert(columns)
+
     def _infer_type(self, name: str, values: Sequence,
                     types: Dict[str, ConcreteDataType],
                     timestamp_column: str) -> ConcreteDataType:

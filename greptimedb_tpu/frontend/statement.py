@@ -14,6 +14,8 @@ import numpy as np
 import pandas as pd
 
 from ..catalog import CatalogManager
+from ..common.datasource import (file_codec, open_compressed_in,
+                                 open_compressed_out)
 from ..datatypes.data_type import parse_type_name
 from ..datatypes.schema import (
     ColumnDefaultConstraint, ColumnSchema, Schema, SemanticType)
@@ -321,11 +323,13 @@ class StatementExecutor:
             raise TableNotFoundError(f"table {table_name!r} not found")
         fmt = str(stmt.options.get("format", "parquet")).lower()
         path = stmt.path
+        codec = file_codec(path, stmt.options.get("compression"))
         if stmt.direction == "to":
-            return self._copy_to(table, path, fmt)
-        return self._copy_from(table, path, fmt)
+            return self._copy_to(table, path, fmt, codec)
+        return self._copy_from(table, path, fmt, codec)
 
-    def _copy_to(self, table, path: str, fmt: str) -> Output:
+    def _copy_to(self, table, path: str, fmt: str,
+                 codec: Optional[str]) -> Output:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
@@ -335,40 +339,46 @@ class StatementExecutor:
             pa.Table.from_batches([], schema=table.schema.to_arrow())
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         if fmt == "parquet":
-            pq.write_table(tbl, path)
+            pq.write_table(tbl, path)      # parquet compresses internally
         elif fmt == "csv":
             import pyarrow.csv as pcsv
-            pcsv.write_csv(tbl, path)
+            with open_compressed_out(path, codec) as sink:
+                pcsv.write_csv(tbl, sink)
         elif fmt == "json":
-            tbl.to_pandas().to_json(path, orient="records", lines=True)
+            data = tbl.to_pandas().to_json(None, orient="records",
+                                           lines=True, date_format="iso")
+            with open_compressed_out(path, codec) as sink:
+                sink.write(data.encode())
         else:
             raise UnsupportedError(f"COPY format {fmt!r}")
         return Output.rows(tbl.num_rows)
 
-    def _copy_from(self, table, path: str, fmt: str) -> Output:
+    def _copy_from(self, table, path: str, fmt: str,
+                   codec: Optional[str]) -> Output:
+        import io as _io
+
         import pyarrow.parquet as pq
 
         if fmt == "parquet":
             tbl = pq.read_table(path)
         elif fmt == "csv":
             import pyarrow.csv as pcsv
-            tbl = pcsv.read_csv(path)
+            with open_compressed_in(path, codec) as src:
+                tbl = pcsv.read_csv(src)
         elif fmt == "json":
-            tbl = pd.read_json(path, orient="records", lines=True)
             import pyarrow as pa
+            with open_compressed_in(path, codec) as src:
+                raw = src.read()
+            raw = raw.to_pybytes() if hasattr(raw, "to_pybytes") else raw
+            tbl = pd.read_json(_io.BytesIO(raw), orient="records",
+                               lines=True)
             tbl = pa.Table.from_pandas(tbl)
         else:
             raise UnsupportedError(f"COPY format {fmt!r}")
-        pdf = tbl.to_pandas()
-        cols = {}
-        for name in pdf.columns:
-            if not table.schema.contains(name):
-                continue
-            s = pdf[name]
-            if s.dtype.kind == "M":
-                s = s.astype(np.int64) // 1_000_000
-            cols[name] = [None if v is pd.NaT or (isinstance(v, float) and
-                                                  np.isnan(v)) else v
-                          for v in s.tolist()]
-        n = table.insert(cols)
+        from ..datatypes.record_batch import arrow_to_ingest_columns
+        cols = arrow_to_ingest_columns(tbl, table.schema)
+        # WAL-less direct-to-SST load when the engine supports it — the
+        # SSTs + one manifest edit are the durability story for COPY FROM
+        bulk = getattr(table, "bulk_load", None)
+        n = bulk(cols) if bulk is not None else table.insert(cols)
         return Output.rows(n)

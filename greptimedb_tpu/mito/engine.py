@@ -164,9 +164,12 @@ class MitoTable(Table):
                 raise RegionNotFoundError(
                     f"rows target region {rnum}, which this node does not "
                     f"host for table {self.info.name}")
+            # lists stay lists under the split (an object-ndarray round
+            # trip would feed None-bearing numerics to astype, which
+            # rejects None) — typed ndarrays keep the raw fast path
             part = columns if idx is None else \
-                {k: np.asarray(v, dtype=object)[idx]
-                 if not isinstance(v, np.ndarray) else v[idx]
+                {k: v[idx] if isinstance(v, np.ndarray)
+                 else [v[i] for i in idx]
                  for k, v in columns.items()}
             written += region.bulk_ingest(part)
         return written
@@ -204,6 +207,9 @@ class MitoTable(Table):
             raise RegionNotFoundError(
                 f"region {region_number} not hosted for table "
                 f"{self.info.name}")
+        if op == "bulk":
+            # WAL-less direct-to-SST load (frontend bulk routing)
+            return region.bulk_ingest(columns)
         wb = WriteBatch(region.schema)
         if op == "put":
             wb.put(columns)

@@ -45,15 +45,29 @@ _STREAM_THRESHOLD_ROWS = [64_000_000]
 _SLICE_ROWS = [16_000_000]
 #: row-count shape bucket floor, so nearby slice sizes share one compile
 _ROW_BUCKET_MIN = 1 << 20
+#: where a cold slice's one-pass partial reduction runs. "host": a
+#: vectorized reduceat over the just-decoded columns — cold scans are
+#: decode/link-bound, and a single streaming pass belongs where the bytes
+#: already are (shipping n rows over the device link to produce nruns
+#: partials is a losing trade at host↔device bandwidths; the resident
+#: warm path keeps the TPU, where reuse amortizes the transfer).
+#: "device": launch the moment kernel per slice (right when the link is
+#: wide, e.g. co-located accelerators).
+_COLD_REDUCE = ["host"]
 
 
 def configure_streaming(threshold_rows: Optional[int] = None,
-                        slice_rows: Optional[int] = None) -> None:
+                        slice_rows: Optional[int] = None,
+                        cold_reduce: Optional[str] = None) -> None:
     """Tune the cold-scan streaming knobs (TOML [query] section)."""
     if threshold_rows is not None:
         _STREAM_THRESHOLD_ROWS[0] = int(threshold_rows)
     if slice_rows is not None:
         _SLICE_ROWS[0] = int(slice_rows)
+    if cold_reduce is not None:
+        if cold_reduce not in ("host", "device"):
+            raise ValueError(f"cold_reduce {cold_reduce!r}")
+        _COLD_REDUCE[0] = cold_reduce
 
 
 def stream_threshold_rows() -> int:
@@ -80,9 +94,19 @@ def _plan_slices(stats: List[Tuple[int, int, int]], budget: int,
     """Choose contiguous half-open time slices [t0, t1) covering every row.
 
     `stats` are (min_ts, max_ts_inclusive, rows) per storage chunk (parquet
-    row group or memtable). Cuts land on chunk upper edges, accumulating
-    until the row budget is reached — slices are exact partitions of the
-    time domain regardless of cut quality; the stats only balance sizes.
+    row group or memtable). Two kinds of cuts, both on chunk edges:
+
+    - *clean breaks*: gaps where no chunk spans the boundary. A slice cut
+      there covers whole sorted runs, so the reader takes the no-sort
+      no-mask path — the dominant cold-scan cost is the host merge sort,
+      and flush SSTs are time-disjoint, so most LSM layouts split fully
+      into merge-free slices. Only taken once a slice has accumulated
+      enough rows to amortize its kernel launch + padding.
+    - *budget cuts*: inside an overlapping run of chunks, accumulate to
+      the row budget (those slices still merge-sort, but stay bounded).
+
+    Slices are exact partitions of the time domain regardless of cut
+    quality; the stats only balance sizes.
     """
     clipped = []
     for lo, hi, rows in stats:
@@ -101,17 +125,46 @@ def _plan_slices(stats: List[Tuple[int, int, int]], budget: int,
         tmax = min(tmax, clip_hi - 1)
     if tmin > tmax:
         return []
-    total = sum(r for _, _, r in clipped)
-    if total <= budget:
-        return [(tmin, tmax + 1)]
-    cuts: List[int] = []
+    # connected components of overlapping chunks: (lo, hi, rows, chunks)
+    comps: List[list] = []
+    for lo, hi, rows in sorted(clipped, key=lambda s: (s[0], s[1])):
+        if comps and lo <= comps[-1][1]:
+            c = comps[-1]
+            c[1] = max(c[1], hi)
+            c[2] += rows
+            c[3].append((lo, hi, rows))
+        else:
+            comps.append([lo, hi, rows, [(lo, hi, rows)]])
+
+    min_clean = max(_ROW_BUCKET_MIN, budget // 8)
+    cuts: set = set()
     acc = 0
-    for lo, hi, rows in sorted(clipped, key=lambda s: (s[1], s[0])):
-        acc += rows
-        if acc >= budget and hi < tmax:
-            cuts.append(hi + 1)
+    prev_hi: Optional[int] = None
+    for clo, chi, crows, chunks in comps:
+        # close the running slice at the gap when it is big enough to
+        # deserve its own launch, when adding the next component would
+        # bust the row budget, or when a budget-busting component
+        # follows (its internal cuts must not bleed into neighbors)
+        if prev_hi is not None and acc and (acc >= min_clean
+                                            or acc + crows > budget
+                                            or crows > budget):
+            cuts.add(prev_hi + 1)
             acc = 0
-    bounds = [tmin] + sorted(set(cuts)) + [tmax + 1]
+        if crows > budget:
+            # oversized overlapping pile: budget cuts inside it (those
+            # slices pay the merge sort, but stay bounded)
+            inner = 0
+            for lo, hi, rows in sorted(chunks, key=lambda s: (s[1], s[0])):
+                inner += rows
+                if inner >= budget and hi < chi:
+                    cuts.add(hi + 1)
+                    inner = 0
+            acc = budget            # force a cut before whatever follows
+        else:
+            acc += crows
+        prev_hi = chi
+    bounds = [tmin] + sorted(c for c in cuts if tmin < c <= tmax) \
+        + [tmax + 1]
     return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
             if bounds[i] < bounds[i + 1]]
 
@@ -138,24 +191,93 @@ def _region_slice_stats(region, snap, unit
     return stats
 
 
-def _pick_slice_dim(stats) -> str:
-    """Choose the slicing dimension with tighter row-group spans.
+def _plan_jobs(stats: List[Tuple[int, int, int, int, int]], budget: int,
+               time_lo: Optional[int], time_hi: Optional[int], unit
+               ) -> List[Tuple[str, int, int, Optional[TimestampRange]]]:
+    """Per-component hybrid slice plan: (dim, lo, hi, time_clip) jobs.
 
-    SSTs sort by (series, ts): flush files cover short time windows
-    (time stats tight, series stats span everything), while compacted or
-    long-window files cover each series' whole range (series stats
-    tight, time stats useless). Mean span / domain span measures how
-    well cuts on a dimension will prune row groups."""
-    def ratio(lo_i: int, hi_i: int) -> float:
-        los = [s[lo_i] for s in stats]
-        his = [s[hi_i] for s in stats]
-        domain = max(his) - min(los) + 1
-        if domain <= 0:
-            return 1.0
-        spans = [h - l + 1 for l, h in zip(los, his)]
-        return (sum(spans) / len(spans)) / domain
+    Merge-freedom beats pruning tightness — the cold scan's dominant
+    host cost is the (sid, ts) merge sort, which vanishes when a slice
+    covers whole sorted runs. So:
 
-    return "series" if ratio(2, 3) < ratio(0, 1) else "time"
+    - chains of budget-sized time-disjoint components (in-order flushes
+      and bulk loads) become TIME slices on their gap boundaries;
+    - an oversized overlapping component (a big compacted file, or
+      several files covering the same window) is sliced on SERIES id
+      within its time range instead: SSTs sort by series first, so
+      series row-group stats are tight there, and a series slice of a
+      single file is itself one sorted run.
+    """
+    clipped = []
+    for tlo, thi, slo, shi, rows in stats:
+        if time_lo is not None and thi < time_lo:
+            continue
+        if time_hi is not None and tlo >= time_hi:
+            continue
+        clipped.append((tlo, thi, slo, shi, rows))
+    if not clipped:
+        return []
+    # connected components over time: [lo, hi, rows, chunks]
+    comps: List[list] = []
+    for ch in sorted(clipped):
+        if comps and ch[0] <= comps[-1][1]:
+            c = comps[-1]
+            c[1] = max(c[1], ch[1])
+            c[2] += ch[4]
+            c[3].append(ch)
+        else:
+            comps.append([ch[0], ch[1], ch[4], [ch]])
+
+    def clamp(lo: int, end: int) -> Tuple[int, int]:
+        if time_lo is not None:
+            lo = max(lo, time_lo)
+        if time_hi is not None:
+            end = min(end, time_hi)
+        return lo, end
+
+    jobs: List[Tuple[str, int, int, Optional[TimestampRange]]] = []
+    min_clean = max(_ROW_BUCKET_MIN, budget // 8)
+    pend_lo: Optional[int] = None
+    pend_rows = 0
+    prev_hi: Optional[int] = None
+
+    def flush_pending() -> None:
+        nonlocal pend_lo, pend_rows
+        if pend_lo is not None:
+            lo, end = clamp(pend_lo, prev_hi + 1)
+            if lo < end:
+                jobs.append(("time", lo, end, None))
+        pend_lo = None
+        pend_rows = 0
+
+    for clo, chi, crows, chunks in comps:
+        if crows > budget:
+            flush_pending()
+            lo, end = clamp(clo, chi + 1)
+            clip = TimestampRange(lo, end, unit)
+            sstats = [(c[2], c[3], c[4]) for c in chunks]
+            sslices = _plan_slices(sstats, budget, None, None)
+            if len(sslices) > 1:
+                for slo, shi in sslices:
+                    jobs.append(("series", slo, shi, clip))
+            else:
+                # the series axis cannot subdivide (few series, or every
+                # chunk spans the whole sid domain): fall back to time
+                # budget cuts — those slices pay the merge sort but stay
+                # bounded
+                tstats = [(c[0], c[1], c[4]) for c in chunks]
+                for tlo2, thi2 in _plan_slices(tstats, budget, lo, end):
+                    jobs.append(("time", tlo2, thi2, None))
+        else:
+            if pend_lo is not None and (pend_rows >= min_clean
+                                        or pend_rows + crows > budget):
+                flush_pending()
+            if pend_lo is None:
+                pend_lo = clo
+            pend_rows += crows
+        prev_hi = chi
+    flush_pending()
+    return jobs
 
 
 def _slice_dedup(data) -> Optional[np.ndarray]:
@@ -186,10 +308,189 @@ def _slice_dedup(data) -> Optional[np.ndarray]:
     return merge_dedup_numpy(s, t, q, data.op_types)
 
 
+def _host_partial_frame(data, kept: Optional[np.ndarray], plan, sd
+                        ) -> Optional[pd.DataFrame]:
+    """One-pass vectorized host reduction of a sorted slice into the
+    same partial moment frame shape `tpu_exec._collect_moment_frame`
+    emits, so `_finalize` folds host and device partials identically.
+
+    Everything is segment arithmetic over the (sid [, bucket]) run
+    boundaries: `np.<ufunc>.reduceat` per moment, masks folded into the
+    identity element. Runs are (sid, ts)-sorted, so first/last reduce to
+    the min/max valid row index per run."""
+    from .planner import _group_slot
+
+    sids, ts = data.series_ids, data.ts
+    fields = data.fields
+    n = len(ts)
+    if n == 0:
+        return None
+
+    # ---- base row mask (dedup + tag predicates + time/field filters) ----
+    mask: Optional[np.ndarray] = None
+
+    def and_mask(m: np.ndarray) -> None:
+        nonlocal mask
+        mask = m if mask is None else mask & m
+
+    if kept is not None:
+        if len(kept) > 1 and not bool(np.all(kept[1:] > kept[:-1])):
+            # fallback merge-dedup: `kept` is in (sid, ts) SORT order, so
+            # the arrays must be gathered before run detection — a keep
+            # mask over the unsorted input would group nothing
+            sids = sids[kept]
+            ts = ts[kept]
+            fields = {nm: (d[kept], vd[kept] if vd is not None else None)
+                      for nm, (d, vd) in fields.items()}
+            n = len(ts)
+        else:
+            km = np.zeros(n, dtype=bool)
+            km[kept] = True
+            and_mask(km)
+    if plan.tag_predicates:
+        from .expr import Evaluator
+        S = sd.num_series
+        tag_cols = {}
+        for i, tname in enumerate(sd.tag_names):
+            tag_cols[tname] = sd.decode_tag_column(
+                np.arange(S, dtype=np.int32), i)
+        sdf = pd.DataFrame(tag_cols)
+        ev = Evaluator(sdf)
+        smask = np.ones(S, dtype=bool)
+        for p in plan.tag_predicates:
+            m = ev.eval(p)
+            m = m.fillna(False).astype(bool).to_numpy() \
+                if isinstance(m, pd.Series) else np.full(S, bool(m))
+            smask &= m
+        if not smask.any():
+            return None
+        and_mask(smask[sids])
+    if plan.time_lo is not None:
+        and_mask(ts >= plan.time_lo)
+    if plan.time_hi is not None:
+        and_mask(ts < plan.time_hi)
+    for ff in plan.field_filters:
+        vals, valid = fields[ff.column]
+        if vals.dtype == object:
+            from ..errors import UnsupportedError
+            raise UnsupportedError(f"filter on non-numeric {ff.column}")
+        v = vals.astype(np.float64, copy=False)
+        cmp = {"eq": v == ff.value, "ne": v != ff.value,
+               "lt": v < ff.value, "le": v <= ff.value,
+               "gt": v > ff.value, "ge": v >= ff.value}[ff.op]
+        if valid is not None:
+            cmp &= valid
+        and_mask(cmp)
+    if mask is not None and not mask.any():
+        return None
+
+    # ---- run boundaries over (sid [, bucket]) ----
+    buckets = None
+    if plan.bucket is not None:
+        b = plan.bucket
+        buckets = (ts - b.origin) // b.stride_ms
+        flags = np.empty(n, dtype=bool)
+        flags[0] = True
+        np.not_equal(sids[1:], sids[:-1], out=flags[1:])
+        flags[1:] |= buckets[1:] != buckets[:-1]
+        starts = np.nonzero(flags)[0]
+    elif plan.tag_groups:
+        flags = np.empty(n, dtype=bool)
+        flags[0] = True
+        np.not_equal(sids[1:], sids[:-1], out=flags[1:])
+        starts = np.nonzero(flags)[0]
+    else:
+        starts = np.zeros(1, dtype=np.int64)
+    nruns = len(starts)
+
+    if mask is None:
+        counts = np.diff(starts, append=n).astype(np.int64)
+    else:
+        counts = np.add.reduceat(mask.astype(np.int64), starts)
+    live = counts > 0
+    if not live.any():
+        return None
+
+    f64max = np.finfo(np.float64).max
+    i64max = np.iinfo(np.int64).max
+    frame: Dict[str, np.ndarray] = {}
+    for tg in plan.tag_groups:
+        frame[_group_slot(tg.name)] = sd.decode_tag_column(
+            sids[starts], tg.tag_index)
+    if plan.bucket is not None:
+        frame[_group_slot(plan.bucket.expr_key)] = \
+            buckets[starts] * plan.bucket.stride_ms + plan.bucket.origin
+
+    arange = None
+    for m in plan.moments:
+        if m.column is None:             # plain row count
+            frame[m.slot] = counts
+            continue
+        d, vd = fields[m.column]
+        valid = vd if mask is None else (
+            mask if vd is None else (vd & mask))
+        if m.op in ("min_ts", "max_ts"):
+            tsv = ts if valid is None else np.where(valid, ts, i64max
+                                                    if m.op == "min_ts"
+                                                    else -i64max)
+            r = (np.minimum if m.op == "min_ts"
+                 else np.maximum).reduceat(tsv, starts)
+        elif m.op == "count":
+            r = counts if valid is None or valid is mask else \
+                np.add.reduceat(valid.astype(np.int64), starts)
+        elif m.op in ("first", "last"):
+            if arange is None:
+                arange = np.arange(n, dtype=np.int64)
+            if m.op == "first":
+                idx = np.minimum.reduceat(
+                    arange if valid is None
+                    else np.where(valid, arange, n), starts)
+                empty = idx >= n
+            else:
+                idx = np.maximum.reduceat(
+                    arange if valid is None
+                    else np.where(valid, arange, -1), starts)
+                empty = idx < 0
+            vals = d[np.clip(idx, 0, n - 1)].astype(np.float64, copy=False)
+            if empty.any():
+                vals = vals.copy()
+                vals[empty] = np.nan
+            r = vals
+        else:
+            dv = d.astype(np.float64, copy=False)
+            if m.op == "sum":
+                r = np.add.reduceat(
+                    dv if valid is None else np.where(valid, dv, 0.0),
+                    starts)
+            elif m.op == "sum_sq":
+                sq = dv * dv
+                r = np.add.reduceat(
+                    sq if valid is None else np.where(valid, sq, 0.0),
+                    starts)
+            elif m.op == "min":
+                r = np.minimum.reduceat(
+                    dv if valid is None else np.where(valid, dv, f64max),
+                    starts)
+            elif m.op == "max":
+                r = np.maximum.reduceat(
+                    dv if valid is None else np.where(valid, dv, -f64max),
+                    starts)
+            else:  # pragma: no cover — planner only emits the ops above
+                from ..errors import UnsupportedError
+                raise UnsupportedError(f"host moment op {m.op!r}")
+        frame[m.slot] = r
+    frame["__rowcount"] = counts
+    df = pd.DataFrame(frame)[live]
+    return df if len(df) else None
+
+
 def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
                 series_dict, row_bucket_min: int,
-                time_range: Optional[TimestampRange]):
-    """Read + merge + dedup one slice into a padded transient MergedScan.
+                time_range: Optional[TimestampRange],
+                plan=None, reduce: str = "device"):
+    """Read + merge + dedup one slice; reduce it on the host (returning
+    a partial moment frame) or prepare it for the device kernel
+    (returning a padded transient MergedScan).
 
     `dim` selects the partition axis: "time" slices [lo, hi) on the time
     index, "series" on __series_id (with the query's time filter still
@@ -206,6 +507,9 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
     if data.num_rows == 0:
         return None
     kept = _slice_dedup(data)
+    if reduce == "host":
+        return ("frame",
+                _host_partial_frame(data, kept, plan, series_dict))
     n = data.num_rows if kept is None else len(kept)
     if n == 0:
         return None
@@ -300,25 +604,17 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     stats = _region_slice_stats(region, snap, unit)
     if not stats:
         return []
-    dim = _pick_slice_dim(stats)
-    if dim == "series":
-        dstats = [(s[2], s[3], s[4]) for s in stats]
-        clip_lo = clip_hi = None
-        query_range = None
-        if plan.time_lo is not None or plan.time_hi is not None:
-            query_range = TimestampRange(plan.time_lo, plan.time_hi, unit)
-    else:
-        dstats = [(s[0], s[1], s[4]) for s in stats]
-        clip_lo, clip_hi = plan.time_lo, plan.time_hi
-        query_range = None
-    slices = _plan_slices(dstats, _SLICE_ROWS[0], clip_lo, clip_hi)
-    if not slices:
+    jobs = _plan_jobs(stats, _SLICE_ROWS[0], plan.time_lo, plan.time_hi,
+                      unit)
+    if not jobs:
         return []
     needed = sorted({m.column for m in plan.moments if m.column is not None}
                     | {ff.column for ff in plan.field_filters})
     sd = region.series_dict
 
+    mode = _COLD_REDUCE[0]
     launched = []
+    frames: List[pd.DataFrame] = []
     # two-deep prefetch: decode slices i+1, i+2 while slice i launches
     # (decode is the cold-path bottleneck; two workers keep parquet
     # threads busy without unbounded slice residency)
@@ -326,27 +622,49 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     with ThreadPoolExecutor(max_workers=depth,
                             thread_name_prefix="stream-scan") as pool:
         futs = [pool.submit(_load_slice, snap, dim, lo, hi, unit, needed,
-                            sd, _ROW_BUCKET_MIN, query_range)
-                for lo, hi in slices[:depth]]
-        for i in range(len(slices)):
+                            sd, _ROW_BUCKET_MIN, clip, plan, mode)
+                for dim, lo, hi, clip in jobs[:depth]]
+        for i in range(len(jobs)):
             scan = futs[i].result()
-            if i + depth < len(slices):
-                lo, hi = slices[i + depth]
+            if i + depth < len(jobs):
+                dim, lo, hi, clip = jobs[i + depth]
                 futs.append(pool.submit(_load_slice, snap, dim, lo, hi,
                                         unit, needed, sd, _ROW_BUCKET_MIN,
-                                        query_range))
+                                        clip, plan, mode))
             futs[i] = None                   # free the slice as we go
             if scan is None:
+                continue
+            if isinstance(scan, tuple) and scan[0] == "frame":
+                if scan[1] is not None and len(scan[1]):
+                    frames.append(scan[1])
                 continue
             ln = _launch_scan_kernel(scan, schema, plan)
             if ln is not None:
                 launched.append(ln)
             del scan
     if not launched:
-        return []
-    fetched = jax.device_get([(ln.counts, list(ln.results))
-                              for ln in launched])
-    frames: List[pd.DataFrame] = []
+        return frames
+    # overlap the D2H copies: fetch every per-slice array concurrently —
+    # a sequential device_get pays the (tunneled) device-link round-trip
+    # latency once per array, which dominates for these small partials
+    flat: List = []
+    for ln in launched:
+        flat.append(ln.counts)
+        flat.extend(ln.results)
+    for arr in flat:
+        if hasattr(arr, "copy_to_host_async"):
+            try:
+                arr.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — async staging is optional
+                break
+    with ThreadPoolExecutor(max_workers=min(8, len(flat))) as fpool:
+        flat_np = list(fpool.map(np.asarray, flat))
+    fetched = []
+    pos = 0
+    for ln in launched:
+        k = len(ln.results)
+        fetched.append((flat_np[pos], flat_np[pos + 1:pos + 1 + k]))
+        pos += 1 + k
     for ln, (counts, res_np) in zip(launched, fetched):
         part = _collect_moment_frame(ln, plan, counts, res_np)
         if part is not None and len(part):

@@ -882,9 +882,9 @@ def _launch_scan_kernel(scan: MergedScan, schema,
             if not plan.tag_groups:
                 flags[:] = False
                 flags[0] = True
-        rid = np.cumsum(flags, dtype=np.int32) - 1
-        nruns = int(rid[-1]) + 1
+        rid = None          # lazy: only first/last reads per-row run ids
         run_starts = np.nonzero(flags)[0]
+        nruns = len(run_starts)
         scan.device[run_key] = (rid, nruns, run_starts, buckets)
 
     # ---- host: per-series tag predicate → row mask ----
@@ -908,29 +908,36 @@ def _launch_scan_kernel(scan: MergedScan, schema,
             return None
         base_mask = smask[sids]
 
-    # ---- row mask (host; cheap elementwise) ----
-    mask = base_mask if base_mask is not None else np.ones(n, dtype=bool)
-    if base_mask is not None:
-        mask = mask.copy()
-    if scan.valid_rows is not None and scan.valid_rows < n:
-        mask[scan.valid_rows:] = False   # shape-bucket padding rows
-    if plan.time_lo is not None:
-        mask &= scan.ts >= plan.time_lo
-    if plan.time_hi is not None:
-        mask &= scan.ts < plan.time_hi
-    for ff in plan.field_filters:
-        vals, valid = scan.fields[ff.column]
-        if vals.dtype == object:
-            raise UnsupportedError(f"filter on non-numeric {ff.column}")
-        v = vals.astype(np.float64)
-        cmp = {"eq": v == ff.value, "ne": v != ff.value,
-               "lt": v < ff.value, "le": v <= ff.value,
-               "gt": v > ff.value, "ge": v >= ff.value}[ff.op]
-        if valid is not None:
-            cmp &= valid
-        mask &= cmp
-    if not mask.any():
-        return None
+    # ---- row mask (host; cheap elementwise, skipped entirely for the
+    # unfiltered case so unpadded/pre-staged scans touch no O(n) host
+    # memory here) ----
+    unfiltered = base_mask is None and plan.time_lo is None and \
+        plan.time_hi is None and not plan.field_filters
+    mask = None
+    if not (unfiltered and (scan.valid_rows is None
+                            or "__pad_mask" in scan.device)):
+        mask = base_mask.copy() if base_mask is not None \
+            else np.ones(n, dtype=bool)
+        if scan.valid_rows is not None and scan.valid_rows < n:
+            mask[scan.valid_rows:] = False   # shape-bucket padding rows
+        if plan.time_lo is not None:
+            mask &= scan.ts >= plan.time_lo
+        if plan.time_hi is not None:
+            mask &= scan.ts < plan.time_hi
+        for ff in plan.field_filters:
+            vals, valid = scan.fields[ff.column]
+            if vals.dtype == object:
+                raise UnsupportedError(
+                    f"filter on non-numeric {ff.column}")
+            v = vals.astype(np.float64)
+            cmp = {"eq": v == ff.value, "ne": v != ff.value,
+                   "lt": v < ff.value, "le": v <= ff.value,
+                   "gt": v > ff.value, "ge": v >= ff.value}[ff.op]
+            if valid is not None:
+                cmp &= valid
+            mask &= cmp
+        if not mask.any():
+            return None
 
     # ---- device kernel (module-level jit; compile cache shared across
     # queries with the same moment signature + shape bucket) ----
@@ -939,12 +946,10 @@ def _launch_scan_kernel(scan: MergedScan, schema,
     # unfiltered queries reuse the cached all-true device mask instead of
     # uploading n bool bytes per query (50 MB at 50M rows, per query);
     # padded streamed slices reuse the pre-staged padding mask
-    unfiltered = base_mask is None and plan.time_lo is None and \
-        plan.time_hi is None and not plan.field_filters
-    if unfiltered and scan.valid_rows is None:
-        d_mask = scan.device_valid_all()
-    elif unfiltered and "__pad_mask" in scan.device:
-        d_mask = scan.device["__pad_mask"]
+    if mask is None:
+        d_mask = scan.device["__pad_mask"] \
+            if scan.valid_rows is not None \
+            else scan.device_valid_all()
     else:
         d_mask = jax.device_put(mask)
 
@@ -975,10 +980,18 @@ def _launch_scan_kernel(scan: MergedScan, schema,
     run_ends = np.full(nbucket, n, dtype=np.int32)
     run_ends[:nruns - 1] = run_starts[1:]
     # with host ends the kernel reads gids only for first/last (arg-extreme
-    # tie-break); for every other op ts stands in for shape and the O(n)
-    # rid upload is skipped
+    # tie-break); for every other op ts stands in for shape and both the
+    # O(n) rid cumsum and its upload are skipped
     needs_gids = any(op in ("first", "last") for op in ops)
-    d_rid = jax.device_put(rid) if needs_gids else d_ts
+    if needs_gids:
+        if rid is None:
+            starts_mark = np.zeros(n, dtype=np.int32)
+            starts_mark[run_starts[1:]] = 1
+            rid = np.cumsum(starts_mark, dtype=np.int32)
+            scan.device[run_key] = (rid, nruns, run_starts, buckets)
+        d_rid = jax.device_put(rid)
+    else:
+        d_rid = d_ts
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
         num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
@@ -1057,8 +1070,33 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
 
     if key_cols:
         if df[key_cols + list(moment_cols)].duplicated(key_cols).any():
-            merged = df.groupby(key_cols, dropna=False, sort=False) \
-                .apply(merge, include_groups=False).reset_index()
+            # vectorized fold: one groupby.agg for the decomposable
+            # moments (a per-group Python merge costs seconds at 10k+
+            # groups — slice streaming produces one partial per group
+            # per slice), plus a sort+first/last pass for ts-extremes
+            gb = df.groupby(key_cols, dropna=False, sort=False)
+            aggs = {}
+            extremes = []
+            for slot, m in moment_cols.items():
+                if m.op in ("sum", "sum_sq", "count"):
+                    aggs[slot] = "sum"
+                elif m.op in ("min", "min_ts"):
+                    aggs[slot] = "min"
+                elif m.op in ("max", "max_ts"):
+                    aggs[slot] = "max"
+                else:
+                    extremes.append((slot, m))
+            merged = gb.agg(aggs)
+            for slot, m in extremes:
+                # groupby.first()/.last() take the first/last NON-NULL
+                # value in frame order; sorting by the companion ts makes
+                # that "valid partial with extreme ts" exactly
+                kind = "min_ts" if m.op == "first" else "max_ts"
+                ts_slot = _ts_slot_for(m, kind)
+                srt = df.sort_values(ts_slot, kind="stable")
+                gs = srt.groupby(key_cols, dropna=False, sort=False)[slot]
+                merged[slot] = gs.first() if m.op == "first" else gs.last()
+            merged = merged.reset_index()
         else:
             merged = df
     else:

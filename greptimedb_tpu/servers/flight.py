@@ -171,10 +171,21 @@ class FlightDatanodeServer(flight.FlightServerBase):
         cmd = json.loads(descriptor.command)
         if cmd.get("type") != "write_region":
             raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
-        columns = _arrow_to_columns(reader.read_all())
+        tbl = reader.read_all()
+        op = cmd.get("op", "put")
+        target = self.datanode.catalog.table(
+            cmd["catalog"], cmd["schema"], cmd["table"]) \
+            if op == "bulk" else None
+        if target is not None:
+            # bulk path: typed ndarray columns feed bulk_ingest's raw
+            # fast path instead of a per-value pylist round trip
+            from ..datatypes.record_batch import arrow_to_ingest_columns
+            columns = arrow_to_ingest_columns(tbl, target.schema)
+        else:
+            columns = _arrow_to_columns(tbl)
         n = self.local.write_region(
             cmd["catalog"], cmd["schema"], cmd["table"],
-            cmd["region_number"], columns, op=cmd.get("op", "put"))
+            cmd["region_number"], columns, op=op)
         writer.write(pa.py_buffer(
             json.dumps({"affected_rows": n}).encode()))
 
@@ -316,12 +327,32 @@ class FlightFrontendServer(flight.FlightServerBase):
 
     def do_put(self, context, descriptor, reader, writer):
         cmd = json.loads(descriptor.command)
-        if cmd.get("type") != "row_insert":
-            raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
-        columns = _arrow_to_columns(reader.read_all())
-        n = self.frontend.handle_row_insert(
-            cmd["table"], columns,
-            tag_columns=cmd.get("tag_columns", ()),
-            timestamp_column=cmd.get("timestamp_column", "greptime_timestamp"))
+        kind = cmd.get("type")
+        if kind == "row_insert":
+            columns = _arrow_to_columns(reader.read_all())
+            n = self.frontend.handle_row_insert(
+                cmd["table"], columns,
+                tag_columns=cmd.get("tag_columns", ()),
+                timestamp_column=cmd.get("timestamp_column",
+                                         "greptime_timestamp"))
+        elif kind == "bulk_load":
+            # WAL-less bulk path: keep columns arrow→ndarray end to end
+            # when the table already exists (the bulk_ingest raw fast
+            # path); fall back to python lists for auto-create inference
+            from ..datatypes.record_batch import arrow_to_ingest_columns
+            tbl = reader.read_all()
+            from ..session import QueryContext
+            ctx = QueryContext()
+            target = self.frontend.catalog.table(
+                ctx.current_catalog, ctx.current_schema, cmd["table"])
+            columns = _arrow_to_columns(tbl) if target is None else \
+                arrow_to_ingest_columns(tbl, target.schema, extra="keep")
+            n = self.frontend.handle_bulk_load(
+                cmd["table"], columns,
+                tag_columns=cmd.get("tag_columns", ()),
+                timestamp_column=cmd.get("timestamp_column",
+                                         "greptime_timestamp"), ctx=ctx)
+        else:
+            raise GreptimeError(f"unsupported put {kind!r}")
         writer.write(pa.py_buffer(
             json.dumps({"affected_rows": n}).encode()))

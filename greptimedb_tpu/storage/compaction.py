@@ -90,6 +90,27 @@ def run_compaction(region, plan: CompactionPlan,
     schema = region.schema
     field_names = [c.name for c in schema.field_columns()]
 
+    # trivial move (RocksDB-style): time-disjoint L0 files cannot hold
+    # competing versions of any (series, ts) key, so re-levelling them is
+    # a metadata-only edit — no read, no merge, no rewrite. This is the
+    # common case for in-order telemetry (every flush/bulk-load covers a
+    # fresh window) and keeps sustained ingest from paying a full region
+    # rewrite every max_l0_files batches.
+    if plan.inputs and not plan.expired and ttl_ms is None:
+        from dataclasses import replace as _dc_replace
+        by_lo = sorted(plan.inputs, key=lambda f: f.time_range[0])
+        disjoint = all(
+            not by_lo[i].keys_overlap(by_lo[j])
+            for i in range(len(by_lo)) for j in range(i + 1, len(by_lo)))
+        if disjoint:
+            moved = [_dc_replace(f, level=1) for f in by_lo]
+            region.commit_compaction(
+                removed=[f.file_name for f in by_lo], added=moved,
+                purge=False)
+            logger.info("region %s trivially moved %d disjoint L0 files "
+                        "to L1", region.name, len(moved))
+            return moved
+
     retracts = bool(plan.expired)
     new_files: List[FileMeta] = []
     if plan.inputs:

@@ -176,6 +176,13 @@ class RegionSnapshot:
             sids1, ts1, seq1, op1, fields1 = runs[0]
             return ScanData(schema, region.series_dict, sids1, ts1, seq1,
                             op1, fields1)
+        # order runs by their first (sid, ts): key-disjoint sorted runs
+        # (sid-chunked bulk loads, series-sliced reads) then concatenate
+        # into a globally sorted array and downstream consumers skip the
+        # merge sort entirely; overlapping runs are unaffected (they get
+        # merge-sorted anyway)
+        runs.sort(key=lambda r: (int(r[0][0]), int(r[1][0]))
+                  if len(r[0]) else (0, 0))
         series_ids = np.concatenate([r[0] for r in runs])
         ts = np.concatenate([r[1] for r in runs])
         seq = np.concatenate([r[2] for r in runs])
@@ -335,6 +342,12 @@ class Region:
                     [FileMeta.from_dict(f) for f in a.get("added", [])])
                 flushed_sequence = max(flushed_sequence,
                                        a.get("flushed_sequence", 0))
+                # bulk loads burn sequences into SSTs without WAL entries
+                # and may cap flushed_sequence below them — recovery must
+                # not re-issue those sequences (equal (sid, ts, seq) keys
+                # have an undefined MVCC winner)
+                committed_sequence = max(committed_sequence,
+                                         a.get("committed_sequence", 0))
                 if a.get("series_dict_file"):
                     dict_file = a["series_dict_file"]
             elif a["type"] == "remove":
@@ -421,7 +434,8 @@ class Region:
             self._flush_done.wait(timeout=300)
         return batch.num_rows
 
-    def bulk_ingest(self, data, *, chunk_rows: int = 1_000_000) -> int:
+    def bulk_ingest(self, data, *,
+                    chunk_rows: Optional[int] = None) -> int:
         """WAL-less bulk load: sort, series-encode, and write the batch
         straight to L0 SSTs — in parallel chunks — then commit one
         manifest edit. Durability comes from the SSTs themselves (the
@@ -434,8 +448,18 @@ class Region:
         Any buffered memtable rows are flushed first so the manifest's
         flushed_sequence may advance past this batch's sequence without
         orphaning their WAL entries at replay."""
+        import os as _os
+
         from ..common.runtime import parallel_map
         from ..ops.kernels import _merge_order
+
+        if chunk_rows is None:
+            # one SST per writer core: chunking only pays when parquet
+            # encodes run concurrently, and fewer files mean single-run
+            # (merge-free) scan slices later
+            cpus = _os.cpu_count() or 1
+            n_in = len(next(iter(data.values()))) if data else 0
+            chunk_rows = max(2_000_000, -(-n_in // cpus))
 
         vc = self.version_control
         schema0 = vc.current.schema
@@ -518,15 +542,16 @@ class Region:
             seq_arr = np.full(n, seq, dtype=np.int64)
             op_arr = np.zeros(n, dtype=np.int8)
 
-            # chunk at key boundaries (a (sid, ts) key must not span two
-            # files: both rows would carry the same sequence, leaving the
-            # MVCC winner undefined) and write the SSTs concurrently —
-            # parquet encode drops the GIL
+            # chunk at SERIES boundaries: a (sid, ts) key must not span
+            # two files (same sequence → undefined MVCC winner), and
+            # keeping whole series per file makes the chunks' key
+            # rectangles disjoint — so compaction trivially moves them
+            # instead of rewriting the region. Write SSTs concurrently;
+            # parquet encode drops the GIL.
             cuts = [0]
             pos = chunk_rows
             while pos < n:
-                while pos < n and sids[pos] == sids[pos - 1] and \
-                        ts[pos] == ts[pos - 1]:
+                while pos < n and sids[pos] == sids[pos - 1]:
                     pos += 1
                 if pos < n:
                     cuts.append(pos)
@@ -552,12 +577,28 @@ class Region:
                                              range(len(cuts) - 1))
                      if f is not None]
             flushed_seq = max(seq, vc.current.flushed_sequence)
+            # a write() may have landed between the pre-lock flush and
+            # acquiring the lock: its WAL entry carries a lower sequence,
+            # and advancing flushed_sequence past it would skip it at
+            # replay (WAL replays from flushed_sequence + 1). Cap below
+            # the lowest unflushed memtable sequence; the bulk rows need
+            # no WAL replay (they are durable in the SSTs just written).
+            unflushed = [int(ms.seq.min()) for ms in
+                         (mt.snapshot()
+                          for mt in vc.current.memtables.all_memtables())
+                         if ms.num_rows]
+            if unflushed:
+                flushed_seq = min(flushed_seq, min(unflushed) - 1)
             dict_file = self._persist_series_dict()
             edit = {
                 "type": "edit",
                 "added": [f.to_dict() for f in files],
                 "removed": [],
                 "flushed_sequence": flushed_seq,
+                # the batch's sequence is durable in the SSTs even when
+                # flushed_sequence is capped below it (unflushed racing
+                # write) — persist it so recovery never re-issues it
+                "committed_sequence": seq,
             }
             if dict_file:
                 edit["series_dict_file"] = dict_file
@@ -771,11 +812,14 @@ class Region:
 
     def commit_compaction(self, *, removed: List[str],
                           added: List[FileMeta],
-                          retracts: bool = False) -> None:
+                          retracts: bool = False,
+                          purge: bool = True) -> None:
         """Swap compaction outputs into the version + manifest and hand the
         removed files to the purger (they stay readable until the grace
         period passes). retracts=True marks that visible rows disappeared
-        (TTL expiry), invalidating incremental scan caches."""
+        (TTL expiry), invalidating incremental scan caches. purge=False is
+        the trivial-move case: `removed` names reappear in `added` at a
+        deeper level (same physical files), so nothing may be deleted."""
         with self._writer_lock:
             if self.closed:
                 return
@@ -789,10 +833,12 @@ class Region:
             if retracts:
                 self.retraction_epoch += 1
             self._maybe_checkpoint()
-        for name in removed:
-            if self.purger is not None:
-                self.purger.schedule(
-                    (lambda n=name: self.access_layer.delete_sst(n)), name)
+        if purge:
+            for name in removed:
+                if self.purger is not None:
+                    self.purger.schedule(
+                        (lambda n=name: self.access_layer.delete_sst(n)),
+                        name)
 
     # ---- TTL ----
     def apply_ttl(self, now_ms: Optional[int] = None) -> int:

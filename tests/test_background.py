@@ -197,14 +197,16 @@ class TestCompaction:
         are purged only after the grace period."""
         eng = mk_engine(tmp_path, purge_grace_s=3600)
         r = eng.create_region("r", monitor_schema())
+        # overlapping time ranges so compaction really rewrites (disjoint
+        # files would be trivially moved and nothing purged)
         for gen in range(2):
-            put(r, ["a"], [gen], [float(gen)])
+            put(r, ["a", "a"], [gen, gen + 1], [float(gen)] * 2)
             r.flush()
         snap_before = r.snapshot()
         r.compact()
         # old snapshot still reads the (now removed) input files
         data = snap_before.read_merged()
-        assert data.num_rows == 2
+        assert data.num_rows == 3
         assert eng.purger.pending_count == 2
         eng.close()
 
@@ -212,7 +214,7 @@ class TestCompaction:
         eng = mk_engine(tmp_path, purge_grace_s=0.0)
         r = eng.create_region("r", monitor_schema())
         for gen in range(2):
-            put(r, ["a"], [gen], [float(gen)])
+            put(r, ["a", "a"], [gen, gen + 1], [float(gen)] * 2)
             r.flush()
         names = [f.file_name for f in
                  r.version_control.current.ssts.levels[0]]
@@ -221,8 +223,35 @@ class TestCompaction:
         for n in names:
             assert not eng.store.exists(f"{r.descriptor.region_dir}/sst/{n}")
         # region still reads fine from L1
-        assert len(rows_of(r)) == 2
+        assert len(rows_of(r)) == 3
         eng.close()
+
+    def test_trivial_move_for_disjoint_files(self, tmp_path):
+        """Time-disjoint L0 files re-level to L1 without a rewrite: same
+        physical files, nothing purged, data intact."""
+        eng = mk_engine(tmp_path, purge_grace_s=0.0)
+        r = eng.create_region("r", monitor_schema())
+        for gen in range(3):
+            put(r, ["a", "b"], [gen * 10, gen * 10 + 1], [float(gen)] * 2)
+            r.flush()
+        names = sorted(f.file_name for f in
+                       r.version_control.current.ssts.levels[0])
+        r.compact()
+        v = r.version_control.current
+        assert not v.ssts.levels[0]
+        assert sorted(f.file_name for f in v.ssts.levels[1]) == names
+        assert eng.purger.sweep() == 0          # nothing deleted
+        for n in names:
+            assert eng.store.exists(f"{r.descriptor.region_dir}/sst/{n}")
+        assert len(rows_of(r)) == 6
+        # survives restart (manifest replays the move edit)
+        eng.close()
+        eng2 = mk_engine(tmp_path)
+        r2 = eng2.open_region("r")
+        v2 = r2.version_control.current
+        assert sorted(f.file_name for f in v2.ssts.levels[1]) == names
+        assert len(rows_of(r2)) == 6
+        eng2.close()
 
     def test_auto_compaction_trigger(self, tmp_path):
         eng = mk_engine(tmp_path, flush_size_bytes=500, max_l0_files=2)
@@ -392,7 +421,7 @@ class TestReviewRegressions:
         eng = mk_engine(tmp_path, purge_grace_s=3600)
         r = eng.create_region("r", monitor_schema())
         for gen in range(2):
-            put(r, ["a"], [gen], [float(gen)])
+            put(r, ["a", "a"], [gen, gen + 1], [float(gen)] * 2)
             r.flush()
         names = [f.file_name for f in
                  r.version_control.current.ssts.levels[0]]

@@ -1,0 +1,445 @@
+"""Bulk load path: WAL-less direct-to-SST ingest and its wiring.
+
+Mirrors the reference's direct part writes + COPY FROM tests
+(src/storage/src/region/writer.rs:394-433, operator COPY flows):
+correctness vs the WAL+memtable write path, crash-safety around the
+manifest commit point, concurrent-write sequence capping, partitioned
+routing, COPY FROM / Flight do_put integration, and compressed COPY.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    FLOAT64, STRING, TIMESTAMP_MILLISECOND, ColumnSchema, Schema,
+    SemanticType,
+)
+from greptimedb_tpu.storage import EngineConfig, StorageEngine, WriteBatch
+
+
+def monitor_schema() -> Schema:
+    return Schema([
+        ColumnSchema("host", STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("cpu", FLOAT64),
+        ColumnSchema("memory", FLOAT64),
+    ])
+
+
+def make_engine(tmp_path, sub="a", **kwargs) -> StorageEngine:
+    return StorageEngine(EngineConfig(data_home=str(tmp_path / sub),
+                                      **kwargs))
+
+
+def merged_rows(region):
+    data = region.snapshot().read_merged()
+    hosts = data.series_dict.decode_tag_column(data.series_ids, 0)
+    cpu_d, cpu_v = data.fields["cpu"]
+    mem_d, mem_v = data.fields["memory"]
+    rows = []
+    for i in range(data.num_rows):
+        rows.append((
+            hosts[i], int(data.ts[i]),
+            None if cpu_v is not None and not cpu_v[i] else float(cpu_d[i]),
+            None if mem_v is not None and not mem_v[i] else float(mem_d[i]),
+        ))
+    return sorted(rows)
+
+
+class TestBulkIngest:
+    def test_matches_write_path(self, tmp_path):
+        """bulk_ingest produces exactly what write() + flush produces,
+        including NULL fields (list-with-None columns) and string tags."""
+        eng = make_engine(tmp_path)
+        r_w = eng.create_region("t/w", monitor_schema())
+        r_b = eng.create_region("t/b", monitor_schema())
+        hosts = ["h2", "h0", "h1", "h0"]
+        ts = [2000, 1000, 1500, 3000]
+        cpu = [0.5, None, 1.5, None]
+        mem = [10.0, 20.0, 30.0, 40.0]
+
+        wb = WriteBatch(r_w.schema)
+        wb.put({"host": hosts, "ts": ts, "cpu": cpu, "memory": mem})
+        r_w.write(wb)
+        r_w.flush()
+
+        r_b.bulk_ingest({"host": hosts, "ts": ts, "cpu": cpu,
+                         "memory": mem})
+        assert merged_rows(r_b) == merged_rows(r_w)
+        # bulk went straight to SSTs — nothing buffered
+        assert all(mt.num_rows == 0 for mt in
+                   r_b.version_control.current.memtables.all_memtables())
+        assert len(r_b.version_control.current.ssts.levels[0]) >= 1
+
+    def test_raw_ndarray_fast_path(self, tmp_path):
+        """All-ndarray batches (the loader shape) round-trip exactly."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        n = 50_000
+        rng = np.random.default_rng(7)
+        cols = {
+            "host": np.array([f"h{i % 37}" for i in range(n)], dtype=object),
+            "ts": np.arange(n, dtype=np.int64) * 100,
+            "cpu": rng.random(n),
+            "memory": rng.random(n),
+        }
+        assert r.bulk_ingest(cols) == n
+        data = r.snapshot().read_merged()
+        assert data.num_rows == n
+        # MVCC overwrite across a second bulk batch: same keys win by seq
+        r.bulk_ingest({"host": cols["host"][:10], "ts": cols["ts"][:10],
+                       "cpu": np.full(10, 9.0), "memory": np.zeros(10)})
+        data = r.snapshot().read_merged()
+        assert data.num_rows == n
+        hosts2 = data.series_dict.decode_tag_column(data.series_ids, 0)
+        got = {(h, int(t)): float(c)
+               for h, t, c in zip(hosts2, data.ts, data.fields["cpu"][0])}
+        for i in range(10):
+            assert got[(cols["host"][i], int(cols["ts"][i]))] == 9.0
+
+    def test_survives_reopen(self, tmp_path):
+        """Durability without the WAL: SSTs + manifest edit survive a
+        crash (no close)."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        r.bulk_ingest({"host": ["a", "b"], "ts": [1000, 2000],
+                       "cpu": [1.0, 2.0], "memory": [3.0, 4.0]})
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert merged_rows(r2) == [("a", 1000, 1.0, 3.0),
+                                   ("b", 2000, 2.0, 4.0)]
+
+    def test_concurrent_write_sequence_not_skipped(self, tmp_path):
+        """A write() landing between bulk_ingest's pre-lock flush and its
+        manifest commit must survive replay: flushed_sequence is capped
+        below the unflushed write's sequence."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        # seed + flush so the pre-lock flush check sees empty memtables
+        r.bulk_ingest({"host": ["a"], "ts": [500],
+                       "cpu": [0.1], "memory": [0.2]})
+        # simulate the race deterministically: a write sneaks in after
+        # the emptiness check (flush becomes a no-op for this call)
+        wb = WriteBatch(r.schema)
+        wb.put({"host": ["race"], "ts": [999], "cpu": [7.0],
+                "memory": [8.0]})
+        r.write(wb)
+        orig_flush = r.flush
+        r.flush = lambda: []          # the gap: bulk sees stale emptiness
+        try:
+            r.bulk_ingest({"host": ["b"], "ts": [1000],
+                           "cpu": [1.0], "memory": [2.0]})
+        finally:
+            r.flush = orig_flush
+        # crash + reopen: WAL replay must still deliver the raced write
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert ("race", 999, 7.0, 8.0) in merged_rows(r2)
+        assert ("b", 1000, 1.0, 2.0) in merged_rows(r2)
+
+    def test_crash_before_manifest_leaves_orphans_only(self, tmp_path):
+        """A crash between SST write and manifest edit loses the batch
+        (never acked) but corrupts nothing: reopen sees the prior state
+        and the half-written files are unreferenced orphans."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        r.bulk_ingest({"host": ["a"], "ts": [500],
+                       "cpu": [0.1], "memory": [0.2]})
+        orig_save = r.manifest.save
+
+        def boom(actions):
+            raise RuntimeError("crash before manifest edit")
+
+        r.manifest.save = boom
+        with pytest.raises(RuntimeError):
+            r.bulk_ingest({"host": ["lost"], "ts": [1000],
+                           "cpu": [1.0], "memory": [2.0]})
+        r.manifest.save = orig_save
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert merged_rows(r2) == [("a", 500, 0.1, 0.2)]
+        # orphan SSTs may exist on disk but none are referenced twice
+        referenced = {f.file_name for f in
+                      r2.version_control.current.ssts.all_files()}
+        assert len(referenced) == 1
+
+    def test_parallel_writers_during_bulk(self, tmp_path):
+        """Racing write()s against bulk_ingest never lose acked rows."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        errors = []
+
+        def writer(k):
+            try:
+                for i in range(20):
+                    wb = WriteBatch(r.schema)
+                    wb.put({"host": [f"w{k}"], "ts": [10_000 + k * 100 + i],
+                            "cpu": [float(i)], "memory": [0.0]})
+                    r.write(wb)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        for b in range(3):
+            r.bulk_ingest({"host": ["bulk"] * 100,
+                           "ts": list(range(b * 100, b * 100 + 100)),
+                           "cpu": [1.0] * 100, "memory": [2.0] * 100})
+        for t in threads:
+            t.join()
+        assert not errors
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        rows = merged_rows(r2)
+        assert len([x for x in rows if x[0] == "bulk"]) == 300
+        assert len([x for x in rows if x[0].startswith("w")]) == 60
+
+
+class TestFrontendBulk:
+    @pytest.fixture()
+    def fe(self, tmp_path):
+        from greptimedb_tpu.datanode import DatanodeOptions
+        from greptimedb_tpu.frontend.instance import build_standalone
+        inst = build_standalone(DatanodeOptions(
+            data_home=str(tmp_path / "fe"), register_numbers_table=False))
+        yield inst
+        inst.shutdown()
+
+    def _q(self, fe, sql):
+        out = fe.do_query(sql)
+        return out[0] if isinstance(out, list) else out
+
+    def _create(self, fe):
+        fe.do_query("CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, val DOUBLE, PRIMARY KEY(host))")
+
+    def test_handle_bulk_load_skips_wal(self, fe):
+        self._create(fe)
+        n = fe.handle_bulk_load("cpu", {
+            "host": np.array(["a", "b"], dtype=object),
+            "ts": np.array([1000, 2000], dtype=np.int64),
+            "val": np.array([1.5, 2.5])})
+        assert n == 2
+        table = fe.catalog.table("greptime", "public", "cpu")
+        region = next(iter(table.regions.values()))
+        assert all(mt.num_rows == 0 for mt in
+                   region.version_control.current.memtables.all_memtables())
+        assert len(region.version_control.current.ssts.levels[0]) == 1
+        out = self._q(fe, "SELECT host, val FROM cpu ORDER BY host")
+        assert [tuple(r) for b in out.batches for r in b.rows()] == [
+            ("a", 1.5), ("b", 2.5)]
+
+    def test_copy_from_routes_through_bulk(self, fe, tmp_path):
+        self._create(fe)
+        fe.do_query("INSERT INTO cpu VALUES ('a', 1000, 1.5), "
+                    "('b', 2000, NULL)")
+        path = str(tmp_path / "out.parquet")
+        fe.do_query(f"COPY cpu TO '{path}'")
+        fe.do_query("CREATE TABLE cpu2 (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, val DOUBLE, PRIMARY KEY(host))")
+        fe.do_query(f"COPY cpu2 FROM '{path}'")
+        out = self._q(fe, "SELECT host, val FROM cpu2 ORDER BY host")
+        assert [tuple(r) for b in out.batches for r in b.rows()] == [
+            ("a", 1.5), ("b", None)]
+        # bulk path: straight to SST, nothing in the memtable
+        t2 = fe.catalog.table("greptime", "public", "cpu2")
+        region = next(iter(t2.regions.values()))
+        assert all(mt.num_rows == 0 for mt in
+                   region.version_control.current.memtables.all_memtables())
+
+    @pytest.mark.parametrize("fmt,ext,codec", [
+        ("csv", "csv.gz", "gzip"),
+        ("csv", "csv.zst", "zstd"),
+        ("json", "json.gz", "gzip"),
+    ])
+    def test_copy_compressed_roundtrip(self, fe, tmp_path, fmt, ext, codec):
+        import pyarrow as pa
+        self._create(fe)
+        fe.do_query("INSERT INTO cpu VALUES ('a', 1000, 1.5), "
+                    "('b', 2000, 2.5)")
+        path = str(tmp_path / f"out.{ext}")
+        fe.do_query(f"COPY cpu TO '{path}' WITH (format='{fmt}')")
+        # the file really is compressed (codec magic, not plain text)
+        with open(path, "rb") as f:
+            head = f.read(4)
+        assert head[:2] == b"\x1f\x8b" if codec == "gzip" \
+            else head == b"\x28\xb5\x2f\xfd"
+        fe.do_query("CREATE TABLE cpu2 (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, val DOUBLE, PRIMARY KEY(host))")
+        fe.do_query(f"COPY cpu2 FROM '{path}' WITH (format='{fmt}')")
+        out = self._q(fe, "SELECT host, val FROM cpu2 ORDER BY host")
+        assert [tuple(r) for b in out.batches for r in b.rows()] == [
+            ("a", 1.5), ("b", 2.5)]
+
+    def test_compressed_external_table(self, fe, tmp_path):
+        import gzip
+        fe.datanode.store.write(
+            "ext/data.csv.gz",
+            gzip.compress(b"host,val\na,1.5\nb,2.5\n"))
+        fe.do_query("CREATE EXTERNAL TABLE ext WITH "
+                    "(location='ext/data.csv.gz', format='csv')")
+        out = self._q(fe, "SELECT host, val FROM ext ORDER BY host")
+        assert [tuple(r) for b in out.batches for r in b.rows()] == [
+            ("a", 1.5), ("b", 2.5)]
+
+
+class TestReviewRegressions:
+    def test_copy_nullable_timestamp_field(self, tmp_path):
+        """A second timestamp-typed FIELD column with NULLs round-trips
+        through COPY (to_pylist of raw timestamps yields datetimes the
+        validating path cannot cast — ints must be used)."""
+        from greptimedb_tpu.datanode import DatanodeOptions
+        from greptimedb_tpu.frontend.instance import build_standalone
+        fe = build_standalone(DatanodeOptions(
+            data_home=str(tmp_path / "fe"), register_numbers_table=False))
+        try:
+            fe.do_query("CREATE TABLE ev (host STRING, ts TIMESTAMP TIME "
+                        "INDEX, seen TIMESTAMP, PRIMARY KEY(host))")
+            fe.do_query("INSERT INTO ev VALUES ('a', 1000, 5000), "
+                        "('b', 2000, NULL)")
+            path = str(tmp_path / "ev.parquet")
+            fe.do_query(f"COPY ev TO '{path}'")
+            fe.do_query("CREATE TABLE ev2 (host STRING, ts TIMESTAMP TIME "
+                        "INDEX, seen TIMESTAMP, PRIMARY KEY(host))")
+            fe.do_query(f"COPY ev2 FROM '{path}'")
+            out = fe.do_query("SELECT host, seen FROM ev2 ORDER BY host")
+            out = out[0] if isinstance(out, list) else out
+            rows = [tuple(r) for b in out.batches for r in b.rows()]
+            assert rows == [("a", 5000), ("b", None)]
+        finally:
+            fe.shutdown()
+
+    def test_sequence_not_reissued_after_crash(self, tmp_path):
+        """The bulk batch's sequence survives recovery even when
+        flushed_sequence was capped below it: a post-restart overwrite
+        of a bulk key must win MVCC (never tie on sequence)."""
+        eng = make_engine(tmp_path)
+        r = eng.create_region("t/r0", monitor_schema())
+        r.bulk_ingest({"host": ["a"], "ts": [500],
+                       "cpu": [0.1], "memory": [0.2]})
+        wb = WriteBatch(r.schema)
+        wb.put({"host": ["race"], "ts": [999], "cpu": [7.0],
+                "memory": [8.0]})
+        r.write(wb)
+        orig_flush = r.flush
+        r.flush = lambda: []
+        try:
+            r.bulk_ingest({"host": ["b"], "ts": [1000],
+                           "cpu": [1.0], "memory": [2.0]})
+        finally:
+            r.flush = orig_flush
+        bulk_seq = r.version_control.committed_sequence
+        # crash + reopen: committed_sequence must not rewind past the
+        # bulk batch's (WAL-less) sequence
+        eng2 = make_engine(tmp_path)
+        r2 = eng2.open_region("t/r0")
+        assert r2.version_control.committed_sequence >= bulk_seq
+        wb = WriteBatch(r2.schema)
+        wb.put({"host": ["b"], "ts": [1000], "cpu": [99.0],
+                "memory": [2.0]})
+        r2.write(wb)
+        assert ("b", 1000, 99.0, 2.0) in merged_rows(r2)
+
+    def test_flight_bulk_load_auto_alter(self, tmp_path):
+        """Flight bulk_load matches insert()'s auto create/alter: a new
+        field column on an existing table is added, not dropped."""
+        import time as _time
+        from greptimedb_tpu.client.flight import Database
+        from greptimedb_tpu.datanode.instance import (
+            DatanodeInstance, DatanodeOptions)
+        from greptimedb_tpu.frontend.instance import FrontendInstance
+        from greptimedb_tpu.servers.flight import FlightFrontendServer
+
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "d"), register_numbers_table=False))
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        srv = FlightFrontendServer(fe)
+        srv.serve_in_background()
+        t0 = _time.time()
+        while srv.port == 0 and _time.time() - t0 < 10:
+            _time.sleep(0.01)
+        db = Database(srv.address)
+        try:
+            n = db.bulk_load("bk", {
+                "host": ["a", "b"], "greptime_timestamp": [1000, 2000],
+                "val": [1.0, 2.0]}, tag_columns=["host"])
+            assert n == 2
+            # second load brings a NEW column → auto-ALTER, data kept
+            n = db.bulk_load("bk", {
+                "host": ["c"], "greptime_timestamp": [3000],
+                "val": [3.0], "extra": [42.0]}, tag_columns=["host"])
+            assert n == 1
+            batches = db.sql("SELECT host, val, extra FROM bk "
+                             "ORDER BY host")
+            rows = [tuple(r) for b in batches for r in b.rows()]
+            assert rows == [("a", 1.0, None), ("b", 2.0, None),
+                            ("c", 3.0, 42.0)]
+        finally:
+            db.close()
+            srv.shutdown()
+            fe.shutdown()
+            dn.shutdown()
+
+
+class TestDistributedBulk:
+    def test_partitioned_routing(self, tmp_path):
+        """bulk_load splits rows across regions by the partition rule and
+        each datanode ingests WAL-less."""
+        from greptimedb_tpu import DEFAULT_CATALOG_NAME as CAT
+        from greptimedb_tpu import DEFAULT_SCHEMA_NAME as SCH
+        from greptimedb_tpu.client import LocalDatanodeClient
+        from greptimedb_tpu.datanode.instance import (
+            DatanodeInstance, DatanodeOptions)
+        from greptimedb_tpu.frontend.distributed import DistInstance
+        from greptimedb_tpu.meta import MetaClient, MetaSrv, Peer
+        from greptimedb_tpu.meta.kv import MemKv
+
+        datanodes, clients = {}, {}
+        for i in (1, 2):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=str(tmp_path / f"dn{i}"), node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+        meta_srv = MetaSrv(MemKv())
+        meta = MetaClient(meta_srv)
+        for i in (1, 2):
+            meta_srv.register_datanode(Peer(i, f"local://{i}"))
+        fe = DistInstance(meta, clients)
+        fe.do_query("""
+CREATE TABLE dist (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE,
+                   PRIMARY KEY(host))
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h5'),
+  PARTITION r1 VALUES LESS THAN (MAXVALUE))""")
+        hosts = np.array([f"h{i}" for i in range(10)], dtype=object)
+        n = fe.handle_bulk_load("dist", {
+            "host": hosts,
+            "ts": np.arange(10, dtype=np.int64) * 1000,
+            "cpu": np.arange(10, dtype=np.float64)})
+        assert n == 10
+        counts = []
+        for dn in datanodes.values():
+            t = dn.catalog.table(CAT, SCH, "dist")
+            got = sum(b.num_rows for b in t.scan_batches())
+            counts.append(got)
+            for region in t.regions.values():
+                assert all(
+                    mt.num_rows == 0 for mt in
+                    region.version_control.current.memtables.all_memtables())
+        assert sorted(counts) == [5, 5]
+        out = fe.do_query("SELECT host, cpu FROM dist ORDER BY host")
+        rows = [tuple(r) for b in out[0].batches for r in b.rows()]
+        assert rows == [(f"h{i}", float(i)) for i in range(10)]
+        for dn in datanodes.values():
+            dn.shutdown()
