@@ -60,6 +60,11 @@ def main():
         gids_np = np.sort(rng.integers(0, G, n)).astype(np.int32)
         ends_np = np.cumsum(np.bincount(gids_np, minlength=G),
                             dtype=np.int64).astype(np.int32)
+        # static longest-segment bucket, as the scan pipeline stages it
+        # (enables the shift-doubling min/max + first/last kernels)
+        from greptimedb_tpu.ops.kernels import seg_len_bucket
+        seg_k = seg_len_bucket(
+            int(np.diff(ends_np, prepend=np.int32(0)).max()))
         gids = jax.device_put(gids_np)
         ends = jax.device_put(ends_np)
         line = [f"G={G:>8}:"]
@@ -67,7 +72,7 @@ def main():
             ops = OP_SETS[name]
             f = functools.partial(_sorted_grouped_aggregate_pre,
                                   num_groups=G, ops=ops,
-                                  has_col_masks=False)
+                                  has_col_masks=False, seg_len_k=seg_k)
             t = timeit(f, gids, mask, ts, tuple(vals for _ in ops), (),
                        ends)
             line.append(f"{name}[{len(ops)}c] {t*1e3:7.0f}ms"

@@ -37,9 +37,13 @@ class StandaloneOptions:
     #: (reference: TlsOption, servers/src/tls.rs)
     tls: dict = field(default_factory=dict)
     #: [query] table: stream_threshold_rows / stream_slice_rows (cold-scan
-    #: streaming), scan_cache_budget_mb (device scan cache bound)
+    #: streaming), cold_reduce ("host"/"device" partial reduction),
+    #: scan_cache_budget_mb (device scan cache bound)
     query: dict = field(default_factory=dict)
     log_dir: Optional[str] = None
+    #: [logging] otlp_endpoint: OTLP/HTTP collector base URL (spans are
+    #: exported to {endpoint}/v1/traces when set)
+    otlp_endpoint: Optional[str] = None
 
 
 def load_options(args) -> StandaloneOptions:
@@ -67,6 +71,8 @@ def load_options(args) -> StandaloneOptions:
         logging_doc = doc.get("logging", {})
         opts.log_level = logging_doc.get("level", opts.log_level)
         opts.log_dir = logging_doc.get("dir", opts.log_dir)
+        opts.otlp_endpoint = logging_doc.get("otlp_endpoint",
+                                             opts.otlp_endpoint)
         opts.tls = doc.get("tls", {})
         opts.query = doc.get("query", {})
     for name in ("data_home", "http_addr", "mysql_addr", "postgres_addr",
@@ -88,7 +94,8 @@ def build_servers(opts: StandaloneOptions):
         from ..query.stream_exec import configure_streaming
         configure_streaming(
             threshold_rows=opts.query.get("stream_threshold_rows"),
-            slice_rows=opts.query.get("stream_slice_rows"))
+            slice_rows=opts.query.get("stream_slice_rows"),
+            cold_reduce=opts.query.get("cold_reduce"))
         budget_mb = opts.query.get("scan_cache_budget_mb")
         if budget_mb is not None:
             from ..query.tpu_exec import SCAN_CACHE
@@ -139,8 +146,11 @@ def build_servers(opts: StandaloneOptions):
 def standalone_start(args) -> None:
     opts = load_options(args)
     from ..common.jax_cache import enable_compile_cache
-    from ..common.telemetry import init_logging, install_panic_hook
+    from ..common.telemetry import (configure_otlp, init_logging,
+                                    install_panic_hook)
     init_logging(opts.log_level, opts.log_dir)
+    if opts.otlp_endpoint:
+        configure_otlp(opts.otlp_endpoint, service_name="greptimedb")
     install_panic_hook()
     enable_compile_cache(opts.data_home)
     fe, servers = build_servers(opts)

@@ -90,7 +90,7 @@ def current_span() -> Optional[Dict]:
 @contextlib.contextmanager
 def span(name: str, **attrs) -> Iterator[Dict]:
     """Nested span: inherits trace_id from the parent, logs duration on
-    exit at DEBUG (the in-process analog of the Jaeger pipeline)."""
+    exit at DEBUG, and (when configured) ships to an OTLP collector."""
     stack = getattr(_tls, "spans", None)
     if stack is None:
         stack = _tls.spans = []
@@ -102,6 +102,7 @@ def span(name: str, **attrs) -> Iterator[Dict]:
         "parent_id": parent["span_id"] if parent else None,
         "attrs": attrs,
         "start": time.perf_counter(),
+        "start_unix_ns": time.time_ns(),
     }
     stack.append(s)
     try:
@@ -112,6 +113,112 @@ def span(name: str, **attrs) -> Iterator[Dict]:
         logger.debug("span %s finished in %.2fms attrs=%s", name,
                      elapsed_ms, attrs)
         _observe(f"span_{name}", elapsed_ms / 1e3)
+        exporter = _OTLP[0]
+        if exporter is not None:
+            exporter.enqueue(s, int(elapsed_ms * 1e6))
+
+
+# ---------------------------------------------------------------------------
+# OTLP trace export (reference: the OpenTelemetry pipeline wired in
+# src/common/telemetry/src/logging.rs:83-150 — tracing-opentelemetry
+# layer + otlp exporter behind config)
+# ---------------------------------------------------------------------------
+
+_OTLP: list = [None]
+
+
+class OtlpExporter:
+    """Background OTLP/HTTP-JSON span exporter: bounded queue, batched
+    POSTs to `{endpoint}/v1/traces`, dropped (and counted) rather than
+    ever blocking the traced path."""
+
+    def __init__(self, endpoint: str, service_name: str = "greptimedb",
+                 flush_interval: float = 2.0, max_queue: int = 4096):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.flush_interval = flush_interval
+        self.max_queue = max_queue
+        self.dropped = 0
+        self.exported = 0
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+
+    def enqueue(self, s: Dict, duration_ns: int) -> None:
+        start_ns = s.get("start_unix_ns") or time.time_ns()
+        rec = {
+            # OTLP requires 16-byte trace / 8-byte span ids (hex)
+            "traceId": s["trace_id"].ljust(32, "0"),
+            "spanId": s["span_id"].ljust(16, "0"),
+            "name": s["name"],
+            "kind": 1,                            # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(start_ns + duration_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in (s.get("attrs") or {}).items()],
+        }
+        if s.get("parent_id"):
+            rec["parentSpanId"] = s["parent_id"].ljust(16, "0")
+        with self._lock:
+            if len(self._buf) >= self.max_queue:
+                self.dropped += 1
+                return
+            self._buf.append(rec)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush()
+        self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        import json as _json
+        import urllib.request
+        doc = {"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": self.service_name}}]},
+            "scopeSpans": [{
+                "scope": {"name": "greptimedb_tpu"},
+                "spans": batch,
+            }],
+        }]}
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces",
+            data=_json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            self.exported += len(batch)
+        except Exception as e:  # noqa: BLE001 — export must never break
+            self.dropped += len(batch)
+            logger.debug("otlp export failed: %s", e)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def configure_otlp(endpoint: Optional[str],
+                   service_name: str = "greptimedb",
+                   flush_interval: float = 2.0) -> Optional[OtlpExporter]:
+    """Enable (or, with endpoint=None, disable) OTLP span export."""
+    old = _OTLP[0]
+    if old is not None:
+        old.shutdown()
+        _OTLP[0] = None
+    if endpoint:
+        _OTLP[0] = OtlpExporter(endpoint, service_name=service_name,
+                                flush_interval=flush_interval)
+    return _OTLP[0]
 
 
 # ---------------------------------------------------------------------------

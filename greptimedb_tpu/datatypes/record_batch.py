@@ -193,6 +193,6 @@ def _fmt(v: Any, col) -> str:
             "%Y-%m-%dT%H:%M:%S.%f")[:-3]
     if isinstance(v, float):
         return repr(v)
-    if isinstance(v, bool):
+    if isinstance(v, (bool, np.bool_)):
         return "true" if v else "false"
     return str(v)

@@ -95,6 +95,10 @@ class FlightMetaServer(flight.FlightServerBase):
                     and self.raft_node is not None:
                 resp = {"ok": True,
                         **self.raft_node.handle_append_entries(**body)}
+            elif kind == "raft_install_snapshot" \
+                    and self.raft_node is not None:
+                resp = {"ok": True,
+                        **self.raft_node.handle_install_snapshot(**body)}
             else:
                 raise GreptimeError(f"unknown meta action {kind!r}")
         except GreptimeError as e:

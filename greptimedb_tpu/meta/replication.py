@@ -63,24 +63,34 @@ class RaftNode:
     def __init__(self, node_id: int, peer_ids: List[int],
                  *, store_path: Optional[str] = None,
                  election_timeout: Tuple[float, float] = (1.5, 3.0),
-                 heartbeat_interval: float = 0.5):
+                 heartbeat_interval: float = 0.5,
+                 compact_threshold: int = 256):
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
         self.transports: Dict[int, Any] = {}   # peer id -> transport
         self.store_path = store_path
         self._el_lo, self._el_hi = election_timeout
         self._hb_every = heartbeat_interval
+        #: compact once the applied log tail exceeds this many entries —
+        #: bounds both memory and the bytes rewritten per append (etcd
+        #: compacts its revision history the same way,
+        #: src/meta-srv/src/service/store/etcd.rs)
+        self.compact_threshold = compact_threshold
 
         self._lock = threading.RLock()
         self._applied = threading.Condition(self._lock)
         # persistent
         self.term = 0
         self.voted_for: Optional[int] = None
+        #: log[k] holds GLOBAL index base + k + 1; entries at or below
+        #: `base` live only in the snapshot (state-at-base)
         self.log: List[dict] = []              # {term, op}
+        self.base = 0                          # last compacted global idx
+        self.snapshot_term = 0                 # term of the entry at base
         # volatile
         self.role = FOLLOWER
         self.leader_id: Optional[int] = None
-        self.commit_idx = 0                    # 1-based count committed
+        self.commit_idx = 0                    # global committed index
         self.applied_idx = 0
         self.state: Dict[str, bytes] = {}
         self.next_idx: Dict[int, int] = {}
@@ -109,25 +119,102 @@ class RaftNode:
             self.role = FOLLOWER
             self.leader_id = None
 
+    # ---- global-index helpers (caller holds the lock) ----
+    def _last_index(self) -> int:
+        return self.base + len(self.log)
+
+    def _term_at(self, gidx: int) -> int:
+        if gidx <= self.base:
+            return self.snapshot_term if gidx == self.base else 0
+        return self.log[gidx - self.base - 1]["term"]
+
     # ---- persistence ----
-    def _persist_locked(self) -> None:
-        if not self.store_path:
-            return
-        doc = {"term": self.term, "voted_for": self.voted_for,
-               "log": self.log}
-        d = os.path.dirname(self.store_path) or "."
+    def _write_json(self, path: str, doc: dict) -> None:
+        d = os.path.dirname(path) or "."
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-")
         with os.fdopen(fd, "w") as f:
             json.dump(doc, f)
-        os.replace(tmp, self.store_path)
+        os.replace(tmp, path)
+
+    def _persist_locked(self) -> None:
+        """Persist term/vote and the (compaction-bounded) log tail. The
+        snapshot file carries everything at or below `base`, so each
+        append rewrites at most compact_threshold entries — not the
+        whole history."""
+        if not self.store_path:
+            return
+        self._write_json(self.store_path, {
+            "term": self.term, "voted_for": self.voted_for,
+            "base": self.base, "snapshot_term": self.snapshot_term,
+            "enc": "latin-1", "log": self.log})
+
+    def _persist_snapshot_locked(self) -> None:
+        if not self.store_path:
+            return
+        self._write_json(self.store_path + ".snap", {
+            "base": self.base, "snapshot_term": self.snapshot_term,
+            "state": {k: v.decode("latin-1")
+                      for k, v in self.state.items()}})
 
     def _load(self) -> None:
+        snap_path = self.store_path + ".snap"
+        if os.path.exists(snap_path):
+            with open(snap_path) as f:
+                snap = json.load(f)
+            self.base = snap["base"]
+            self.snapshot_term = snap.get("snapshot_term", 0)
+            self.state = {k: v.encode("latin-1")
+                          for k, v in snap["state"].items()}
+            self.commit_idx = self.applied_idx = self.base
         with open(self.store_path) as f:
             doc = json.load(f)
         self.term = doc["term"]
         self.voted_for = doc.get("voted_for")
-        self.log = doc["log"]
+        log = doc["log"]
+        if doc.get("enc") != "latin-1":
+            # pre-compaction logs stored values utf-8-decoded; re-bridge
+            # them to the latin-1 byte-preserving representation so
+            # replay applies identical bytes
+            log = [self._upgrade_entry(e) for e in log]
+        log_base = doc.get("base", 0)
+        if log_base < self.base:
+            # snapshot advanced past the log file (crash between the two
+            # writes — snap is always written first): drop the overlap
+            drop = self.base - log_base
+            log = log[drop:] if drop < len(log) else []
+        elif log_base > self.base:
+            # the log references compacted entries and no snapshot covers
+            # them: refusing loudly beats silently serving an empty state
+            # (and install-snapshotting that emptiness onto followers)
+            raise GreptimeError(
+                f"raft store {self.store_path!r} has log base {log_base} "
+                f"but no snapshot at or beyond it ({self.base}); refusing "
+                f"to start from a truncated history")
+        self.log = log
+
+    @staticmethod
+    def _upgrade_entry(entry: dict) -> dict:
+        """Re-encode a legacy (utf-8-bridged) log entry's value strings
+        into the latin-1 byte-preserving representation."""
+        def bridge(s):
+            return s.encode("utf-8").decode("latin-1") \
+                if isinstance(s, str) else s
+
+        op = dict(entry.get("op") or {})
+        for k in ("value", "expect"):
+            if op.get(k) is not None:
+                op[k] = bridge(op[k])
+        if op.get("guard"):
+            g = dict(op["guard"])
+            if g.get("expect") is not None:
+                g["expect"] = bridge(g["expect"])
+            op["guard"] = g
+        if op.get("ops"):
+            op["ops"] = [(sub, k, bridge(v)) for sub, k, v in op["ops"]]
+        out = dict(entry)
+        out["op"] = op
+        return out
 
     # ---- timers ----
     def _election_deadline(self) -> float:
@@ -153,8 +240,8 @@ class RaftNode:
             self.leader_id = None
             self._last_heard = time.monotonic()
             term = self.term
-            last_idx = len(self.log)
-            last_term = self.log[-1]["term"] if self.log else 0
+            last_idx = self._last_index()
+            last_term = self._term_at(last_idx)
             self._persist_locked()
         votes = 1
         for pid in self.peer_ids:
@@ -180,7 +267,8 @@ class RaftNode:
             if votes >= quorum:
                 self.role = LEADER
                 self.leader_id = self.node_id
-                self.next_idx = {p: len(self.log) for p in self.peer_ids}
+                self.next_idx = {p: self._last_index()
+                                 for p in self.peer_ids}
                 # a no-op in the new term lets prior-term entries commit
                 # (raft §5.4.2: only current-term entries count quorum)
                 self.log.append({"term": self.term, "op": {"kind": "noop"}})
@@ -205,9 +293,10 @@ class RaftNode:
                 self._step_down(term)
             granted = False
             if term == self.term and self.voted_for in (None, candidate):
-                my_last_term = self.log[-1]["term"] if self.log else 0
+                my_last = self._last_index()
+                my_last_term = self._term_at(my_last)
                 up_to_date = (last_term, last_idx) >= (my_last_term,
-                                                       len(self.log))
+                                                       my_last)
                 if up_to_date:
                     granted = True
                     self.voted_for = candidate
@@ -225,12 +314,21 @@ class RaftNode:
                 self._step_down(term)
             self.leader_id = leader
             self._last_heard = time.monotonic()
+            if prev_idx < self.base:
+                # everything at or below base is committed + applied via
+                # the snapshot: skip the already-covered prefix
+                drop = self.base - prev_idx
+                if drop >= len(entries):
+                    return {"term": self.term, "ok": True}
+                entries = entries[drop:]
+                prev_idx = self.base
+                prev_term = self.snapshot_term
             # log matching: the entry before the new ones must agree
-            if prev_idx > len(self.log) or (
-                    prev_idx > 0 and
-                    self.log[prev_idx - 1]["term"] != prev_term):
+            if prev_idx > self._last_index() or (
+                    prev_idx > self.base and
+                    self._term_at(prev_idx) != prev_term):
                 return {"term": self.term, "ok": False,
-                        "have": min(len(self.log), prev_idx)}
+                        "have": min(self._last_index(), prev_idx)}
             if entries:
                 # truncate only from the first genuinely conflicting
                 # entry (term mismatch): a delayed, shorter AppendEntries
@@ -238,20 +336,54 @@ class RaftNode:
                 # appended (raft §5.3 — committed suffixes survive)
                 changed = False
                 for i, ent in enumerate(entries):
-                    idx = prev_idx + i
-                    if idx >= len(self.log):
+                    k = prev_idx + i - self.base      # 0-based log slot
+                    if k >= len(self.log):
                         self.log.extend(entries[i:])
                         changed = True
                         break
-                    if self.log[idx]["term"] != ent["term"]:
-                        self.log = self.log[:idx] + list(entries[i:])
+                    if self.log[k]["term"] != ent["term"]:
+                        self.log = self.log[:k] + list(entries[i:])
                         changed = True
                         break
                 if changed:
                     self._persist_locked()
             if commit_idx > self.commit_idx:
-                self.commit_idx = min(commit_idx, len(self.log))
+                self.commit_idx = min(commit_idx, self._last_index())
                 self._apply_locked()
+            return {"term": self.term, "ok": True}
+
+    def handle_install_snapshot(self, term: int, leader: int, base: int,
+                                snapshot_term: int,
+                                state: Dict[str, str]) -> dict:
+        """Replace this follower's prefix with the leader's applied
+        snapshot — sent when the leader has compacted away the entries
+        the follower still needs (raft §7 InstallSnapshot)."""
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term, "ok": False}
+            if term > self.term or self.role != FOLLOWER:
+                self._step_down(term)
+            self.leader_id = leader
+            self._last_heard = time.monotonic()
+            if base <= self.applied_idx:
+                return {"term": self.term, "ok": True}
+            # keep a log tail that extends beyond the snapshot only when
+            # it provably continues it (the entry AT base must carry the
+            # snapshot's term); otherwise it is an uncommitted branch
+            keep = base - self.base
+            if keep < len(self.log) and \
+                    self.log[keep - 1]["term"] == snapshot_term:
+                self.log = self.log[keep:]
+            else:
+                self.log = []
+            self.state = {k: v.encode("latin-1") for k, v in state.items()}
+            self.base = base
+            self.snapshot_term = snapshot_term
+            self.applied_idx = base
+            self.commit_idx = max(self.commit_idx, base)
+            self._persist_snapshot_locked()
+            self._persist_locked()
+            self._applied.notify_all()
             return {"term": self.term, "ok": True}
 
     # ---- replication ----
@@ -265,7 +397,7 @@ class RaftNode:
             if self.role != LEADER:
                 return False
             term = self.term
-            total = len(self.log)
+            total = self._last_index()
         acked = 1
         for pid in self.peer_ids:
             tr = self.transports.get(pid)
@@ -276,21 +408,42 @@ class RaftNode:
                     if self.role != LEADER or self.term != term:
                         return False
                     nxt = self.next_idx.get(pid, total)
-                    prev_idx = nxt
-                    prev_term = self.log[nxt - 1]["term"] if nxt else 0
-                    entries = self.log[nxt:total]
-                    commit = self.commit_idx
+                    snap = None
+                    if nxt < self.base:
+                        # the tail this follower needs is compacted away:
+                        # ship the applied snapshot instead, then resume
+                        # normal appends from its index
+                        snap = (self.applied_idx,
+                                self._term_at(self.applied_idx),
+                                {k: v.decode("latin-1")
+                                 for k, v in self.state.items()})
+                    else:
+                        prev_idx = nxt
+                        prev_term = self._term_at(nxt)
+                        entries = self.log[nxt - self.base:
+                                           total - self.base]
+                        commit = self.commit_idx
                 try:
-                    resp = tr.append_entries(
-                        term=term, leader=self.node_id, prev_idx=prev_idx,
-                        prev_term=prev_term, entries=entries,
-                        commit_idx=commit)
+                    if snap is not None:
+                        resp = tr.install_snapshot(
+                            term=term, leader=self.node_id, base=snap[0],
+                            snapshot_term=snap[1], state=snap[2])
+                    else:
+                        resp = tr.append_entries(
+                            term=term, leader=self.node_id,
+                            prev_idx=prev_idx, prev_term=prev_term,
+                            entries=entries, commit_idx=commit)
                 except Exception:
                     break
                 with self._lock:
                     if resp["term"] > self.term:
                         self._step_down(resp["term"])
                         return False
+                    if snap is not None:
+                        if resp.get("ok"):
+                            self.next_idx[pid] = snap[0]
+                            continue   # follow with the remaining tail
+                        break
                     if resp.get("ok"):
                         self.next_idx[pid] = total
                         acked += 1
@@ -304,8 +457,9 @@ class RaftNode:
             # only an index whose entry is from the current term may
             # advance the commit point (raft §5.4.2); the election no-op
             # guarantees such an entry exists promptly
-            if acked >= quorum and total > self.commit_idx and total > 0 \
-                    and self.log[total - 1]["term"] == self.term:
+            if acked >= quorum and total > self.commit_idx \
+                    and total > self.base \
+                    and self._term_at(total) == self.term:
                 self.commit_idx = total
                 self._apply_locked()
             return acked >= quorum
@@ -313,28 +467,43 @@ class RaftNode:
     # ---- state machine ----
     def _apply_locked(self) -> None:
         while self.applied_idx < self.commit_idx:
-            entry = self.log[self.applied_idx]
+            entry = self.log[self.applied_idx - self.base]
             entry["result"] = self._apply_op(entry["op"])
             self.applied_idx += 1
         self._applied.notify_all()
+        if len(self.log) > self.compact_threshold \
+                and self.applied_idx > self.base:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Fold the applied log prefix into the snapshot: state is
+        already AT applied_idx, so compaction is a copy-free truncation
+        plus one snapshot write. Bounds memory and per-append persist
+        cost; lagging followers past the horizon get InstallSnapshot."""
+        cut = self.applied_idx - self.base
+        self.snapshot_term = self.log[cut - 1]["term"]
+        self.log = self.log[cut:]
+        self.base = self.applied_idx
+        self._persist_snapshot_locked()
+        self._persist_locked()
 
     def _apply_op(self, op: dict):
         kind = op["kind"]
         key = op.get("key")
         if kind == "put":
-            self.state[key] = op["value"].encode()
+            self.state[key] = op["value"].encode("latin-1")
             return True
         if kind == "delete":
             return self.state.pop(key, None) is not None
         if kind == "cap":                      # compare_and_put
-            expect = op["expect"].encode() if op["expect"] is not None \
-                else None
+            expect = op["expect"].encode("latin-1") \
+                if op["expect"] is not None else None
             if self.state.get(key) != expect:
                 return False
-            self.state[key] = op["value"].encode()
+            self.state[key] = op["value"].encode("latin-1")
             return True
         if kind == "cad":                      # compare_and_delete
-            if self.state.get(key) != op["expect"].encode():
+            if self.state.get(key) != op["expect"].encode("latin-1"):
                 return False
             del self.state[key]
             return True
@@ -346,13 +515,13 @@ class RaftNode:
         if kind == "batch":
             guard = op.get("guard")
             if guard is not None:
-                expect = guard["expect"].encode() \
+                expect = guard["expect"].encode("latin-1") \
                     if guard["expect"] is not None else None
                 if self.state.get(guard["key"]) != expect:
                     return False
             for sub, k, v in op["ops"]:
                 if sub == "put":
-                    self.state[k] = v.encode()
+                    self.state[k] = v.encode("latin-1")
                 elif sub == "delete":
                     self.state.pop(k, None)
                 else:
@@ -376,20 +545,24 @@ class RaftNode:
                 raise NotLeaderError(self.leader_id)
             entry = {"term": self.term, "op": op}
             self.log.append(entry)
-            idx = len(self.log)
+            idx = self._last_index()
             self._persist_locked()
         self._replicate()   # best effort; heartbeats keep pushing
         with self._lock:
             deadline = time.monotonic() + timeout
             while True:
-                lost = idx > len(self.log) or self.log[idx - 1] is not entry
+                if self.applied_idx >= idx:
+                    # the entry object survives compaction, so its result
+                    # is readable even after the log slot is truncated
+                    return entry.get("result")
+                lost = idx > self._last_index() or (
+                    idx > self.base and
+                    self.log[idx - self.base - 1] is not entry)
                 if lost:
                     # a new leader overwrote the uncommitted entry
                     raise NotLeaderError(self.leader_id
                                          if self.leader_id != self.node_id
                                          else None)
-                if self.applied_idx >= idx:
-                    return entry.get("result")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._applied.wait(
                         timeout=min(remaining, self._hb_every)):
@@ -435,6 +608,9 @@ class LocalTransport:
     def append_entries(self, **kw) -> dict:
         return self.node.handle_append_entries(**kw)
 
+    def install_snapshot(self, **kw) -> dict:
+        return self.node.handle_install_snapshot(**kw)
+
 
 def connect_local(nodes: List[RaftNode]) -> None:
     for a in nodes:
@@ -469,6 +645,9 @@ class FlightTransport:
 
     def append_entries(self, **kw) -> dict:
         return self._action("raft_append_entries", kw)
+
+    def install_snapshot(self, **kw) -> dict:
+        return self._action("raft_install_snapshot", kw)
 
 
 class HaMetaClient:
@@ -519,8 +698,10 @@ class ReplicatedKv:
 
     # writes (consensus round-trips)
     def put(self, key: str, value: bytes) -> None:
+        # latin-1 maps bytes<->str 1:1, so arbitrary (non-UTF-8) values
+        # survive the JSON-encoded raft log — matching MemKv/FileKv
         self.node.propose({"kind": "put", "key": key,
-                           "value": value.decode()})
+                           "value": value.decode("latin-1")})
 
     def delete(self, key: str) -> bool:
         return bool(self.node.propose({"kind": "delete", "key": key}))
@@ -529,12 +710,14 @@ class ReplicatedKv:
                         value: bytes) -> bool:
         return bool(self.node.propose({
             "kind": "cap", "key": key,
-            "expect": expect.decode() if expect is not None else None,
-            "value": value.decode()}))
+            "expect": expect.decode("latin-1") if expect is not None
+            else None,
+            "value": value.decode("latin-1")}))
 
     def compare_and_delete(self, key: str, expect: bytes) -> bool:
         return bool(self.node.propose({
-            "kind": "cad", "key": key, "expect": expect.decode()}))
+            "kind": "cad", "key": key,
+            "expect": expect.decode("latin-1")}))
 
     def incr(self, key: str, start: int = 0) -> int:
         return int(self.node.propose({"kind": "incr", "key": key,
@@ -549,9 +732,9 @@ class ReplicatedKv:
         g = None
         if guard is not None:
             g = {"key": guard[0],
-                 "expect": guard[1].decode() if guard[1] is not None
-                 else None}
+                 "expect": guard[1].decode("latin-1")
+                 if guard[1] is not None else None}
         return bool(self.node.propose({
             "kind": "batch", "guard": g,
-            "ops": [(op, k, v.decode() if v is not None else None)
-                    for op, k, v in ops]}))
+            "ops": [(op, k, v.decode("latin-1") if v is not None
+                     else None) for op, k, v in ops]}))

@@ -571,6 +571,71 @@ def _sorted_seg_minmax(x, starts, ends, bs, be, has_inner, n, *, is_min):
     return jnp.where(ends > starts, out, ident)
 
 
+def seg_len_bucket(max_len: int) -> int:
+    """Static pass-count bucket for the shift-doubling kernels: the
+    smallest even k with 2^k >= max_len. Even buckets bound recompiles;
+    the kernels' correctness REQUIRES 2^k >= the longest segment, so
+    every caller (scan launch, benches, tests) must derive k through
+    this one helper."""
+    return -(-max(max_len - 1, 1).bit_length() // 2) * 2
+
+
+def _seg_minmax_doubling(x, gids, starts, ends, ident, *, is_min, k_max):
+    """Segmented min/max by shift-doubling: k_max passes of pure
+    elementwise work (shift + gid compare + select), no gathers beyond
+    the final per-segment pickup. After pass k, y[i] covers
+    [i, min(i + 2^k, segment end)); requires 2^k_max >= the longest
+    segment (the host caller bucketizes that bound into `k_max`).
+
+    At high cardinality this replaces the in-block sparse table
+    (`_sorted_seg_minmax`'s [K2, NB, B] build is n·log B memory traffic;
+    the VERDICT r3/r5 kernel gap) with ~k_max linear passes that map to
+    the VPU with no random access — the winning shape on TPU, where
+    gathers, not FLOPs, priced the old kernel."""
+    n = x.shape[0]
+    red = jnp.minimum if is_min else jnp.maximum
+    y = x
+    for k in range(k_max):
+        sh = 1 << k
+        if sh >= n:
+            break
+        ys = jnp.concatenate([y[sh:], jnp.full((sh,), ident, y.dtype)])
+        gs = jnp.concatenate(
+            [gids[sh:], jnp.full((sh,), -1, gids.dtype)])
+        y = jnp.where(gs == gids, red(y, ys), y)
+    out = y[jnp.minimum(starts, n - 1)]
+    return jnp.where(ends > starts, out, ident)
+
+
+def _seg_argext_doubling(key, gids, starts, ends, ident, *, is_min, k_max):
+    """Segmented lexicographic arg-extreme of (key, position) by
+    shift-doubling — one fused pass family carrying the (value, pos)
+    pair, replacing the old two-pass minmax + O(n) gather formulation
+    (first/last at high cardinality). Returns (ext_key, pos); pos = -1
+    for empty segments."""
+    n = key.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    for k in range(k_max):
+        sh = 1 << k
+        if sh >= n:
+            break
+        ks = jnp.concatenate([key[sh:], jnp.full((sh,), ident, key.dtype)])
+        ps = jnp.concatenate([pos[sh:], jnp.full((sh,), -1, jnp.int32)])
+        gs = jnp.concatenate(
+            [gids[sh:], jnp.full((sh,), -1, gids.dtype)])
+        if is_min:
+            better = (ks < key) | ((ks == key) & (ps < pos))
+        else:
+            better = (ks > key) | ((ks == key) & (ps > pos))
+        take = (gs == gids) & better
+        key = jnp.where(take, ks, key)
+        pos = jnp.where(take, ps, pos)
+    sel = jnp.minimum(starts, n - 1)
+    live = ends > starts
+    return (jnp.where(live, key[sel], ident),
+            jnp.where(live, pos[sel], -1))
+
+
 def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min,
                        gids=None):
     """Per-segment lexicographic arg-extreme of (x, position).
@@ -664,7 +729,7 @@ def _sorted_seg_argext(x, starts, ends, bs, be, has_inner, n, *, is_min,
 
 def sorted_grouped_aggregate(gids, mask, ts, values, col_masks=(), *,
                              num_groups, ops, has_col_masks=False,
-                             ends=None):
+                             ends=None, seg_len_k=None):
     """Host-validating wrapper (mirrors grouped_aggregate; gids sorted).
 
     At high cardinality the device-side binary search for segment bounds is
@@ -683,23 +748,31 @@ def sorted_grouped_aggregate(gids, mask, ts, values, col_masks=(), *,
         return _sorted_grouped_aggregate_pre(
             gids, mask, ts, tuple(values), tuple(col_masks), ends,
             num_groups=num_groups, ops=tuple(ops),
-            has_col_masks=has_col_masks)
+            has_col_masks=has_col_masks, seg_len_k=seg_len_k)
     return _sorted_grouped_aggregate(
         gids, mask, ts, tuple(values), tuple(col_masks),
         num_groups=num_groups, ops=tuple(ops), has_col_masks=has_col_masks)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_groups", "ops", "has_col_masks"))
+                   static_argnames=("num_groups", "ops", "has_col_masks",
+                                    "seg_len_k"))
 def _sorted_grouped_aggregate_pre(gids, mask, ts, values, col_masks, ends, *,
-                                  num_groups, ops, has_col_masks=False):
-    """_sorted_grouped_aggregate with host-precomputed segment ends."""
+                                  num_groups, ops, has_col_masks=False,
+                                  seg_len_k=None):
+    """_sorted_grouped_aggregate with host-precomputed segment ends.
+
+    seg_len_k (static): ceil-log2 of the longest segment, bucketized by
+    the caller — enables the shift-doubling min/max + first/last kernels
+    at high cardinality. Callers must only pass it when `gids` holds
+    REAL run ids (the scan path ships a dummy when no op needs them).
+    """
     ends = jnp.asarray(ends)
     starts = jnp.concatenate([jnp.zeros(1, jnp.int32), ends[:-1]])
     bs, be, has_inner = _block_cover(starts, ends)
     return _sga_body(gids, mask, ts, values, col_masks, starts, ends, bs,
                      be, has_inner, num_groups=num_groups, ops=ops,
-                     has_col_masks=has_col_masks)
+                     has_col_masks=has_col_masks, seg_len_k=seg_len_k)
 
 
 @functools.partial(jax.jit,
@@ -721,7 +794,10 @@ def _sorted_grouped_aggregate(gids, mask, ts, values, col_masks=(), *,
 
 
 def _sga_body(gids, mask, ts, values, col_masks, starts, ends, bs, be,
-              has_inner, *, num_groups, ops, has_col_masks):
+              has_inner, *, num_groups, ops, has_col_masks,
+              seg_len_k=None):
+    use_doubling = seg_len_k is not None and \
+        num_groups > _SEG_HIGH_CARD_THRESHOLD
     n = gids.shape[0]
 
     def agg_mask(i):
@@ -785,19 +861,30 @@ def _sga_body(gids, mask, ts, values, col_masks, starts, ends, bs, be,
             results.append(jnp.sqrt(var) if op == "stddev" else var)
         elif op in ("min", "max"):
             is_min = op == "min"
-            filled = jnp.where(m, col,
-                               _max_ident(fdt) if is_min else _min_ident(fdt))
-            results.append(_sorted_seg_minmax(filled, starts, ends, bs, be,
-                                              has_inner, n, is_min=is_min))
+            ident = _max_ident(fdt) if is_min else _min_ident(fdt)
+            filled = jnp.where(m, col, ident)
+            if use_doubling:
+                results.append(_seg_minmax_doubling(
+                    filled, gids, starts, ends, ident, is_min=is_min,
+                    k_max=seg_len_k))
+            else:
+                results.append(_sorted_seg_minmax(
+                    filled, starts, ends, bs, be, has_inner, n,
+                    is_min=is_min))
         elif op in ("first", "last"):
             # arg-extreme by (ts, position) — same semantics as the scatter
             # twin even when ts is unsorted within a segment
             is_min = op == "first"
             ident = _max_ident(ts.dtype) if is_min else _min_ident(ts.dtype)
             key = jnp.where(m, ts, ident)
-            ext_t, pos = _sorted_seg_argext(key, starts, ends, bs, be,
-                                            has_inner, n, is_min=is_min,
-                                            gids=gids)
+            if use_doubling:
+                ext_t, pos = _seg_argext_doubling(
+                    key, gids, starts, ends, ident, is_min=is_min,
+                    k_max=seg_len_k)
+            else:
+                ext_t, pos = _sorted_seg_argext(key, starts, ends, bs, be,
+                                                has_inner, n,
+                                                is_min=is_min, gids=gids)
             found = (ext_t != ident) & (pos >= 0)
             val = col[jnp.clip(pos, 0, n - 1)]
             empty = jnp.nan if jnp.issubdtype(fdt, jnp.floating) \

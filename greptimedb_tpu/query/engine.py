@@ -16,6 +16,7 @@ import pandas as pd
 from ..catalog import CatalogManager
 from ..common.time import TimeUnit
 from ..datatypes import data_type as dt
+from ..datatypes.data_type import parse_type_name
 from ..datatypes.record_batch import RecordBatch
 from ..datatypes.schema import ColumnSchema, Schema, SemanticType
 from ..errors import (
@@ -200,7 +201,17 @@ class QueryEngine:
                     frame[name] = v
                 keys.append(name)
                 ascs.append(asc)
-            frame = frame.sort_values(keys, ascending=ascs, kind="stable")
+            nulls_spec = getattr(sq, "order_nulls", [])
+            sort_cols, sort_asc = [], []
+            for i, (name, asc) in enumerate(zip(keys, ascs)):
+                nf = nulls_spec[i] if i < len(nulls_spec) else None
+                if nf is None:
+                    nf = not asc     # Postgres default (see Query sort)
+                frame[f"__unull{i}"] = frame[name].isna()
+                sort_cols += [f"__unull{i}", name]
+                sort_asc += [not nf, asc]
+            frame = frame.sort_values(sort_cols, ascending=sort_asc,
+                                      kind="stable")
             df = df.loc[frame.index]
         if sq.offset:
             df = df.iloc[sq.offset:]
@@ -383,7 +394,16 @@ class QueryEngine:
             self._reject_correlated(q, "EXISTS")
             if isinstance(q, Query) and q.limit is None:
                 q.limit = 1                # existence needs one row, but
-            out = self.execute_query(q, ctx)  # honor an explicit LIMIT 0
+            try:                           # honor an explicit LIMIT 0
+                out = self.execute_query(q, ctx)
+            except ColumnNotFoundError as err:
+                # an unqualified outer-column reference slipped past the
+                # qualified-name check — but this also catches plain
+                # typos, so keep the original diagnostic visible
+                raise UnsupportedError(
+                    "correlated EXISTS subqueries are not supported "
+                    f"(if the column is not an outer reference: {err})"
+                ) from err
             return Literal(out.num_rows > 0)
         for name, v in vars(e).items():
             if isinstance(v, Expr):
@@ -484,7 +504,9 @@ class QueryEngine:
             out = self.execute_query(q, ctx)
         except ColumnNotFoundError as err:
             raise UnsupportedError(
-                f"correlated {what} subqueries are not supported") from err
+                f"correlated {what} subqueries are not supported "
+                f"(if the column is not an outer reference: {err})"
+            ) from err
         cols = out.batches[0].columns if out.batches else []
         if out.batches and len(cols) != 1:
             raise PlanError(
@@ -727,7 +749,23 @@ class QueryEngine:
                 keys.append(target)
                 ascs.append(asc)
             if keys and len(sort_frame):
-                sort_frame = sort_frame.sort_values(keys, ascending=ascs,
+                # per-key NULL placement (pandas has one global
+                # na_position): an isna flag key ahead of each value key.
+                # Default is the Postgres rule — NULLS LAST for ASC,
+                # NULLS FIRST for DESC — overridden by NULLS FIRST/LAST.
+                nulls_spec = getattr(query, "order_nulls", [])
+                sort_cols: List[str] = []
+                sort_asc: List[bool] = []
+                for i, (target, asc) in enumerate(zip(keys, ascs)):
+                    nf = nulls_spec[i] if i < len(nulls_spec) else None
+                    if nf is None:
+                        nf = not asc
+                    flag = f"__nullord{i}"
+                    sort_frame[flag] = sort_frame[target].isna()
+                    sort_cols += [flag, target]
+                    sort_asc += [not nf, asc]
+                sort_frame = sort_frame.sort_values(sort_cols,
+                                                    ascending=sort_asc,
                                                     kind="stable")
                 proj = proj.loc[sort_frame.index]
 
@@ -900,4 +938,16 @@ def _result_dtype_override(expr, a: Analysis, table: Optional[Table]):
                 if src.is_timestamp and \
                         src.time_unit == TimeUnit.MILLISECOND:
                     return src
+    from ..sql.ast import Cast
+    if isinstance(expr, Cast):
+        # the projection carries the CAST target type, not whatever
+        # dtype the value plane decayed to (NULL-bearing ints run as
+        # float there)
+        tn = expr.type_name.strip().lower()
+        if tn in ("date", "timestamp", "datetime"):
+            return dt.TIMESTAMP_MILLISECOND
+        try:
+            return parse_type_name(expr.type_name)
+        except Exception:  # noqa: BLE001 — unknown alias: keep inference
+            return None
     return None

@@ -214,18 +214,43 @@ class Evaluator:
             return lv / rv
 
     def _cast(self, v, type_name: str):
+        """SQL CAST semantics: NULL in → NULL out for every target type
+        (pandas astype would either raise on NaN→int or coerce NaN→True
+        for bool), and invalid literals surface as taxonomy errors."""
+        from ..errors import InvalidArgumentsError
         tn = type_name.strip().lower()
-        if tn in ("date", "timestamp", "datetime"):
+        try:
+            if tn in ("date", "timestamp", "datetime"):
+                if isinstance(v, pd.Series):
+                    dtv = pd.to_datetime(v, utc=True)
+                    return dtv.map(
+                        lambda x: None if pd.isna(x)
+                        else int(x.value // 1_000_000))
+                return int(pd.Timestamp(v, tz="UTC").value // 1_000_000)
+            dtype = parse_type_name(type_name)
             if isinstance(v, pd.Series):
-                return (pd.to_datetime(v, utc=True).astype(np.int64)
-                        // 1_000_000)
-            return int(pd.Timestamp(v, tz="UTC").value // 1_000_000)
-        dtype = parse_type_name(type_name)
-        if isinstance(v, pd.Series):
-            if dtype.is_string:
-                return v.astype("string")
-            return v.astype(dtype.np_dtype)
-        return dtype.cast_value(v)
+                if dtype.is_string:
+                    return v.astype("string")
+                kind = np.dtype(dtype.np_dtype).kind \
+                    if dtype.np_dtype is not None else "O"
+                if kind in "iu" and v.dtype.kind in "fO":
+                    # float→int CAST rounds (Postgres semantics), and the
+                    # same way whether or not the column holds NULLs
+                    num = pd.to_numeric(v)
+                    if num.isna().any():
+                        return num.map(
+                            lambda x: None if pd.isna(x)
+                            else int(round(float(x))))
+                    return np.rint(num.to_numpy(np.float64)) \
+                        .astype(dtype.np_dtype)
+                if kind == "b" and v.isna().any():
+                    return v.map(lambda x: None if pd.isna(x)
+                                 else bool(x))
+                return v.astype(dtype.np_dtype)
+            return dtype.cast_value(v) if v is not None else None
+        except (ValueError, TypeError, OverflowError) as err:
+            raise InvalidArgumentsError(
+                f"cannot cast value to {type_name}: {err}") from None
 
     def _case(self, e: Case):
         idx = self.df.index

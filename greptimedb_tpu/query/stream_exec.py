@@ -88,6 +88,25 @@ def region_estimated_rows(region) -> int:
     return total
 
 
+def region_estimated_bytes(region) -> int:
+    """Estimated DECODED residency of a fully-cached scan: rows × the
+    schema's in-memory row width (ts + sid + every field column and its
+    validity). Parquet file sizes understate this badly — compression
+    plus column pruning hide the real host+HBM footprint — and the
+    streaming threshold exists to protect residency, so it must be
+    measured in the same units as the scan-cache budget."""
+    vc = getattr(region, "version_control", None)
+    if vc is None:
+        return 0
+    schema = vc.current.schema
+    width = 12                        # int64 ts + int32 sid
+    for c in schema.field_columns():
+        np_dtype = c.dtype.np_dtype
+        width += (np.dtype(np_dtype).itemsize
+                  if np_dtype is not None else 16) + 1
+    return region_estimated_rows(region) * width
+
+
 def _plan_slices(stats: List[Tuple[int, int, int]], budget: int,
                  clip_lo: Optional[int], clip_hi: Optional[int]
                  ) -> List[Tuple[int, int]]:

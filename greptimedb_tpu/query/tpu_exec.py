@@ -795,8 +795,15 @@ def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
     from . import stream_exec
     frames = []
     for region in table.regions.values():
+        # stream on either bound: row count, or estimated decoded bytes
+        # vs the scan-cache budget — a wide-schema region can bust
+        # residency long before the row threshold (the budget never
+        # evicts the newest entry, so admission is the only guard)
         if stream_exec.region_estimated_rows(region) > \
-                stream_exec.stream_threshold_rows():
+                stream_exec.stream_threshold_rows() or \
+                (SCAN_CACHE.budget_bytes > 0 and
+                 stream_exec.region_estimated_bytes(region) >
+                 SCAN_CACHE.budget_bytes // 2):
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))
             continue
@@ -886,6 +893,13 @@ def _launch_scan_kernel(scan: MergedScan, schema,
         run_starts = np.nonzero(flags)[0]
         nruns = len(run_starts)
         scan.device[run_key] = (rid, nruns, run_starts, buckets)
+        # bound the per-scan run-context cache: each distinct bucket
+        # spec stores O(n) host arrays, and dashboards sweeping many
+        # strides over one hot region would otherwise grow host memory
+        # past the scan-cache budget unchecked
+        stale = [k for k in scan.device if k.startswith("__runs:")][:-4]
+        for k in stale:
+            scan.device.pop(k, None)
 
     # ---- host: per-series tag predicate → row mask ----
     base_mask = None
@@ -979,10 +993,15 @@ def _launch_scan_kernel(scan: MergedScan, schema,
     # cost at high run cardinality
     run_ends = np.full(nbucket, n, dtype=np.int32)
     run_ends[:nruns - 1] = run_starts[1:]
-    # with host ends the kernel reads gids only for first/last (arg-extreme
-    # tie-break); for every other op ts stands in for shape and both the
-    # O(n) rid cumsum and its upload are skipped
-    needs_gids = any(op in ("first", "last") for op in ops)
+    # with host ends the kernel reads gids for first/last (arg-extreme
+    # tie-break) and for high-cardinality min/max (the shift-doubling
+    # kernel's same-segment guard); for every other op ts stands in for
+    # shape and both the O(n) rid cumsum and its upload are skipped
+    from ..ops.kernels import _SEG_HIGH_CARD_THRESHOLD, seg_len_bucket
+    high_card = nbucket > _SEG_HIGH_CARD_THRESHOLD
+    needs_gids = any(op in ("first", "last") for op in ops) or \
+        (high_card and any(op in ("min", "max") for op in ops))
+    seg_len_k = None
     if needs_gids:
         if rid is None:
             starts_mark = np.zeros(n, dtype=np.int32)
@@ -990,12 +1009,16 @@ def _launch_scan_kernel(scan: MergedScan, schema,
             rid = np.cumsum(starts_mark, dtype=np.int32)
             scan.device[run_key] = (rid, nruns, run_starts, buckets)
         d_rid = jax.device_put(rid)
+        # static ceil-log2 of the longest run, bucketized to even values
+        # so nearby layouts share one compile
+        lens = np.diff(run_starts, append=np.int64(n))
+        seg_len_k = seg_len_bucket(int(lens.max()) if len(lens) else 1)
     else:
         d_rid = d_ts
     results, counts = sorted_grouped_aggregate(
         d_rid, d_mask, d_ts, tuple(values), tuple(col_masks),
         num_groups=nbucket, ops=tuple(ops), has_col_masks=True,
-        ends=run_ends)
+        ends=run_ends, seg_len_k=seg_len_k)
     return _Launched(tuple(results), counts, nruns, sids[run_starts],
                      buckets[run_starts] if buckets is not None else None,
                      scan.series_dict, scan.ts_base)

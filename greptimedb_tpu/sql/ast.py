@@ -236,6 +236,9 @@ class Query(Statement):
     group_by: List[Expr] = field(default_factory=list)
     having: Optional[Expr] = None
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)  # (expr, asc)
+    #: per-order-key NULLS FIRST(True)/LAST(False); None = SQL default
+    #: (NULLS LAST for ASC, NULLS FIRST for DESC — the Postgres rule)
+    order_nulls: List[Optional[bool]] = field(default_factory=list)
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
@@ -248,6 +251,7 @@ class SetQuery(Statement):
     right: "Query" = None
     all: bool = False
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)
+    order_nulls: List[Optional[bool]] = field(default_factory=list)
     limit: Optional[int] = None
     offset: Optional[int] = None
 

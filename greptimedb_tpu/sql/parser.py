@@ -361,9 +361,17 @@ class Parser:
                     asc = False
                 else:
                     self.match_kw("ASC")
-                self.match_kw("NULLS") and (self.match_kw("FIRST") or
-                                            self.match_kw("LAST"))
+                nulls_first: Optional[bool] = None
+                if self.match_kw("NULLS"):
+                    if self.match_kw("FIRST"):
+                        nulls_first = True
+                    elif self.match_kw("LAST"):
+                        nulls_first = False
+                    else:
+                        raise ParserError(
+                            "expected FIRST or LAST after NULLS")
                 q.order_by.append((e, asc))
+                q.order_nulls.append(nulls_first)
                 if not self.match_op(","):
                     break
         if self.match_kw("LIMIT"):

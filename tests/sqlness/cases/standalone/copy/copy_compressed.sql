@@ -1,0 +1,25 @@
+CREATE TABLE csrc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO csrc VALUES ('a', 1000, 1.5), ('b', 2000, NULL), ('c', 3000, 3.5);
+
+COPY csrc TO '/tmp/sqlness_copy_comp.csv.gz' WITH (format='csv');
+
+CREATE TABLE cdst (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+COPY cdst FROM '/tmp/sqlness_copy_comp.csv.gz' WITH (format='csv');
+
+SELECT host, v FROM cdst ORDER BY host;
+
+COPY csrc TO '/tmp/sqlness_copy_comp.json.zst' WITH (format='json', compression='zstd');
+
+CREATE TABLE jdst (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+COPY jdst FROM '/tmp/sqlness_copy_comp.json.zst' WITH (format='json');
+
+SELECT host, v FROM jdst ORDER BY host;
+
+DROP TABLE csrc;
+
+DROP TABLE cdst;
+
+DROP TABLE jdst;

@@ -1,0 +1,13 @@
+CREATE TABLE dv (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE DEFAULT 9.5, b BIGINT DEFAULT 7, c STRING, PRIMARY KEY(host));
+
+INSERT INTO dv (host, ts) VALUES ('x', 1000);
+
+INSERT INTO dv (host, ts, a, c) VALUES ('y', 2000, 1.25, 'set');
+
+INSERT INTO dv VALUES ('z', 3000, NULL, NULL, NULL);
+
+SELECT host, a, b, c FROM dv ORDER BY host;
+
+SELECT host, count(a), count(b), count(c) FROM dv GROUP BY host ORDER BY host;
+
+DROP TABLE dv;

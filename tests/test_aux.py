@@ -71,6 +71,67 @@ class TestTelemetry:
         from greptimedb_tpu.common.telemetry import _histograms
         assert "unit_test_timer" in _histograms
 
+    def test_otlp_export_to_fake_collector(self):
+        """Spans flow to an OTLP/HTTP collector: right path, right JSON
+        shape, parenting preserved; export failures never raise."""
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from greptimedb_tpu.common.telemetry import configure_otlp
+
+        received = []
+
+        class Collector(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers["Content-Length"]))
+                received.append((self.path, json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Collector)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        exporter = configure_otlp(
+            f"http://127.0.0.1:{srv.server_port}",
+            service_name="gdb-test", flush_interval=60)
+        try:
+            with span("outer_op", table="m"):
+                with span("inner_op"):
+                    pass
+            exporter.flush()
+            assert received, "collector saw no export"
+            path, doc = received[0]
+            assert path == "/v1/traces"
+            rs = doc["resourceSpans"][0]
+            svc = {a["key"]: a["value"]["stringValue"]
+                   for a in rs["resource"]["attributes"]}
+            assert svc["service.name"] == "gdb-test"
+            spans = rs["scopeSpans"][0]["spans"]
+            byname = {sp["name"]: sp for sp in spans}
+            assert set(byname) == {"outer_op", "inner_op"}
+            assert byname["inner_op"]["parentSpanId"] == \
+                byname["outer_op"]["spanId"]
+            assert byname["inner_op"]["traceId"] == \
+                byname["outer_op"]["traceId"]
+            assert len(byname["outer_op"]["traceId"]) == 32
+            outer_attrs = {a["key"] for a in
+                           byname["outer_op"]["attributes"]}
+            assert "table" in outer_attrs
+            assert exporter.exported == 2
+            # a dead collector must not raise into the traced path
+            srv.shutdown()
+            with span("after_death"):
+                pass
+            exporter.flush()
+        finally:
+            configure_otlp(None)
+            srv.shutdown()
+
 
 class TestPlugins:
     def test_insert_get(self):

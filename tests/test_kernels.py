@@ -437,6 +437,45 @@ class TestSortedGroupedAggregate:
                 np.asarray(g, np.float64), np.asarray(w, np.float64),
                 rtol=2e-3, atol=2e-3, err_msg=f"{op} skew={skew}")
 
+    @pytest.mark.parametrize("ops", [
+        ("min", "max"),
+        ("first", "last"),
+        ("min", "max", "first", "last", "avg"),
+    ])
+    def test_doubling_kernels_high_cardinality(self, ops):
+        """The shift-doubling min/max + argext kernels (seg_len_k set,
+        G > the high-card threshold) match the scatter oracle, including
+        masked rows, empty groups, and skewed segment lengths."""
+        from greptimedb_tpu.ops.kernels import (
+            grouped_aggregate, sorted_grouped_aggregate)
+        rng = np.random.default_rng(11)
+        G = 9000                      # > _SEG_HIGH_CARD_THRESHOLD
+        n = 120_000
+        raw = np.concatenate([
+            rng.integers(0, G, n - 5000),
+            np.full(5000, 1234)])     # one fat segment (skew)
+        gids = np.sort(raw).astype(np.int32)
+        mask = rng.random(n) > 0.2
+        ts = rng.integers(0, 1 << 20, n).astype(np.int32)
+        vals = (rng.normal(size=n) * 50).astype(np.float32)
+        ends = np.cumsum(np.bincount(gids, minlength=G),
+                         dtype=np.int64).astype(np.int32)
+        from greptimedb_tpu.ops.kernels import seg_len_bucket
+        seg_k = seg_len_bucket(
+            int(np.diff(ends, prepend=np.int32(0)).max()))
+        values = tuple(vals for _ in ops)
+        got, counts = sorted_grouped_aggregate(
+            gids, mask, ts, values, num_groups=G, ops=ops, ends=ends,
+            seg_len_k=seg_k)
+        want, want_counts = grouped_aggregate(
+            gids, mask, ts, values, num_groups=G, ops=ops)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(want_counts))
+        for op, g, w in zip(ops, got, want):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64), np.asarray(w, np.float64),
+                rtol=2e-3, atol=2e-3, err_msg=op)
+
     def test_small_and_empty_groups(self):
         from greptimedb_tpu.ops.kernels import sorted_grouped_aggregate
         # groups 0,2 used; 1,3 empty; single-row group

@@ -209,6 +209,113 @@ class TestReplication:
             for nd in nodes:
                 nd.stop()
 
+    def test_non_utf8_values_roundtrip(self):
+        """Arbitrary bytes survive the JSON-encoded raft log (latin-1
+        bridge) — matching MemKv/FileKv byte semantics."""
+        nodes = make_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            blob = bytes(range(256))
+            kv.put("bin", blob)
+            assert kv.get("bin") == blob
+            assert kv.compare_and_put("bin", blob, b"\xff\xfe\x00")
+            assert kv.get("bin") == b"\xff\xfe\x00"
+            kv.batch([("put", "bin2", b"\x80\x81")])
+            assert kv.get("bin2") == b"\x80\x81"
+            follower = next(nd for nd in nodes if nd is not leader)
+            wait_for(lambda: follower.state.get("bin2") == b"\x80\x81",
+                     what="binary replication")
+        finally:
+            for nd in nodes:
+                nd.stop()
+
+
+class TestLogCompaction:
+    def test_log_stays_bounded(self, tmp_path):
+        """1k writes keep the in-memory log and per-append persist cost
+        bounded by compact_threshold (no O(n^2) bytes), and state stays
+        complete across a restart from snapshot + tail."""
+        import os as _os
+        ids = [1, 2, 3]
+        nodes = [RaftNode(i, ids, compact_threshold=32,
+                          store_path=str(tmp_path / f"raft-{i}.json"),
+                          **FAST) for i in ids]
+        connect_local(nodes)
+        for nd in nodes:
+            nd.start()
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            for i in range(1000):
+                kv.put(f"k{i % 50}", f"v{i}".encode())
+            assert len(leader.log) <= 32 + 4, \
+                "log must compact at the threshold"
+            assert leader.base > 900
+            # per-append persisted bytes are bounded: the log file holds
+            # only the tail
+            log_bytes = _os.path.getsize(tmp_path / "raft-1.json") + \
+                _os.path.getsize(tmp_path / "raft-2.json")
+            assert log_bytes < 64_000, "log file must stay tail-sized"
+            assert kv.get("k49") is not None
+            # full restart from snapshot + tail recovers everything
+            lid = leader.node_id
+            for nd in nodes:
+                nd.stop()
+            revived = [RaftNode(i, ids, compact_threshold=32,
+                                store_path=str(tmp_path / f"raft-{i}.json"),
+                                **FAST) for i in ids]
+            connect_local(revived)
+            for nd in revived:
+                nd.start()
+            nodes.extend(revived)
+            leader2 = leader_of(revived)
+            kv2 = ReplicatedKv(leader2)
+            wait_for(lambda: leader2.applied_idx >= leader2.base,
+                     what="revived apply")
+            for i in range(950, 1000):
+                assert kv2.get(f"k{i % 50}") == f"v{i}".encode()
+        finally:
+            for nd in nodes:
+                nd.stop()
+
+    def test_lagging_follower_gets_snapshot_install(self):
+        """A follower partitioned past the leader's compaction horizon
+        rejoins via InstallSnapshot (not an index-0 replay) and
+        converges."""
+        ids = [1, 2, 3]
+        nodes = [RaftNode(i, ids, compact_threshold=16, **FAST)
+                 for i in ids]
+        connect_local(nodes)
+        for nd in nodes:
+            nd.start()
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            kv.put("seed", b"1")
+            follower = next(nd for nd in nodes if nd is not leader)
+            wait_for(lambda: follower.state.get("seed") == b"1",
+                     what="initial sync")
+            # partition the follower, then write far past the threshold
+            follower.stop()
+            partition_away(nodes, follower)
+            for i in range(200):
+                kv.put(f"k{i}", f"v{i}".encode())
+            assert leader.base > 100, "leader must have compacted"
+            assert follower.base == 0
+            # reconnect: the needed tail is gone; snapshot must flow
+            live = [nd for nd in nodes if nd is not follower] + [follower]
+            connect_local(live)
+            follower.start()
+            wait_for(lambda: follower.state.get("k199") == b"v199",
+                     what="snapshot install catch-up")
+            assert follower.base > 0, "follower must have installed a " \
+                "snapshot, not replayed from zero"
+            assert follower.state.get("seed") == b"1"
+        finally:
+            for nd in nodes:
+                nd.stop()
+
 
 class TestMetaSrvFailover:
     """The VERDICT bar: kill the meta leader; routes stay resolvable."""

@@ -186,6 +186,17 @@ class TestFallback:
             (SELECT host FROM monitor WHERE ts < 1000) s""")
         assert out.batches[0].to_pylist()[0]["c"] == 4
 
+    def test_correlated_exists_unsupported_error(self, world):
+        """An unqualified outer-column reference inside EXISTS surfaces
+        the 'correlated ... not supported' taxonomy error, not a raw
+        column-not-found."""
+        from greptimedb_tpu.errors import UnsupportedError
+        engine, *_ = world
+        with pytest.raises(UnsupportedError, match="correlated"):
+            run(engine, """
+                SELECT host FROM monitor m WHERE EXISTS
+                (SELECT 1 FROM monitor WHERE host = no_such_col)""")
+
 
 class TestTpuPath:
     def _oracle(self, engine, sql, monkeypatch):

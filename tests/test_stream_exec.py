@@ -151,6 +151,34 @@ class TestStreamedMatchesCached:
         finally:
             storage.close()
 
+    def test_wide_region_streams_on_byte_budget(self, tmp_path,
+                                                monkeypatch):
+        """A region under the ROW threshold still streams when its
+        estimated decoded bytes exceed half the scan-cache budget (one
+        fat region must not blow residency — the cache never evicts its
+        newest entry)."""
+        storage, engine, table, region = make_world(tmp_path)
+        try:
+            # row threshold far above the region; byte budget tiny
+            monkeypatch.setattr(stream_exec, "_STREAM_THRESHOLD_ROWS",
+                                [1 << 62])
+            est = stream_exec.region_estimated_bytes(region)
+            assert est > 0
+            monkeypatch.setattr(tpu_exec.SCAN_CACHE, "budget_bytes", est)
+            called = []
+            orig = stream_exec.stream_region_moment_frames
+
+            def spy(*a, **k):
+                called.append(1)
+                return orig(*a, **k)
+            monkeypatch.setattr(stream_exec,
+                                "stream_region_moment_frames", spy)
+            rows_of(engine, "SELECT host, avg(cpu) FROM m GROUP BY host")
+            assert called, "wide region must stream, not cache"
+            assert region.uid not in tpu_exec.SCAN_CACHE._entries
+        finally:
+            storage.close()
+
     def test_memtable_only_region(self, tmp_path, monkeypatch):
         storage, engine, table, region = make_world(
             tmp_path, n=900, flushes=0)
