@@ -89,6 +89,66 @@ def bench_cpu(gids, ts, metrics):
     return len(gids) / dt
 
 
+def bench_cold_e2e(n_rows: int):
+    """Second driver metric: cold single-groupby Mrows/s over a small
+    REGION PERSISTED THROUGH THE REAL WRITE PATH — parquet decode →
+    lean slice reduce → fold, via frontend.do_query with the scan cache
+    cleared. The flagship kernel number above has been flat for rounds
+    while the actual work moved to this path; carrying both makes a
+    regression in either visible (ISSUE 1 satellite)."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    from greptimedb_tpu.query import stream_exec, tpu_exec
+    from greptimedb_tpu.session import QueryContext
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-cold-")
+    fe = None
+    saved_threshold = stream_exec.stream_threshold_rows()
+    try:
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=tmpdir, register_numbers_table=False))
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE cpu (hostname STRING, ts TIMESTAMP "
+                    "TIME INDEX, usage_user DOUBLE, "
+                    "PRIMARY KEY(hostname))")
+        table = fe.catalog.table("greptime", "public", "cpu")
+        rng = np.random.default_rng(7)
+        hosts = 500
+        per = n_rows // hosts
+        ts = np.tile(np.arange(per, dtype=np.int64) * 10_000, hosts)
+        host = np.repeat(
+            np.array([f"host_{i}" for i in range(hosts)]),
+            per).astype(object)
+        table.bulk_load({"hostname": host, "ts": ts,
+                         "usage_user": rng.random(len(ts)) * 100})
+        n = hosts * per
+        sql = "SELECT hostname, avg(usage_user) FROM cpu GROUP BY hostname"
+        stream_exec.configure_streaming(threshold_rows=1)
+        fe.do_query(sql, ctx)              # absorb one-time costs
+        dt = float("inf")
+        for _ in range(2):                 # best of 2: noisy shared hosts
+            tpu_exec.SCAN_CACHE._entries.clear()
+            t0 = time.perf_counter()
+            fe.do_query(sql, ctx)
+            dt = min(dt, time.perf_counter() - t0)
+        return n / dt                      # rows/sec
+    finally:
+        # the streaming threshold is process-global: restore it so any
+        # metric added after this one measures the normal dispatch, and
+        # stop the engine's background workers before deleting their dir
+        stream_exec.configure_streaming(threshold_rows=saved_threshold)
+        if fe is not None:
+            fe.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main():
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
@@ -109,6 +169,15 @@ def main():
         "value": round(tpu_rps / 1e6, 2),
         "unit": "Mrows/s",
         "vs_baseline": round(tpu_rps / cpu_rps, 2),
+    }))
+
+    cold_rows = int(os.environ.get("GREPTIME_BENCH_COLD_ROWS", 4_000_000))
+    cold_rps = bench_cold_e2e(cold_rows)
+    print(json.dumps({
+        "metric": "cold_single_groupby_e2e_throughput",
+        "value": round(cold_rps / 1e6, 2),
+        "unit": "Mrows/s",
+        "rows": cold_rows,
     }))
 
 

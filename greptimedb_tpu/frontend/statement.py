@@ -311,8 +311,27 @@ class StatementExecutor:
         return Output.rows(0)
 
     def set_variable(self, stmt: ast.SetVariable, ctx: QueryContext) -> Output:
-        if stmt.name.lower() in ("time_zone", "timezone"):
+        name = stmt.name.lower()
+        if name in ("time_zone", "timezone"):
             ctx.time_zone = str(stmt.value)
+        elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
+            try:
+                value = int(stmt.value)
+            except (TypeError, ValueError):
+                raise InvalidArgumentsError(
+                    f"SET {stmt.name}: expected an integer, "
+                    f"got {stmt.value!r}")
+            if name == "stream_threshold_rows":
+                # expose the cold-scan streaming knob to SQL so operators
+                # (and the sqlness explain goldens) can pin the dispatch
+                # decision without a config reload
+                from ..query.stream_exec import configure_streaming
+                configure_streaming(threshold_rows=value)
+            else:
+                # static device-dispatch floor (the latency-adaptive
+                # floor never goes below it)
+                from ..query import tpu_exec
+                tpu_exec.TPU_DISPATCH_MIN_ROWS = value
         return Output.rows(0)
 
     # ---- COPY ----

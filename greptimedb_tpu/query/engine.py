@@ -92,7 +92,44 @@ class QueryEngine:
                 table = self.resolve_table(inner.from_, ctx)
             plan = tpu_exec.plan_for(table, a, inner) if table else None
             if plan is not None:
-                lines.append("TpuAggregateExec: " + plan.describe())
+                # pin the dispatch decision (sqlness explain goldens):
+                # pushdown / cpu-small-scan / streamed-cold / resident.
+                # Uses the STATIC dispatch floor, not the latency-adaptive
+                # one (_dispatch_min_rows), so the plan text is
+                # deterministic across processes and runs.
+                est = tpu_exec._estimated_table_rows(table)
+                if hasattr(table, "execute_tpu_plan"):
+                    lines.append("TpuAggregateExec: " + plan.describe())
+                    lines.append("  Dispatch: aggregate-pushdown "
+                                 "(datanodes reduce, frontend folds)")
+                elif est is not None and \
+                        est < tpu_exec.TPU_DISPATCH_MIN_ROWS:
+                    lines.append("CpuAggregateExec: " + plan.describe())
+                    lines.append(
+                        f"  Dispatch: cpu-small-scan (est_rows={est} < "
+                        f"dispatch_floor={tpu_exec.TPU_DISPATCH_MIN_ROWS})")
+                else:
+                    # mirror execution exactly: region_moment_frames
+                    # decides per REGION, on rows OR decoded-bytes vs
+                    # the scan-cache budget (region_streams_cold)
+                    from .stream_exec import stream_threshold_rows
+                    regions = list(getattr(table, "regions", {}).values())
+                    n_stream = sum(
+                        1 for r in regions
+                        if tpu_exec.region_streams_cold(r))
+                    lines.append("TpuAggregateExec: " + plan.describe())
+                    if n_stream == 0:
+                        lines.append(
+                            "  Dispatch: device-resident (scan cache)")
+                    elif n_stream == len(regions):
+                        lines.append(
+                            f"  Dispatch: streamed-cold (est_rows={est}, "
+                            f"stream_threshold_rows="
+                            f"{stream_threshold_rows()})")
+                    else:
+                        lines.append(
+                            f"  Dispatch: mixed ({n_stream}/"
+                            f"{len(regions)} regions streamed-cold)")
             elif a.is_aggregate:
                 lines.append("CpuAggregateExec: groups=" + ", ".join(
                     expr_name(g) for g in a.group_exprs))
@@ -875,28 +912,48 @@ def _np_to_type(s: pd.Series):
 
 
 def _df_to_batch(df: pd.DataFrame, schema: Schema) -> RecordBatch:
-    cols = {}
+    # column-at-a-time vectorized conversion: per-value python loops here
+    # used to cost more than the whole streamed fold on wide group-bys
+    # (0.37s at 136k output rows)
+    from ..datatypes.vector import Vector
+    cols = []
     for cs in schema.column_schemas:
         s = df[cs.name]
         if cs.dtype.is_string:
             vals = [None if v is None or (isinstance(v, float) and np.isnan(v))
                     else str(v) if not isinstance(v, str) else v
                     for v in s.tolist()]
-            cols[cs.name] = vals
+            cols.append(Vector.from_pylist(vals, cs.dtype))
         elif s.dtype.kind == "M":
-            cols[cs.name] = (s.astype(np.int64) // 1_000_000).tolist()
+            cols.append(Vector(
+                cs.dtype,
+                np.ascontiguousarray(s.to_numpy(np.int64) // 1_000_000,
+                                     dtype=cs.dtype.np_dtype)))
         elif s.dtype.kind == "f":
+            a = s.to_numpy()
+            nan = np.isnan(a)
+            has_nan = bool(nan.any())
             if cs.dtype.np_dtype.kind in "iu" or cs.dtype.is_timestamp:
                 # declared integral (int aggregate / time bucket) but the
                 # accumulator ran in float: cast back, NaN -> NULL
-                cols[cs.name] = [None if v != v else int(round(v))
-                                 for v in s.tolist()]
+                ints = np.round(np.where(nan, 0.0, a)).astype(
+                    cs.dtype.np_dtype if cs.dtype.np_dtype is not None
+                    else np.int64)
+                cols.append(Vector(cs.dtype, ints,
+                                   ~nan if has_nan else None))
             else:
                 # SQL convention (as in pandas-backed systems): NaN is NULL
-                cols[cs.name] = [None if v != v else v for v in s.tolist()]
+                cols.append(Vector(
+                    cs.dtype,
+                    np.ascontiguousarray(a, dtype=cs.dtype.np_dtype),
+                    ~nan if has_nan else None))
+        elif s.dtype == object:
+            cols.append(Vector.from_pylist(s.tolist(), cs.dtype))
         else:
-            cols[cs.name] = s.tolist()
-    return RecordBatch.from_pydict(schema, cols)
+            cols.append(Vector(
+                cs.dtype,
+                np.ascontiguousarray(s.to_numpy(), dtype=cs.dtype.np_dtype)))
+    return RecordBatch(schema, cols)
 
 
 _INT_TYPE_NAMES = {"Int8", "Int16", "Int32", "Int64",

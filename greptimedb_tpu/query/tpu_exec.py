@@ -784,6 +784,22 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     return _finalize(merged, plan)
 
 
+def region_streams_cold(region) -> bool:
+    """Whether a region takes the streamed-cold path instead of the
+    device-resident scan cache. Streams on either bound: row count, or
+    estimated decoded bytes vs the scan-cache budget — a wide-schema
+    region can bust residency long before the row threshold (the budget
+    never evicts the newest entry, so admission is the only guard).
+    Shared by execution (region_moment_frames) and EXPLAIN so the
+    printed dispatch decision cannot drift from the real one."""
+    from . import stream_exec
+    return stream_exec.region_estimated_rows(region) > \
+        stream_exec.stream_threshold_rows() or \
+        (SCAN_CACHE.budget_bytes > 0 and
+         stream_exec.region_estimated_bytes(region) >
+         SCAN_CACHE.budget_bytes // 2)
+
+
 def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
     """Per-region moment frames for a table's local regions (shared by the
     single-node fast path and the datanode side of aggregate pushdown).
@@ -795,15 +811,7 @@ def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
     from . import stream_exec
     frames = []
     for region in table.regions.values():
-        # stream on either bound: row count, or estimated decoded bytes
-        # vs the scan-cache budget — a wide-schema region can bust
-        # residency long before the row threshold (the budget never
-        # evicts the newest entry, so admission is the only guard)
-        if stream_exec.region_estimated_rows(region) > \
-                stream_exec.stream_threshold_rows() or \
-                (SCAN_CACHE.budget_bytes > 0 and
-                 stream_exec.region_estimated_bytes(region) >
-                 SCAN_CACHE.budget_bytes // 2):
+        if region_streams_cold(region):
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))
             continue

@@ -27,7 +27,11 @@ class EngineConfig:
     wal_backend: str = "auto"           # auto | native | python
     disable_wal: bool = False           # benchmarks / ephemeral regions
     checkpoint_margin: int = 10
-    row_group_size: int = 65536
+    #: rows per parquet row group — 1Mi matches sst.DEFAULT_ROW_GROUP_SIZE:
+    #: large groups encode ~2x and decode ~15% faster than the old 64Ki
+    #: (fewer page/stat boundaries), and the streamed cold scan plans
+    #: slices from row-group stats at multi-million-row granularity anyway
+    row_group_size: int = 1 << 20
     # background machinery (reference: scheduler.rs + file_purger.rs)
     bg_workers: int = 4
     purge_grace_s: float = 60.0

@@ -39,6 +39,72 @@ class ObjectStore:
         readers), return its path; else None and callers fall back to read()."""
         return None
 
+    def put_path(self, key: str):
+        """Context manager yielding a local filesystem path for the caller
+        to write the object into directly (parquet writers stream pages to
+        it instead of buffering the whole file in memory). The object
+        becomes visible under `key` only when the context exits cleanly.
+        Default implementation spools to a temp file and write()s it."""
+        return _SpoolPut(self, key)
+
+
+class _SpoolPut:
+    def __init__(self, store: "ObjectStore", key: str):
+        self._store = store
+        self._key = key
+        self._tmp: Optional[str] = None
+
+    def __enter__(self) -> str:
+        fd, self._tmp = tempfile.mkstemp(prefix=".gdb-put-")
+        os.close(fd)
+        return self._tmp
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None:
+                with open(self._tmp, "rb") as f:
+                    self._store.write(self._key, f.read())
+        finally:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
+
+class _FsPut:
+    """Direct put: write into a temp file in the destination directory,
+    fsync, rename — the same atomicity as FsObjectStore.write without the
+    intermediate whole-file buffer."""
+
+    def __init__(self, store: "FsObjectStore", key: str):
+        self._path = store._path(key)
+        self._tmp: Optional[str] = None
+
+    def __enter__(self) -> str:
+        d = os.path.dirname(self._path)
+        os.makedirs(d, exist_ok=True)
+        fd, self._tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+        os.close(fd)
+        return self._tmp
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            try:
+                with open(self._tmp, "rb+") as f:
+                    os.fsync(f.fileno())
+                os.replace(self._tmp, self._path)
+                return
+            except BaseException:
+                self._unlink_tmp()           # no orphaned spool files
+                raise
+        self._unlink_tmp()
+
+    def _unlink_tmp(self) -> None:
+        try:
+            os.unlink(self._tmp)
+        except OSError:
+            pass
+
 
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
@@ -101,6 +167,9 @@ class FsObjectStore(ObjectStore):
     def local_path(self, key: str) -> Optional[str]:
         p = self._path(key)
         return p if os.path.exists(p) else None
+
+    def put_path(self, key: str) -> "_FsPut":
+        return _FsPut(self, key)
 
 
 def new_fs_object_store(root: str) -> FsObjectStore:

@@ -32,7 +32,8 @@ from .memtable import Memtable, MemtableSnapshot, MemtableVersion
 from .manifest import RegionManifest
 from .object_store import ObjectStore
 from .series import SeriesDict
-from .sst import AccessLayer, FileMeta, LevelMetas, SERIES_COL
+from .sst import (AccessLayer, DEFAULT_ROW_GROUP_SIZE, FileMeta, LevelMetas,
+                  SERIES_COL)
 from .version import Version, VersionControl
 from .wal import NoopWal, Wal
 from .write_batch import OP_DELETE, OP_PUT, WriteBatch
@@ -47,6 +48,34 @@ class RegionDescriptor:
     schema: Schema
     region_dir: str               # key prefix on the object store
     wal_dir: str                  # local filesystem dir for the WAL
+
+
+@dataclass
+class IngestProfile:
+    """Stage-by-stage wall-clock breakdown of one bulk_ingest call
+    (published in BASELINE.md; the perf-smoke test asserts the machinery).
+    `sst_write` covers the parallel parquet encode + fsync of all chunks,
+    so with N concurrent writers it is wall time, not CPU time."""
+    rows: int = 0
+    total_s: float = 0.0
+    stages: Dict[str, float] = field(default_factory=dict)
+
+    def mrows_per_s(self) -> float:
+        return self.rows / self.total_s / 1e6 if self.total_s else 0.0
+
+    def merge(self, other: "IngestProfile") -> None:
+        """Accumulate another call's profile (multi-batch loads)."""
+        self.rows += other.rows
+        self.total_s += other.total_s
+        for k, v in other.stages.items():
+            self.stages[k] = self.stages.get(k, 0.0) + v
+
+    def describe(self) -> str:
+        parts = ", ".join(f"{k}={v:.3f}s"
+                          for k, v in sorted(self.stages.items(),
+                                             key=lambda kv: -kv[1]))
+        return (f"{self.rows} rows in {self.total_s:.3f}s "
+                f"({self.mrows_per_s():.2f} Mrows/s): {parts}")
 
 
 @dataclass
@@ -83,7 +112,16 @@ class RegionSnapshot:
     def scan(self, *, projection: Optional[Sequence[str]] = None,
              time_range: Optional[TimestampRange] = None,
              series_range: Optional[Tuple[int, int]] = None,
-             synthetic_seq: bool = False) -> ScanData:
+             synthetic_seq: bool = False,
+             need_ts: bool = True,
+             need_mvcc: bool = True) -> ScanData:
+        """need_ts=False / need_mvcc=False let a caller that PROVED it
+        will not consult row times / sequence+op values (dup-free,
+        delete-free, key-disjoint slice — the streamed cold scan's
+        fast path) skip decoding and materializing those columns; the
+        returned arrays are 0-stride placeholders. need_ts=False also
+        skips the per-file time-range mask: the caller asserts every
+        selected row group lies inside its requested range."""
         region = self._region
         v = self._version
         schema = v.schema
@@ -125,13 +163,14 @@ class RegionSnapshot:
         for sst in parallel_imap(
                 lambda m: region.access_layer.read_sst(
                     m, projection=field_names, time_range=time_range,
-                    series_range=series_range, synthetic_seq=synthetic_seq),
+                    series_range=series_range, synthetic_seq=synthetic_seq,
+                    need_ts=need_ts),
                 v.ssts.files_in_range(time_range)):
             if sst.num_rows == 0:
                 continue
             sel = None
             need_mask = False
-            if time_range is not None:
+            if time_range is not None and need_ts:
                 # skip the mask (and the per-column copies it forces) when
                 # every surviving row group lies inside the range — the
                 # common case for slice reads cut on row-group edges
@@ -147,7 +186,7 @@ class RegionSnapshot:
                     smax >= series_range[1]
             if need_mask:
                 sel = np.ones(sst.num_rows, dtype=bool)
-                if time_range is not None:
+                if time_range is not None and need_ts:
                     if time_range.start is not None:
                         sel &= sst.ts >= time_range.start
                     if time_range.end is not None:
@@ -184,9 +223,18 @@ class RegionSnapshot:
         runs.sort(key=lambda r: (int(r[0][0]), int(r[1][0]))
                   if len(r[0]) else (0, 0))
         series_ids = np.concatenate([r[0] for r in runs])
-        ts = np.concatenate([r[1] for r in runs])
-        seq = np.concatenate([r[2] for r in runs])
-        op = np.concatenate([r[3] for r in runs])
+        total = len(series_ids)
+        # placeholder columns stay 0-stride through the concat — a lean
+        # scan of N runs must not pay an 8B×rows materialize per column
+        # it promised never to read
+        ts = np.concatenate([r[1] for r in runs]) if need_ts \
+            else np.broadcast_to(np.int64(0), (total,))
+        if need_mvcc:
+            seq = np.concatenate([r[2] for r in runs])
+            op = np.concatenate([r[3] for r in runs])
+        else:
+            seq = np.broadcast_to(np.int64(0), (total,))
+            op = np.broadcast_to(np.int8(0), (total,))
         fields = {}
         for name in field_names:
             datas = [r[4][name][0] for r in runs]
@@ -228,7 +276,7 @@ class Region:
                  *, wal: Optional[Wal] = None,
                  flush_size_bytes: int = 64 * 1024 * 1024,
                  checkpoint_margin: int = 10,
-                 row_group_size: int = 65536,
+                 row_group_size: int = DEFAULT_ROW_GROUP_SIZE,
                  scheduler=None,
                  purger=None,
                  ttl_ms: Optional[int] = None,
@@ -280,6 +328,7 @@ class Region:
         self._dict_version = 0
         self._persisted_series = 0
         self.version_control: Optional[VersionControl] = None
+        self.last_ingest_profile: Optional[IngestProfile] = None
         self.closed = False
 
     # ---- lifecycle ----
@@ -364,7 +413,8 @@ class Region:
             region._dict_version = int(dict_file.rsplit("-", 1)[-1].split(".")[0]) + 1
         region.access_layer = AccessLayer(
             store, f"{descriptor.region_dir}/sst", schema,
-            row_group_size=region.access_layer.row_group_size)
+            row_group_size=region.access_layer.row_group_size,
+            field_encoding=region.access_layer.field_encoding)
         mutable = Memtable(schema, region.series_dict)
         version = Version(schema=schema, memtables=MemtableVersion(mutable),
                           ssts=ssts, flushed_sequence=flushed_sequence,
@@ -447,11 +497,26 @@ class Region:
 
         Any buffered memtable rows are flushed first so the manifest's
         flushed_sequence may advance past this batch's sequence without
-        orphaning their WAL entries at replay."""
+        orphaning their WAL entries at replay.
+
+        Each call records its stage breakdown in `self.last_ingest_profile`
+        (series encode / sort / parquet+fsync / manifest — the profile
+        BASELINE.md publishes)."""
         import os as _os
+        import time as _time
 
         from ..common.runtime import parallel_map
         from ..ops.kernels import _merge_order
+
+        prof = IngestProfile()
+        _t = _time.perf_counter()
+        _t0 = _t
+
+        def mark(stage: str) -> None:
+            nonlocal _t
+            now = _time.perf_counter()
+            prof.stages[stage] = prof.stages.get(stage, 0.0) + (now - _t)
+            _t = now
 
         if chunk_rows is None:
             # one SST per writer core: chunking only pays when parquet
@@ -483,8 +548,11 @@ class Region:
             n = rb.num_rows
         if n == 0:
             return 0
+        prof.rows = n
+        mark("coerce")
         if any(mt.num_rows for mt in vc.current.memtables.all_memtables()):
             self.flush()
+            mark("pre_flush")
         with self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
@@ -504,6 +572,7 @@ class Region:
                 sids = self.series_dict.encode_rows(tag_cols)
             else:
                 sids = self.series_dict.encode_zero_tags(n)
+            mark("series_encode")
             ts_name = schema.timestamp_column.name
             ts = np.asarray(data[ts_name] if rb is None
                             else rb.column(ts_name).data, dtype=np.int64)
@@ -515,10 +584,13 @@ class Region:
                 ((sids[1:] == sids[:-1]) & (ts[1:] >= ts[:-1]))))
             if pre_sorted:
                 order = None
+                mark("sort_check")
             else:
+                mark("sort_check")
                 order = _merge_order(sids, ts, np.zeros(n, np.int64))
                 sids = sids[order]
                 ts = ts[order]
+                mark("sort")
             fields = {}
             for c in schema.field_columns():
                 if rb is None:
@@ -541,6 +613,7 @@ class Region:
                 fields[c.name] = (d, vd)
             seq_arr = np.full(n, seq, dtype=np.int64)
             op_arr = np.zeros(n, dtype=np.int8)
+            mark("field_prep")
 
             # chunk at SERIES boundaries: a (sid, ts) key must not span
             # two files (same sequence → undefined MVCC winner), and
@@ -573,9 +646,11 @@ class Region:
                                  for nm, (idx, vals) in tag_id_cols.items()},
                     schema=schema)
 
+            mark("chunk_plan")
             files = [f for f in parallel_map(write_chunk,
                                              range(len(cuts) - 1))
                      if f is not None]
+            mark("sst_write")
             flushed_seq = max(seq, vc.current.flushed_sequence)
             # a write() may have landed between the pre-lock flush and
             # acquiring the lock: its WAL entry carries a lower sequence,
@@ -590,6 +665,7 @@ class Region:
             if unflushed:
                 flushed_seq = min(flushed_seq, min(unflushed) - 1)
             dict_file = self._persist_series_dict()
+            mark("dict_persist")
             edit = {
                 "type": "edit",
                 "added": [f.to_dict() for f in files],
@@ -608,6 +684,9 @@ class Region:
                            manifest_version=mv)
             self._maybe_checkpoint()
             l0_count = len(vc.current.ssts.levels[0])
+            mark("manifest")
+            prof.total_s = _time.perf_counter() - _t0
+            self.last_ingest_profile = prof
         if self.scheduler is not None and l0_count >= self.max_l0_files:
             self.schedule_compaction()
         return n

@@ -14,6 +14,7 @@ row groups cover disjoint-ish series/time ranges for pruning.
 from __future__ import annotations
 
 import io
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -54,6 +55,13 @@ class FileMeta:
     #: disjoint on either axis cannot hold competing versions of a key
     #: (compaction's trivial move and scan planning rely on this).
     sid_range: Optional[Tuple[int, int]] = None
+    #: adjacent rows sharing a (series_id, ts) key (MVCC versions inside
+    #: this file); None = unknown (pre-upgrade files). A slice covering
+    #: only dup-free, delete-free, key-disjoint files needs no merge
+    #: dedup at all — the streamed cold scan skips the per-row key
+    #: comparison pass (and the ts decode, when the query never reads
+    #: time) on that proof.
+    num_dup_keys: Optional[int] = None
 
     def to_dict(self) -> dict:
         return {
@@ -63,6 +71,7 @@ class FileMeta:
             "num_deletes": self.num_deletes,
             "sid_range": list(self.sid_range)
             if self.sid_range is not None else None,
+            "num_dup_keys": self.num_dup_keys,
         }
 
     @staticmethod
@@ -71,7 +80,8 @@ class FileMeta:
                         d["num_rows"], d["file_size"],
                         d.get("max_sequence", 0), d.get("num_deletes"),
                         tuple(d["sid_range"])
-                        if d.get("sid_range") is not None else None)
+                        if d.get("sid_range") is not None else None,
+                        d.get("num_dup_keys"))
 
     def keys_overlap(self, other: "FileMeta") -> bool:
         """Whether the two files' key rectangles intersect — i.e. some
@@ -146,11 +156,16 @@ class AccessLayer:
 
     def __init__(self, store: ObjectStore, sst_dir: str, schema: Schema,
                  row_group_size: int = DEFAULT_ROW_GROUP_SIZE,
-                 compression: str = "lz4"):
+                 compression: str = "lz4",
+                 field_encoding: str = "dictionary"):
         self.store = store
         self.sst_dir = sst_dir.rstrip("/")
         self.schema = schema
         self.row_group_size = row_group_size
+        #: metric-column encoding: "dictionary" (parquet-adaptive, decodes
+        #: fastest when values repeat — e.g. fixed-precision telemetry) or
+        #: "byte_stream_split" (uniform encode cost on full-entropy floats)
+        self.field_encoding = field_encoding
         #: parquet codec. lz4 decodes ~1.7x faster than zstd on mostly-
         #: incompressible float telemetry at near-identical file size —
         #: and single-core decode rate bounds the cold streamed scan.
@@ -208,26 +223,70 @@ class AccessLayer:
         arrays.append(pa.array(op_types, type=pa.int8()))
         names.append(OP_COL)
         table = pa.table(dict(zip(names, arrays)))
-        sink = io.BytesIO()
-        pq.write_table(table, sink, row_group_size=self.row_group_size,
-                       compression=self.compression, write_statistics=True)
-        data = sink.getvalue()
+        ts_name = schema.timestamp_column.name
+        # Encode/stat choices are ingest-rate critical (profiled in
+        # BASELINE.md): stats only on the two pruning columns (ts, sid) —
+        # per-page min/max on the metric columns bought nothing and cost
+        # ~35% of encode; dictionary encoding stays OFF for ts/sid (mostly
+        # unique / already dense — hashing them is pure waste) and ON
+        # elsewhere, where parquet's adaptive fallback bounds the cost on
+        # incompressible metrics. byte_stream_split is the configurable
+        # alternative for float metrics (field_encoding knob): it encodes
+        # fast on any distribution but decodes ~20% slower than dict-hit
+        # columns, and the cold scan is decode-bound.
+        no_dict = {ts_name, SERIES_COL}
+        bss_cols = []
+        if self.field_encoding == "byte_stream_split":
+            for c in schema.field_columns():
+                if c.dtype.np_dtype is not None and \
+                        np.issubdtype(c.dtype.np_dtype, np.floating):
+                    no_dict.add(c.name)
+                    bss_cols.append(c.name)
+        opts = dict(
+            row_group_size=self.row_group_size,
+            compression=self.compression,
+            write_statistics=[ts_name, SERIES_COL],
+            use_dictionary=[nm for nm in names if nm not in no_dict],
+        )
+        if bss_cols:
+            opts["use_byte_stream_split"] = bss_cols
         file_name = new_sst_name()
-        self.store.write(self._key(file_name), data)
+        key = self._key(file_name)
+        put = getattr(self.store, "put_path", None)
+        if put is not None:
+            # stream pages straight to the destination file — the
+            # BytesIO spool + getvalue + write() round trip copied the
+            # whole file twice
+            with put(key) as tmp:
+                pq.write_table(table, tmp, **opts)
+                size = os.path.getsize(tmp)
+        else:
+            sink = io.BytesIO()
+            pq.write_table(table, sink, **opts)
+            data = sink.getvalue()
+            size = len(data)
+            self.store.write(key, data)
+        dups = 0
+        if n > 1:
+            # rows are (sid, ts, seq)-sorted: duplicate keys are adjacent
+            dups = int(np.count_nonzero(
+                (series_ids[1:] == series_ids[:-1]) & (ts[1:] == ts[:-1])))
         return FileMeta(
             file_name=file_name, level=level,
             time_range=(int(ts.min()), int(ts.max())),
-            num_rows=n, file_size=len(data),
+            num_rows=n, file_size=size,
             max_sequence=int(seq.max()) if n else 0,
             num_deletes=int(np.count_nonzero(op_types)),
-            sid_range=(int(series_ids.min()), int(series_ids.max())))
+            sid_range=(int(series_ids.min()), int(series_ids.max())),
+            num_dup_keys=dups)
 
     # ---- read ----
     def read_sst(self, meta: FileMeta, *,
                  projection: Optional[Sequence[str]] = None,
                  time_range: Optional[TimestampRange] = None,
                  series_range: Optional[Tuple[int, int]] = None,
-                 synthetic_seq: bool = False) -> SstData:
+                 synthetic_seq: bool = False,
+                 need_ts: bool = True) -> SstData:
         """Read an SST with column projection and row-group pruning on
         the time index and/or the series id (`series_range` is a
         half-open [lo, hi) over __series_id — the storage sort order,
@@ -241,7 +300,15 @@ class AccessLayer:
         seq-ascending (stable sort keeps them). Only valid for readers
         that never filter by sequence value (the streamed scan); the
         incremental cache needs real sequences. When the file records
-        zero deletes the __op_type column is skipped too."""
+        zero deletes the __op_type column is skipped too.
+
+        need_ts=False additionally skips decoding the time index (the
+        widest internal column) and returns a 0-stride zero ts. Only
+        valid when the caller proved it will never consult row times:
+        no time filter/bucket in the query and no merge dedup needed
+        (dup-free, delete-free, key-disjoint files — see
+        FileMeta.num_dup_keys). Row-group pruning still works — it
+        reads footer stats, not the column."""
         key = self._key(meta.file_name)
         path = self.store.local_path(key)
         src = path if path is not None else pa.BufferReader(self.store.read(key))
@@ -269,8 +336,9 @@ class AccessLayer:
         missing = [n for n in field_names if n not in present]
         skip_seq = synthetic_seq
         skip_op = synthetic_seq and meta.num_deletes == 0
-        cols = [n for n in field_names if n in present] + [ts_name,
-                                                           SERIES_COL]
+        cols = [n for n in field_names if n in present] + [SERIES_COL]
+        if need_ts:
+            cols.append(ts_name)
         if not skip_seq:
             cols.append(SEQ_COL)
         if not skip_op:
@@ -283,7 +351,18 @@ class AccessLayer:
             return SstData(np.zeros(0, np.int32), z64, z64,
                            np.zeros(0, np.int8), empty_fields, 0)
         table = pf.read_row_groups(groups, columns=cols, use_threads=True)
-        ts = np.asarray(table.column(ts_name).cast(pa.int64()))
+        if need_ts:
+            tcol = table.column(ts_name)
+            if pa.types.is_timestamp(tcol.type):
+                # reinterpret, don't cast: the compute cast pays arrow's
+                # kernel-registry init on first use and a copy after
+                tcol = pa.chunked_array([c.view(pa.int64())
+                                         for c in tcol.chunks])
+            elif tcol.type != pa.int64():
+                tcol = tcol.cast(pa.int64())
+            ts = np.asarray(tcol)
+        else:
+            ts = np.broadcast_to(np.int64(0), (table.num_rows,))
         sids = np.asarray(table.column(SERIES_COL))
         # synthetic columns are constant: 0-stride broadcast views cost
         # no allocation or fill (8 MB+ per million rows otherwise)

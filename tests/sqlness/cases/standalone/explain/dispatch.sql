@@ -1,0 +1,50 @@
+-- EXPLAIN goldens pinning the TPU / CPU-fallback / streamed dispatch
+-- decision per query shape (ISSUE 1 satellite). The dispatch line uses
+-- the static floor so the text is deterministic; the SET knobs below
+-- exercise every branch of the decision chain on a 3-row table.
+
+CREATE TABLE cpu_explain (
+    hostname STRING,
+    ts TIMESTAMP TIME INDEX,
+    usage_user DOUBLE,
+    PRIMARY KEY(hostname)
+);
+
+INSERT INTO cpu_explain VALUES
+    ('h1', 1000, 10.0),
+    ('h1', 2000, 20.0),
+    ('h2', 1000, 30.0);
+
+-- aggregate on a tiny table: device plan exists, but the cost model
+-- routes it to the CPU columnar path
+EXPLAIN SELECT hostname, avg(usage_user) FROM cpu_explain GROUP BY hostname;
+
+-- non-aggregate: plain CPU projection
+EXPLAIN SELECT hostname, usage_user FROM cpu_explain WHERE usage_user > 20;
+
+-- time-bucketed double group-by keeps the device plan shape
+EXPLAIN SELECT hostname, date_bin(INTERVAL '1 hour', ts) AS bucket,
+               avg(usage_user)
+        FROM cpu_explain GROUP BY hostname, bucket;
+
+-- aggregate the planner cannot lower (group by a field expression):
+-- CPU aggregate fallback
+EXPLAIN SELECT usage_user * 2 AS k, count(*) FROM cpu_explain GROUP BY k;
+
+-- drop the dispatch floor: the same query now dispatches to the device
+-- (resident, under the streaming threshold)
+SET tpu_dispatch_min_rows = 1;
+
+EXPLAIN SELECT hostname, avg(usage_user) FROM cpu_explain GROUP BY hostname;
+
+-- drop the streaming threshold under the table's 3 rows: streamed-cold
+SET stream_threshold_rows = 2;
+
+EXPLAIN SELECT hostname, avg(usage_user) FROM cpu_explain GROUP BY hostname;
+
+-- restore defaults (these knobs are process-global)
+SET stream_threshold_rows = 64000000;
+
+SET tpu_dispatch_min_rows = 131072;
+
+DROP TABLE cpu_explain;

@@ -1,0 +1,29 @@
+-- Error-path goldens: invalid inserts must fail with stable, rendered
+-- errors — not partial writes (ISSUE 1 satellite).
+
+CREATE TABLE invalid_insert_t (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    cpu DOUBLE,
+    PRIMARY KEY(host)
+);
+
+-- unknown column
+INSERT INTO invalid_insert_t (host, ts, nope) VALUES ('h1', 1000, 1.0);
+
+-- arity mismatch: more values than columns
+INSERT INTO invalid_insert_t VALUES ('h1', 1000, 1.0, 2.0);
+
+-- type mismatch: string into DOUBLE
+INSERT INTO invalid_insert_t VALUES ('h1', 1000, 'not-a-number');
+
+-- missing the time index value
+INSERT INTO invalid_insert_t (host, cpu) VALUES ('h1', 1.0);
+
+-- unknown table
+INSERT INTO no_such_table VALUES ('h1', 1000, 1.0);
+
+-- nothing must have landed from the failed statements
+SELECT count(*) FROM invalid_insert_t;
+
+DROP TABLE invalid_insert_t;

@@ -279,6 +279,68 @@ class TestLogCompaction:
             for nd in nodes:
                 nd.stop()
 
+    def test_crash_between_snapshot_and_log_write(self, tmp_path):
+        """_compact_locked persists the .snap file first, then rewrites
+        the log file with the advanced base. The `_load` overlap-drop
+        branch (replication.py: "snapshot advanced past the log file")
+        claims a crash BETWEEN those two writes is safe — this test
+        actually creates that on-disk state and proves recovery: the
+        reloaded node must drop the already-snapshotted overlap, apply
+        the tail exactly once, and serve the full pre-crash state."""
+        import shutil as _shutil
+        ids = [1, 2, 3]
+        nodes = [RaftNode(i, ids, compact_threshold=32,
+                          store_path=str(tmp_path / f"raft-{i}.json"),
+                          **FAST) for i in ids]
+        connect_local(nodes)
+        for nd in nodes:
+            nd.start()
+        stale = tmp_path / "stale-log.json"
+        try:
+            leader = leader_of(nodes)
+            kv = ReplicatedKv(leader)
+            # fill past one compaction so base > 0, then snapshot the
+            # CURRENT log file (pre-next-compaction state)
+            for i in range(100):
+                kv.put(f"k{i % 20}", f"v{i}".encode())
+            wait_for(lambda: leader.base > 0, what="first compaction")
+            lid = leader.node_id
+            log_path = tmp_path / f"raft-{lid}.json"
+            _shutil.copy(log_path, stale)
+            base_at_copy = leader.base
+            # more writes + another compaction advance base and state
+            for i in range(100, 200):
+                kv.put(f"k{i % 20}", f"v{i}".encode())
+            with leader._lock:
+                last = leader._last_index()
+            # push compaction past the last k-write so the snapshot alone
+            # (the crash-consistent part) carries the full expected state
+            j = 0
+            while leader.base < last and j < 300:
+                kv.put("filler", f"f{j}".encode())
+                j += 1
+            assert leader.base >= last, "compaction must pass the k-writes"
+            expected = {f"k{j}": f"v{180 + j}" for j in range(20)}
+            for nd in nodes:
+                nd.stop()
+            # simulate the crash: .snap is the NEW snapshot (written
+            # first), but the log file never got its post-compaction
+            # rewrite — restore the stale pre-compaction log, whose base
+            # is BELOW the snapshot's and whose tail overlaps it
+            _shutil.copy(stale, log_path)
+            revived = RaftNode(lid, ids, compact_threshold=32,
+                               store_path=str(log_path), **FAST)
+            assert revived.base > base_at_copy, \
+                "snapshot must define the base"
+            assert revived.applied_idx == revived.base
+            # overlap dropped: no log entry at or below the base survives
+            assert len(revived.log) <= 32 + 4
+            for key, val in expected.items():
+                assert revived.state.get(key) == val.encode(), key
+        finally:
+            for nd in nodes:
+                nd.stop()
+
     def test_lagging_follower_gets_snapshot_install(self):
         """A follower partitioned past the leader's compaction horizon
         rejoins via InstallSnapshot (not an index-0 replay) and

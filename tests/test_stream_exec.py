@@ -131,6 +131,125 @@ class TestStreamedMatchesCached:
         finally:
             storage.close()
 
+    def test_lean_path_engages_on_clean_bulk_region(self, tmp_path,
+                                                    monkeypatch):
+        """A bulk-loaded region (dup-free, delete-free, key-disjoint
+        files, no memtable rows) must take the zero-copy chunk-frame
+        fast path — and produce the same answers as the general merge
+        path with the lean proof disabled."""
+        rng = np.random.default_rng(11)
+        schema = Schema([
+            ColumnSchema("host", dt.STRING, nullable=False,
+                         semantic_type=SemanticType.TAG),
+            ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                         semantic_type=SemanticType.TIMESTAMP),
+            ColumnSchema("cpu", dt.FLOAT64),
+        ])
+        storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        mito = MitoEngine(storage)
+        cm = MemoryCatalogManager()
+        table = mito.create_table(CreateTableRequest(
+            "m", schema, primary_key_indices=[0]))
+        cm.register_table(CAT, SCH, "m", table)
+        engine = QueryEngine(cm)
+        try:
+            hosts = 5
+            per = 400
+            for batch_no in range(3):           # 3 time-disjoint files
+                ts = np.tile(np.arange(per, dtype=np.int64) * 100
+                             + batch_no * per * 100, hosts)
+                host = np.repeat(np.array(
+                    [f"h{i}" for i in range(hosts)]), per).astype(object)
+                table.bulk_load({"host": host, "ts": ts,
+                                 "cpu": rng.random(len(ts)).round(4)})
+            monkeypatch.setattr(stream_exec, "_STREAM_THRESHOLD_ROWS", [0])
+            monkeypatch.setattr(stream_exec, "_SLICE_ROWS", [per * hosts])
+            monkeypatch.setattr(stream_exec, "_ROW_BUCKET_MIN", 256)
+            lean_calls = []
+            orig = stream_exec._lean_chunk_frames
+
+            def spy(*a, **k):
+                r = orig(*a, **k)
+                lean_calls.append(r is not None)
+                return r
+            monkeypatch.setattr(stream_exec, "_lean_chunk_frames", spy)
+            sqls = [
+                "SELECT host, count(*), avg(cpu) FROM m GROUP BY host "
+                "ORDER BY host",
+                "SELECT host, date_bin(INTERVAL '30 seconds', ts) AS b, "
+                "min(cpu), max(cpu) FROM m GROUP BY host, b "
+                "ORDER BY host, b LIMIT 40",
+                "SELECT host, avg(cpu) FROM m WHERE ts >= 5000 AND "
+                "ts < 100000 GROUP BY host ORDER BY host",
+            ]
+            got = [rows_of(engine, s) for s in sqls]
+            assert lean_calls and all(lean_calls), \
+                "clean bulk region must take the lean chunk-frame path"
+            # same answers with the lean proof disabled (general path)
+            monkeypatch.setattr(stream_exec, "_slice_lean_proof",
+                                lambda *a, **k: (False, False, []))
+            want = [rows_of(engine, s) for s in sqls]
+            for g, w in zip(got, want):
+                approx_equal(g, w)
+        finally:
+            storage.close()
+
+    def test_first_last_across_key_disjoint_boundary_sid(self, tmp_path,
+                                                         monkeypatch):
+        """Two key-disjoint files sharing a boundary series with
+        non-monotonic time across the concat: the dedup-skip proof holds
+        (no key has two versions), but positional first/last must NOT
+        trust concat order — regression for the round-6 review find."""
+        schema = Schema([
+            ColumnSchema("host", dt.STRING, nullable=False,
+                         semantic_type=SemanticType.TAG),
+            ColumnSchema("ts", dt.TIMESTAMP_MILLISECOND, nullable=False,
+                         semantic_type=SemanticType.TIMESTAMP),
+            ColumnSchema("cpu", dt.FLOAT64),
+        ])
+        storage = StorageEngine(EngineConfig(data_home=str(tmp_path)))
+        mito = MitoEngine(storage)
+        cm = MemoryCatalogManager()
+        table = mito.create_table(CreateTableRequest(
+            "m", schema, primary_key_indices=[0]))
+        cm.register_table(CAT, SCH, "m", table)
+        engine = QueryEngine(cm)
+        try:
+            # file A: sids for h00..h10, LATE times; h10 written here
+            # first (larger ts)
+            hosts_a = [f"h{i:02d}" for i in range(11) for _ in range(4)]
+            ts_a = [5000 + 100 * j for _ in range(11) for j in range(4)]
+            table.bulk_load({"host": np.array(hosts_a, dtype=object),
+                             "ts": np.array(ts_a, dtype=np.int64),
+                             "cpu": np.array(
+                                 [float(t) for t in ts_a])})
+            # file B: sids h10..h20, EARLY times (disjoint from A's
+            # window, so the key rectangles stay disjoint)
+            hosts_b = [f"h{i:02d}" for i in range(10, 21)
+                       for _ in range(4)]
+            ts_b = [100 * j for _ in range(11) for j in range(4)]
+            table.bulk_load({"host": np.array(hosts_b, dtype=object),
+                             "ts": np.array(ts_b, dtype=np.int64),
+                             "cpu": np.array(
+                                 [float(t) for t in ts_b])})
+            monkeypatch.setattr(stream_exec, "_STREAM_THRESHOLD_ROWS", [0])
+            # one big slice spanning both files → concat path, and
+            # disable the chunk-frame reader to force the general path
+            monkeypatch.setattr(stream_exec, "_SLICE_ROWS", [100000])
+            monkeypatch.setattr(stream_exec, "_ROW_BUCKET_MIN", 256)
+            monkeypatch.setattr(stream_exec, "_lean_chunk_frames",
+                                lambda *a, **k: None)
+            rows = rows_of(engine,
+                           "SELECT host, first(cpu), last(cpu) FROM m "
+                           "WHERE host = 'h10' GROUP BY host")
+            assert len(rows) == 1
+            r = rows[0]
+            # h10's earliest row is ts=0 (file B), latest ts=5300 (file A)
+            assert r["first(cpu)"] == 0.0, r
+            assert r["last(cpu)"] == 5300.0, r
+        finally:
+            storage.close()
+
     def test_streaming_actually_streams(self, tmp_path, monkeypatch):
         storage, engine, table, region = make_world(tmp_path)
         try:
