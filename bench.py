@@ -138,7 +138,28 @@ def bench_cold_e2e(n_rows: int):
             t0 = time.perf_counter()
             fe.do_query(sql, ctx)
             dt = min(dt, time.perf_counter() - t0)
-        return n / dt                      # rows/sec
+        # stage breakdown of the final run: the scan profiler +
+        # ExecStats collector (so BENCH rounds capture where the time
+        # went, not just the headline rate — ISSUE 2 satellite)
+        region = next(iter(table.regions.values()))
+        sp = region.last_scan_profile
+        st = fe.query_engine.last_exec_stats
+        profile = {
+            "scan_profile": None if sp is None else {
+                "path": sp.path, "rows": sp.rows,
+                "total_s": round(sp.total_s, 4),
+                "stages": {k: round(v, 4)
+                           for k, v in sp.stages.items()},
+                "counters": sp.counters,
+            },
+            "exec_stats": None if st is None else {
+                "dispatch": st.dispatch,
+                "stages": {s.stage: {"rows": s.rows, "files": s.files,
+                                     "ms": round(s.elapsed_s * 1e3, 2)}
+                           for s in st.stages.values()},
+            },
+        }
+        return n / dt, profile             # rows/sec + stage breakdown
     finally:
         # the streaming threshold is process-global: restore it so any
         # metric added after this one measures the normal dispatch, and
@@ -172,12 +193,17 @@ def main():
     }))
 
     cold_rows = int(os.environ.get("GREPTIME_BENCH_COLD_ROWS", 4_000_000))
-    cold_rps = bench_cold_e2e(cold_rows)
+    cold_rps, cold_profile = bench_cold_e2e(cold_rows)
     print(json.dumps({
         "metric": "cold_single_groupby_e2e_throughput",
         "value": round(cold_rps / 1e6, 2),
         "unit": "Mrows/s",
         "rows": cold_rows,
+    }))
+    print(json.dumps({
+        "metric": "cold_scan_stage_profile",
+        "unit": "json",
+        **cold_profile,
     }))
 
 

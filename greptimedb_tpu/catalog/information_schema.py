@@ -7,6 +7,11 @@ tables are materialized from live catalog state at scan time:
 
 - information_schema.tables  — one row per registered table
 - information_schema.columns — one row per column of every table
+- information_schema.runtime_metrics — every sample the prometheus
+  registry would export on /metrics (same counters, same values), plus
+  live engine gauges (region/memtable/SST state, scan-cache residency,
+  object-store read-cache hit ratio) — so metrics are queryable over
+  SQL exactly like the /metrics endpoint.
 """
 
 from __future__ import annotations
@@ -39,6 +44,76 @@ _COLUMNS_SCHEMA = Schema([
     ColumnSchema("semantic_type", dt.STRING),
     ColumnSchema("is_nullable", dt.STRING),
 ])
+
+_RUNTIME_METRICS_SCHEMA = Schema([
+    ColumnSchema("metric_name", dt.STRING),
+    ColumnSchema("labels", dt.STRING),
+    ColumnSchema("value", dt.FLOAT64),
+    ColumnSchema("kind", dt.STRING),
+])
+
+
+def _engine_gauges(catalog_manager, catalog_name: str):
+    """Live engine state as gauge samples: per-region storage facts plus
+    process-wide cache gauges. These exist even before any metric has
+    been observed, so `SELECT ... WHERE metric_name = 'greptime_...'`
+    over a fresh server is deterministic (the sqlness golden relies on
+    that)."""
+    rows = []          # (name, labels, value, kind)
+    region_count = 0
+    for schema_name in catalog_manager.schema_names(catalog_name):
+        for tname in catalog_manager.table_names(catalog_name,
+                                                 schema_name):
+            t = catalog_manager.table(catalog_name, schema_name, tname)
+            regions = getattr(t, "regions", None)
+            if not regions:
+                continue
+            for rnum, region in sorted(regions.items()):
+                region_count += 1
+                vc = getattr(region, "version_control", None)
+                if vc is None:
+                    continue
+                v = vc.current
+                labels = (f'{{region="{rnum}", schema="{schema_name}", '
+                          f'table="{tname}"}}')
+                mt_rows = sum(m.num_rows
+                              for m in v.memtables.all_memtables())
+                files = list(v.ssts.all_files())
+                rows.append(("greptime_region_memtable_rows", labels,
+                             float(mt_rows), "gauge"))
+                rows.append(("greptime_region_sst_files", labels,
+                             float(len(files)), "gauge"))
+                rows.append(("greptime_region_sst_rows", labels,
+                             float(sum(f.num_rows for f in files)),
+                             "gauge"))
+    rows.append(("greptime_region_count", "", float(region_count),
+                 "gauge"))
+    from ..query.tpu_exec import SCAN_CACHE
+    rows.append(("greptime_scan_cache_resident_bytes", "",
+                 float(SCAN_CACHE.resident_bytes()), "gauge"))
+    store = getattr(catalog_manager, "store", None)
+    hit_ratio = getattr(store, "hit_ratio", None)
+    if callable(hit_ratio):
+        rows.append(("greptime_read_cache_hit_ratio", "",
+                     float(hit_ratio()), "gauge"))
+    return rows
+
+
+def _prometheus_samples():
+    """Every sample the /metrics endpoint would render, via the same
+    default registry prometheus_client.generate_latest reads."""
+    try:
+        from prometheus_client import REGISTRY
+    except ImportError:  # pragma: no cover — prometheus is baked in
+        return []
+    rows = []
+    for family in REGISTRY.collect():
+        for s in family.samples:
+            labels = "{" + ", ".join(
+                f'{k}="{v}"' for k, v in sorted(s.labels.items())) + "}" \
+                if s.labels else ""
+            rows.append((s.name, labels, float(s.value), family.type))
+    return rows
 
 
 class _VirtualTable(Table):
@@ -113,4 +188,17 @@ def information_schema_table(catalog_manager, catalog_name: str,
                             "YES" if cs.nullable else "NO")
             return rows
         return _VirtualTable("columns", _COLUMNS_SCHEMA, build_columns)
+    if name == "runtime_metrics":
+        def build_metrics():
+            samples = _prometheus_samples() + \
+                _engine_gauges(catalog_manager, catalog_name)
+            samples.sort(key=lambda r: (r[0], r[1]))
+            return {
+                "metric_name": [r[0] for r in samples],
+                "labels": [r[1] for r in samples],
+                "value": [r[2] for r in samples],
+                "kind": [r[3] for r in samples],
+            }
+        return _VirtualTable("runtime_metrics", _RUNTIME_METRICS_SCHEMA,
+                             build_metrics)
     return None

@@ -1,0 +1,185 @@
+"""Per-query execution statistics: the machinery behind EXPLAIN ANALYZE.
+
+Reference behavior: DataFusion's `ExecutionPlan::metrics()` — every
+physical operator accumulates row counts and elapsed time, and
+`EXPLAIN ANALYZE` renders the annotated plan (the reference surfaces it
+through src/query's DataFusion integration). Here an `ExecStats`
+collector rides a thread-local during execution; each layer records its
+stage with the SAME stage names the storage profilers use
+(`Region.last_ingest_profile` / `Region.last_scan_profile`), so traces,
+metrics, EXPLAIN ANALYZE and the profilers all tell one story.
+
+Stage vocabulary (shared with the scan/ingest profilers):
+
+- dispatch decision: ``cpu-small-scan`` / ``cpu-fallback`` /
+  ``device-resident`` / ``streamed-cold`` / ``aggregate-pushdown``
+- streamed scan: ``plan``, ``decode_reduce``, ``device_fetch``,
+  ``fold`` (+ counters lean_slices / merged_slices / dedup_skip_slices)
+- resident scan: ``scan_prep``, ``reduce``
+- CPU fallback: ``scan``, ``filter``, ``aggregate``, ``project``
+- shared tail: ``finalize``
+
+The collector is installed per top-level query (`collect()`), is
+thread-safe (streamed slices report from pool workers), and a missing
+collector makes every record call a no-op, so hot paths pay only a
+thread-local read when nobody is watching.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+_tls = threading.local()
+
+
+@dataclass
+class StageStat:
+    stage: str
+    rows: int = 0
+    files: int = 0
+    elapsed_s: float = 0.0
+    detail: Dict[str, object] = field(default_factory=dict)
+
+    def detail_str(self) -> str:
+        return ", ".join(f"{k}={v}" for k, v in self.detail.items())
+
+
+class ExecStats:
+    """Accumulates per-stage counters for one statement execution."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stages: "OrderedDict[str, StageStat]" = OrderedDict()
+        self.dispatch: Optional[str] = None
+        self.total_s: float = 0.0
+
+    # ---- recording ----
+    def record(self, stage: str, *, rows: int = 0, files: int = 0,
+               elapsed_s: float = 0.0, **detail) -> None:
+        with self._lock:
+            st = self.stages.get(stage)
+            if st is None:
+                st = self.stages[stage] = StageStat(stage)
+            st.rows += int(rows)
+            st.files += int(files)
+            st.elapsed_s += float(elapsed_s)
+            for k, v in detail.items():
+                old = st.detail.get(k)
+                # numeric details accumulate across regions/slices so a
+                # multi-region query reports totals, not the last region
+                if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                        and isinstance(old, (int, float)) \
+                        and not isinstance(old, bool):
+                    st.detail[k] = old + v
+                else:
+                    st.detail[k] = v
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **detail) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, elapsed_s=time.perf_counter() - t0, **detail)
+
+    def set_dispatch(self, decision: str) -> None:
+        """First decision wins: nested subqueries must not overwrite the
+        top-level statement's dispatch line."""
+        with self._lock:
+            if self.dispatch is None:
+                self.dispatch = decision
+
+    # ---- rendering ----
+    def summary(self) -> str:
+        """One-line digest for the slow-query log."""
+        with self._lock:
+            parts = [f"dispatch={self.dispatch or 'n/a'}"]
+            for st in self.stages.values():
+                bit = f"{st.stage}={st.elapsed_s * 1e3:.1f}ms"
+                if st.rows:
+                    bit += f"/{st.rows}r"
+                parts.append(bit)
+            parts.append(f"total={self.total_s * 1e3:.1f}ms")
+        return " ".join(parts)
+
+    def rows_table(self) -> Dict[str, List]:
+        """Column dict for the EXPLAIN ANALYZE per-stage batch."""
+        cols: Dict[str, List] = {"stage": [], "rows": [], "files": [],
+                                 "elapsed_ms": [], "detail": []}
+
+        def add(stage, rows, files, elapsed_ms, detail):
+            cols["stage"].append(stage)
+            cols["rows"].append(int(rows))
+            cols["files"].append(int(files))
+            cols["elapsed_ms"].append(float(elapsed_ms))
+            cols["detail"].append(detail)
+
+        with self._lock:
+            add("dispatch", 0, 0, 0.0, self.dispatch or "n/a")
+            for st in self.stages.values():
+                add(st.stage, st.rows, st.files, st.elapsed_s * 1e3,
+                    st.detail_str())
+            add("total", 0, 0, self.total_s * 1e3, "")
+        return cols
+
+
+# ---------------------------------------------------------------------------
+# thread-local collector plumbing
+# ---------------------------------------------------------------------------
+
+def current() -> Optional[ExecStats]:
+    return getattr(_tls, "stats", None)
+
+
+@contextlib.contextmanager
+def collect(stats: Optional[ExecStats] = None) -> Iterator[ExecStats]:
+    """Install a collector for the duration of one statement."""
+    prev = getattr(_tls, "stats", None)
+    s = stats if stats is not None else ExecStats()
+    _tls.stats = s
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        s.total_s += time.perf_counter() - t0
+        _tls.stats = prev
+
+
+@contextlib.contextmanager
+def collect_into(stats: Optional[ExecStats]) -> Iterator[None]:
+    """Install an EXISTING collector (possibly None) on this thread — no
+    timing, no creation. Used by telemetry.propagate to carry the
+    query's collector into pool workers."""
+    prev = getattr(_tls, "stats", None)
+    _tls.stats = stats
+    try:
+        yield
+    finally:
+        _tls.stats = prev
+
+
+def record(stage: str, **kwargs) -> None:
+    s = current()
+    if s is not None:
+        s.record(stage, **kwargs)
+
+
+def set_dispatch(decision: str) -> None:
+    s = current()
+    if s is not None:
+        s.set_dispatch(decision)
+
+
+@contextlib.contextmanager
+def stage(name: str, **detail) -> Iterator[None]:
+    s = current()
+    if s is None:
+        yield
+        return
+    with s.stage(name, **detail):
+        yield

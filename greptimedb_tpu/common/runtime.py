@@ -50,15 +50,18 @@ def write_runtime() -> concurrent.futures.ThreadPoolExecutor:
 
 
 def spawn_bg(fn: Callable, *args, **kwargs):
-    return bg_runtime().submit(fn, *args, **kwargs)
+    from .telemetry import propagate
+    return bg_runtime().submit(propagate(fn), *args, **kwargs)
 
 
 def spawn_read(fn: Callable, *args, **kwargs):
-    return read_runtime().submit(fn, *args, **kwargs)
+    from .telemetry import propagate
+    return read_runtime().submit(propagate(fn), *args, **kwargs)
 
 
 def spawn_write(fn: Callable, *args, **kwargs):
-    return write_runtime().submit(fn, *args, **kwargs)
+    from .telemetry import propagate
+    return write_runtime().submit(propagate(fn), *args, **kwargs)
 
 
 def shutdown_runtimes(wait: bool = True) -> None:
@@ -78,6 +81,8 @@ def parallel_map(fn: Callable, items, *, max_workers: int = 8) -> list:
     if len(items) <= 1:
         return [fn(x) for x in items]
     from concurrent.futures import ThreadPoolExecutor
+    from .telemetry import propagate
+    fn = propagate(fn)       # workers stay parented to the caller's trace
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
         return list(p.map(fn, items))
 
@@ -91,5 +96,7 @@ def parallel_imap(fn: Callable, items, *, max_workers: int = 8):
             yield fn(x)
         return
     from concurrent.futures import ThreadPoolExecutor
+    from .telemetry import propagate
+    fn = propagate(fn)
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
         yield from p.map(fn, items)

@@ -9,8 +9,13 @@ Python twin:
 - `span(name, **attrs)` — nested tracing spans carried in a thread-local
   (trace_id/span_id/parent), logged on exit with duration; the active
   trace context rides log records via a logging.Filter.
+- `propagate(fn)` — capture the caller's span stack at submit time and
+  re-install it around `fn` in whatever worker thread runs it, so spans
+  opened on the `common/runtime` pools stay parented to the trace.
 - `timer(name)` — histogram observation (prometheus_client, the same
   registry the /metrics endpoint exports).
+- `slow_query_threshold_ms()` — the SET/env-configurable threshold the
+  frontend checks per statement (None = slow-query log off).
 - `install_panic_hook()` — top-level excepthook that logs crashes.
 """
 
@@ -116,6 +121,76 @@ def span(name: str, **attrs) -> Iterator[Dict]:
         exporter = _OTLP[0]
         if exporter is not None:
             exporter.enqueue(s, int(elapsed_ms * 1e6))
+
+
+def propagate(fn):
+    """Capture the calling thread's span stack NOW and return a callable
+    that re-installs it around `fn` wherever it runs.
+
+    `_tls.spans` is thread-local, so a stage submitted to a worker pool
+    detaches from its parent trace: spans it opens start a fresh
+    trace_id and the OTLP export shows them orphaned. Wrapping the
+    submitted callable fixes that — the capture happens at submit (the
+    moment the parent span is live), not at execution. The parent span
+    dicts are shared read-only; the worker appends to its own list, so
+    concurrent workers never see each other's nesting.
+
+    The active ExecStats collector (common/exec_stats.py) rides along
+    for the same reason: per-stage EXPLAIN ANALYZE counters recorded by
+    pool workers (SST reads, slice decodes) land on the query's
+    collector instead of vanishing. ExecStats methods are lock-guarded,
+    so concurrent workers may share one collector."""
+    from . import exec_stats as _es
+    stack = getattr(_tls, "spans", None)
+    stats = _es.current()
+    if not stack and stats is None:
+        return fn
+    captured = list(stack) if stack else []
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        prev = getattr(_tls, "spans", None)
+        _tls.spans = list(captured)
+        with _es.collect_into(stats):
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _tls.spans = prev if prev is not None else []
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# slow-query log threshold (reference: the slow-query timer in
+# src/common/telemetry logging options — statements slower than the
+# threshold log at WARN with their trace id and stage stats)
+# ---------------------------------------------------------------------------
+
+def _env_slow_query_ms() -> Optional[int]:
+    raw = os.environ.get("GREPTIME_SLOW_QUERY_MS")
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+_SLOW_QUERY_MS: list = [_env_slow_query_ms()]
+
+
+def slow_query_threshold_ms() -> Optional[int]:
+    """Current slow-query threshold in ms; None = disabled (default,
+    unless the GREPTIME_SLOW_QUERY_MS env/config set one)."""
+    return _SLOW_QUERY_MS[0]
+
+
+def set_slow_query_threshold_ms(value: Optional[int]) -> None:
+    """SET slow_query_threshold_ms — 0 or negative disables."""
+    if value is not None and value <= 0:
+        value = None
+    _SLOW_QUERY_MS[0] = value
 
 
 # ---------------------------------------------------------------------------
@@ -228,10 +303,27 @@ def configure_otlp(endpoint: Optional[str],
 _metrics_lock = threading.Lock()
 _histograms: Dict[str, object] = {}
 _counters: Dict[str, object] = {}
+#: sanitized key → the original name that claimed it. Distinct originals
+#: sanitizing to one key ("a.b" and "a-b" → "a_b") used to silently share
+#: one time series; now the newcomer is deterministically disambiguated
+#: (crc suffix) and the collision is logged.
+_sanitized_owners: Dict[str, str] = {}
 
 
 def _sanitize(name: str) -> str:
-    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    key = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    owner = _sanitized_owners.setdefault(key, name)
+    if owner != name:
+        import zlib
+        crc = zlib.crc32(name.encode()) & 0xFFFF
+        key2 = f"{key}_x{crc:04x}"
+        if key2 not in _sanitized_owners:
+            _sanitized_owners[key2] = name
+            logger.error(
+                "metric name collision: %r and %r both sanitize to %r; "
+                "recording %r as %r instead", owner, name, key, name, key2)
+        return key2
+    return key
 
 
 def _observe(name: str, seconds: float) -> None:

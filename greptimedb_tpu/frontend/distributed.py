@@ -454,11 +454,34 @@ class DistInstance:
 
     # ---- SQL ----
     def do_query(self, sql: str, ctx: Optional[QueryContext] = None):
+        import time as _time
+
+        from ..common.telemetry import (
+            increment_counter, slow_query_threshold_ms, span, timer)
         from ..sql import parse_statements
         ctx = ctx or QueryContext()
         outs = []
         for stmt in parse_statements(sql):
-            outs.append(self.execute_stmt(stmt, ctx))
+            t0 = _time.perf_counter()
+            prev_stats = getattr(self.query_engine, "last_exec_stats",
+                                 None)
+            with span("execute_stmt", stmt=type(stmt).__name__,
+                      distributed=True) as sp, timer("stmt_execute"):
+                outs.append(self.execute_stmt(stmt, ctx))
+            increment_counter(f"stmt_{type(stmt).__name__.lower()}")
+            elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            thr = slow_query_threshold_ms()
+            if thr is not None and elapsed_ms >= thr:
+                stats = getattr(self.query_engine, "last_exec_stats",
+                                None)
+                if stats is prev_stats:     # not this statement's stats
+                    stats = None
+                import logging
+                logging.getLogger("greptimedb_tpu.slow_query").warning(
+                    "slow query: %.1fms (threshold %dms) trace=%s "
+                    "stmt=%r stats=[%s]", elapsed_ms, thr,
+                    sp["trace_id"], sql,
+                    stats.summary() if stats is not None else "n/a")
         return outs
 
     def execute_stmt(self, stmt, ctx: QueryContext):

@@ -26,6 +26,11 @@ from .statement import StatementExecutor
 GREPTIME_TIMESTAMP = "greptime_timestamp"
 GREPTIME_VALUE = "greptime_value"
 
+#: dedicated logger so operators can route/filter the slow-query log
+#: independently (reference: the slow_query appender in common-telemetry)
+import logging
+_slow_logger = logging.getLogger("greptimedb_tpu.slow_query")
+
 
 class FrontendInstance:
     def __init__(self, datanode: DatanodeInstance):
@@ -62,14 +67,37 @@ class FrontendInstance:
         stmts = parse_statements(sql)
         if interceptor is not None:
             stmts = interceptor.post_parsing(stmts, ctx)
-        from ..common.telemetry import span
+        import time as _time
+
+        from ..common.telemetry import (
+            increment_counter, slow_query_threshold_ms, span, timer)
         outputs = []
         for s in stmts:
             if interceptor is not None:
                 interceptor.pre_execute(s, ctx)
+            t0 = _time.perf_counter()
+            prev_stats = getattr(self.query_engine, "last_exec_stats",
+                                 None)
             with span("execute_stmt", stmt=type(s).__name__,
-                      channel=ctx.channel.value):
+                      channel=ctx.channel.value) as sp, \
+                    timer("stmt_execute"):
                 out = self.execute_stmt(s, ctx)
+            increment_counter(f"stmt_{type(s).__name__.lower()}")
+            elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            thr = slow_query_threshold_ms()
+            if thr is not None and elapsed_ms >= thr:
+                # only attach ExecStats THIS statement produced — a slow
+                # DDL/DML or plain EXPLAIN (which never collects) must
+                # not report the previous SELECT's stages
+                stats = getattr(self.query_engine, "last_exec_stats",
+                                None)
+                if stats is prev_stats:
+                    stats = None
+                _slow_logger.warning(
+                    "slow query: %.1fms (threshold %dms) trace=%s "
+                    "stmt=%r stats=[%s]", elapsed_ms, thr,
+                    sp["trace_id"], sql,
+                    stats.summary() if stats is not None else "n/a")
             if interceptor is not None:
                 out = interceptor.post_execute(out, ctx)
             outputs.append(out)

@@ -314,6 +314,17 @@ class StatementExecutor:
         name = stmt.name.lower()
         if name in ("time_zone", "timezone"):
             ctx.time_zone = str(stmt.value)
+        elif name == "slow_query_threshold_ms":
+            try:
+                value = int(stmt.value)
+            except (TypeError, ValueError):
+                raise InvalidArgumentsError(
+                    f"SET {stmt.name}: expected an integer, "
+                    f"got {stmt.value!r}")
+            # 0 or negative disables; default comes from the
+            # GREPTIME_SLOW_QUERY_MS env/config (off when unset)
+            from ..common.telemetry import set_slow_query_threshold_ms
+            set_slow_query_threshold_ms(value)
         elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
             try:
                 value = int(stmt.value)
@@ -329,9 +340,15 @@ class StatementExecutor:
                 configure_streaming(threshold_rows=value)
             else:
                 # static device-dispatch floor (the latency-adaptive
-                # floor never goes below it)
+                # floor never goes below it). Pinning it also resets the
+                # adaptive observation: an operator setting the floor
+                # expects it to take effect now, not to stay shadowed by
+                # the fixed-cost estimate of earlier queries — and the
+                # sqlness EXPLAIN ANALYZE goldens rely on the reset for
+                # deterministic dispatch lines.
                 from ..query import tpu_exec
                 tpu_exec.TPU_DISPATCH_MIN_ROWS = value
+                tpu_exec._observed_min_dt[0] = None
         return Output.rows(0)
 
     # ---- COPY ----

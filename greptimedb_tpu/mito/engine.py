@@ -149,7 +149,13 @@ class MitoTable(Table):
         WAL+memtable write path (Region.bulk_ingest)."""
         if not columns:
             return 0
+        from ..common.telemetry import span
         num_rows = len(next(iter(columns.values())))
+        with span("bulk_load", table=self.info.name, rows=num_rows):
+            return self._bulk_load_inner(columns, num_rows)
+
+    def _bulk_load_inner(self, columns: Dict[str, Sequence],
+                         num_rows: int) -> int:
         for name, vals in columns.items():
             if len(vals) != num_rows:
                 raise InvalidArgumentsError(

@@ -14,6 +14,7 @@ import numpy as np
 import pandas as pd
 
 from ..catalog import CatalogManager
+from ..common import exec_stats
 from ..common.time import TimeUnit
 from ..datatypes import data_type as dt
 from ..datatypes.data_type import parse_type_name
@@ -41,6 +42,10 @@ class QueryEngine:
 
     def __init__(self, catalog: CatalogManager):
         self.catalog = catalog
+        #: ExecStats of the most recent top-level query this thread ran —
+        #: the slow-query log and /status read it (diagnostic only; a
+        #: concurrent server sees the latest finished query's stats)
+        self.last_exec_stats: Optional[exec_stats.ExecStats] = None
 
     # ---- dispatch ----
     def execute(self, stmt: Statement, ctx: Optional[QueryContext] = None
@@ -109,27 +114,13 @@ class QueryEngine:
                         f"  Dispatch: cpu-small-scan (est_rows={est} < "
                         f"dispatch_floor={tpu_exec.TPU_DISPATCH_MIN_ROWS})")
                 else:
-                    # mirror execution exactly: region_moment_frames
-                    # decides per REGION, on rows OR decoded-bytes vs
-                    # the scan-cache budget (region_streams_cold)
-                    from .stream_exec import stream_threshold_rows
-                    regions = list(getattr(table, "regions", {}).values())
-                    n_stream = sum(
-                        1 for r in regions
-                        if tpu_exec.region_streams_cold(r))
+                    # mirror execution exactly: the decision string is
+                    # built by the same helper region_moment_frames
+                    # records into ExecStats (per-REGION decision, on
+                    # rows OR decoded-bytes vs the scan-cache budget)
                     lines.append("TpuAggregateExec: " + plan.describe())
-                    if n_stream == 0:
-                        lines.append(
-                            "  Dispatch: device-resident (scan cache)")
-                    elif n_stream == len(regions):
-                        lines.append(
-                            f"  Dispatch: streamed-cold (est_rows={est}, "
-                            f"stream_threshold_rows="
-                            f"{stream_threshold_rows()})")
-                    else:
-                        lines.append(
-                            f"  Dispatch: mixed ({n_stream}/"
-                            f"{len(regions)} regions streamed-cold)")
+                    lines.append("  Dispatch: " +
+                                 tpu_exec.local_dispatch_decision(table))
             elif a.is_aggregate:
                 lines.append("CpuAggregateExec: groups=" + ", ".join(
                     expr_name(g) for g in a.group_exprs))
@@ -141,21 +132,61 @@ class QueryEngine:
                 lines.append(f"  TableScan: {table.name}")
         else:
             lines.append(type(inner).__name__)
+        if stmt.analyze:
+            return self._explain_analyze(inner, lines, ctx)
         schema = Schema([ColumnSchema("plan_type", dt.STRING),
                          ColumnSchema("plan", dt.STRING)])
         rb = RecordBatch.from_pydict(schema, {
             "plan_type": ["logical_plan"], "plan": ["\n".join(lines)]})
-        if stmt.analyze:
-            out = self.execute_query(inner, ctx) \
-                if isinstance(inner, Query) else None
-            rows = out.num_rows if out else 0
-            rb = RecordBatch.from_pydict(schema, {
-                "plan_type": ["logical_plan", "analyze"],
-                "plan": ["\n".join(lines), f"rows: {rows}"]})
         return Output.record_batches([rb])
+
+    def _explain_analyze(self, inner, plan_lines: List[str],
+                         ctx: QueryContext) -> Output:
+        """EXPLAIN ANALYZE: actually execute the statement under an
+        ExecStats collector and render the per-stage breakdown — stage,
+        rows, files, elapsed ms, and the path facts (dispatch decision,
+        lean/dedup-skip vs merged slices, cache hit) under the same
+        stage names the storage profilers use, so this table, the
+        tracing spans and Region.last_scan_profile agree (reference:
+        DataFusion's EXPLAIN ANALYZE over operator metrics)."""
+        stats = exec_stats.ExecStats()
+        out_rows = 0
+        with exec_stats.collect(stats):
+            if isinstance(inner, Query):
+                out = self._execute_query_inner(inner, ctx)
+                out_rows = out.num_rows or 0
+        self.last_exec_stats = stats
+        cols = stats.rows_table()
+        # lead with the plan so the dispatch line stays next to the plan
+        # shape it annotates
+        cols["stage"].insert(0, "plan")
+        cols["rows"].insert(0, out_rows)
+        cols["files"].insert(0, 0)
+        cols["elapsed_ms"].insert(0, 0.0)
+        cols["detail"].insert(0, "\n".join(plan_lines))
+        schema = Schema([ColumnSchema("stage", dt.STRING),
+                         ColumnSchema("rows", dt.INT64),
+                         ColumnSchema("files", dt.INT64),
+                         ColumnSchema("elapsed_ms", dt.FLOAT64),
+                         ColumnSchema("detail", dt.STRING)])
+        rb = RecordBatch.from_pydict(schema, cols)
+        return Output.record_batches([rb], schema)
 
     # ---- SELECT ----
     def execute_query(self, query: Query, ctx: QueryContext) -> Output:
+        """Top-level entry installs an ExecStats collector (nested calls —
+        subqueries, UNION arms, join sides — record into the active one),
+        so every statement leaves a per-stage breakdown behind for the
+        slow-query log and EXPLAIN ANALYZE."""
+        if exec_stats.current() is not None:
+            return self._execute_query_inner(query, ctx)
+        with exec_stats.collect() as st:
+            out = self._execute_query_inner(query, ctx)
+        self.last_exec_stats = st
+        return out
+
+    def _execute_query_inner(self, query: Query, ctx: QueryContext
+                             ) -> Output:
         if isinstance(query, SetQuery):     # e.g. a UNION-bodied CTE /
             return self.execute_set_query(query, ctx)  # derived table
         self._rewrite_query_subqueries(query, ctx)
@@ -187,29 +218,50 @@ class QueryEngine:
         # CPU fallback: the per-version cached frame when the table is
         # region-backed (repeat queries skip scan+convert entirely),
         # else scan the needed columns
-        df = None
-        try:
-            df = tpu_exec.cached_table_frame(table)
-        except Exception:  # noqa: BLE001 — cache is an optimization
+        exec_stats.set_dispatch("cpu-fallback")
+        cached = True
+        with exec_stats.stage("scan"):
             df = None
-        if df is None:
-            needed = None
-            if a.column_refs and not self._needs_all(a, query):
-                refs = set(a.column_refs)
-                if any(c.op in ("first", "last") for c in a.agg_calls):
-                    # _aggregate sorts by the time index so first/last
-                    # are time-ordered — keep it in the projection even
-                    # when the query doesn't reference it
-                    tc = table.schema.timestamp_column
-                    if tc is not None:
-                        refs.add(tc.name)
-                needed = [c for c in table.schema.names() if c in refs]
-            batches = table.scan_batches(projection=needed)
-            df = _batches_to_df(batches)
+            try:
+                df = tpu_exec.cached_table_frame(table)
+            except Exception:  # noqa: BLE001 — cache is an optimization
+                df = None
+            if df is None:
+                cached = False
+                needed = None
+                if a.column_refs and not self._needs_all(a, query):
+                    refs = set(a.column_refs)
+                    if any(c.op in ("first", "last")
+                           for c in a.agg_calls):
+                        # _aggregate sorts by the time index so
+                        # first/last are time-ordered — keep it in the
+                        # projection even when the query doesn't
+                        # reference it
+                        tc = table.schema.timestamp_column
+                        if tc is not None:
+                            refs.add(tc.name)
+                    needed = [c for c in table.schema.names()
+                              if c in refs]
+                batches = table.scan_batches(projection=needed)
+                df = _batches_to_df(batches)
+        exec_stats.record("scan", rows=len(df), cached=cached)
         return self._run_on_frame(df, a, query, table)
 
     # ---- UNION [ALL] ----
     def execute_set_query(self, sq: SetQuery, ctx: QueryContext) -> Output:
+        """Same collector discipline as execute_query: a top-level UNION
+        installs one ExecStats for the whole statement so both arms
+        record into it (each arm alone would otherwise overwrite
+        last_exec_stats with a partial view)."""
+        if exec_stats.current() is not None:
+            return self._execute_set_query_inner(sq, ctx)
+        with exec_stats.collect() as st:
+            out = self._execute_set_query_inner(sq, ctx)
+        self.last_exec_stats = st
+        return out
+
+    def _execute_set_query_inner(self, sq: SetQuery, ctx: QueryContext
+                                 ) -> Output:
         left = self.execute(sq.left, ctx)
         right = self.execute(sq.right, ctx)
         if not (left.is_batches and right.is_batches):
@@ -558,14 +610,19 @@ class QueryEngine:
     def _run_on_frame(self, df: pd.DataFrame, a: Analysis, query: Query,
                       table: Optional[Table]) -> Output:
         if query.where is not None:
-            ev = Evaluator(df)
-            mask = ev.eval(query.where)
-            if not isinstance(mask, pd.Series):
-                mask = pd.Series([bool(mask)] * len(df), index=df.index)
-            df = df[mask.fillna(False).astype(bool)]
+            with exec_stats.stage("filter", rows_in=len(df)):
+                ev = Evaluator(df)
+                mask = ev.eval(query.where)
+                if not isinstance(mask, pd.Series):
+                    mask = pd.Series([bool(mask)] * len(df),
+                                     index=df.index)
+                df = df[mask.fillna(False).astype(bool)]
+            exec_stats.record("filter", rows=len(df))
 
         if a.is_aggregate:
-            grouped = self._aggregate(df, a, table)
+            with exec_stats.stage("aggregate", rows_in=len(df)):
+                grouped = self._aggregate(df, a, table)
+            exec_stats.record("aggregate", rows=len(grouped))
             return self._finish_aggregate_frame(grouped, a, query, table)
 
         return self._project_and_finish(df, a, query, table)
@@ -812,6 +869,7 @@ class QueryEngine:
             proj = proj.iloc[:query.limit]
 
         schema = _infer_schema(proj, table, source_cols, dtype_overrides)
+        exec_stats.record("project", rows=len(proj))
         return Output.record_batches([_df_to_batch(proj, schema)], schema)
 
 

@@ -396,11 +396,18 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
     `files` is the list _slice_lean_proof certified for this slice —
     the single source of truth for what belongs to it.
 
-    Returns a list of frames, or None when any precondition fails and
-    the caller must take the general scan path."""
+    Returns (frames, rows_read), or None when any precondition fails
+    and the caller must take the general scan path."""
+    import time as _time
+
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from ..common import exec_stats
+
+    _t0 = _time.perf_counter()
+    _rows_read = 0
+    _reduce_s = 0.0
     schema = snap._version.schema
     ts_name = schema.timestamp_column.name
     if dim == "series":
@@ -438,15 +445,23 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
                 nb = batch.num_rows
                 if nb == 0:
                     continue
+                _rows_read += nb
                 data = _lean_batch(batch, schema, needed_fields,
                                    want_types, ts_name, need_ts, nb)
                 if data is None:
                     return None
+                _tr = _time.perf_counter()
                 f = _host_partial_frame(data, None, plan, sd,
                                         sid_keys=sid_keys)
+                _reduce_s += _time.perf_counter() - _tr
                 if f is not None and len(f):
                     frames.append(f)
-    return frames
+    # the lean reader bypasses read_sst, so it reports its own decode
+    # stats (same stage names, so EXPLAIN ANALYZE sees one decode line)
+    exec_stats.record("decode", rows=_rows_read, files=len(files),
+                      elapsed_s=_time.perf_counter() - _t0 - _reduce_s)
+    exec_stats.record("reduce", rows=_rows_read, elapsed_s=_reduce_s)
+    return frames, _rows_read
 
 
 def _lean_batch(batch, schema, needed_fields, want_types, ts_name: str,
@@ -739,8 +754,15 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
                 plan=None, reduce: str = "device",
                 sid_keys: bool = False):
     """Read + merge + dedup one slice; reduce it on the host (returning
-    a partial moment frame) or prepare it for the device kernel
+    partial moment frames) or prepare it for the device kernel
     (returning a padded transient MergedScan).
+
+    Returns None for an empty slice, else a tagged
+    ``(kind, payload, info)`` tuple — kind "frames" (lean chunk-frame
+    path), "frame" (host-reduced general path) or "scan" (device
+    MergedScan) — where `info` carries the per-slice facts the
+    coordinator folds into ExecStats and Region.last_scan_profile
+    (rows, lean_slices / merged_slices / dedup_skip_slices).
 
     `dim` selects the partition axis: "time" slices [lo, hi) on the time
     index, "series" on __series_id (with the query's time filter still
@@ -764,12 +786,15 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
     if skip_dedup:
         need_ts = _plan_needs_ts(plan) or not covered
         if covered:
-            frames = _lean_chunk_frames(
+            lean = _lean_chunk_frames(
                 snap, snap._region.access_layer, lean_files, dim, lo, hi,
                 needed_fields, plan, series_dict, need_ts,
                 sid_keys=sid_keys)
-            if frames is not None:
-                return ("frames", frames)
+            if lean is not None:
+                frames, rows_read = lean
+                return ("frames", frames,
+                        {"rows": rows_read, "lean_slices": 1,
+                         "dedup_skip_slices": 1})
     if dim == "series":
         data = snap.scan(projection=needed_fields, series_range=(lo, hi),
                          time_range=time_range, synthetic_seq=True,
@@ -792,10 +817,13 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
         getattr(m, "op", None) in ("first", "last")
         for m in plan.moments if m.column is not None)
     kept = None if (skip_dedup and not positional) else _slice_dedup(data)
+    info = {"rows": data.num_rows,
+            "merged_slices": 0 if skip_dedup else 1,
+            "dedup_skip_slices": int(skip_dedup)}
     if reduce == "host":
         return ("frame",
                 _host_partial_frame(data, kept, plan, series_dict,
-                                    sid_keys=sid_keys))
+                                    sid_keys=sid_keys), info)
     n = data.num_rows if kept is None else len(kept)
     if n == 0:
         return None
@@ -863,7 +891,7 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
             scan.device["__pad_mask"] = jax.device_put(pm)
     except Exception:  # noqa: BLE001 — staging is an optimization
         scan.device.clear()
-    return scan
+    return ("scan", scan, info)
 
 
 def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
@@ -878,21 +906,37 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     end (per-slice fetches would each pay the device-link latency, which
     dominates on tunneled chips). Only run-level context is kept per
     launched slice — full slice arrays are freed as the pipeline advances.
+
+    Observability: publishes a stage breakdown to
+    `region.last_scan_profile` (the scan twin of the ingest profiler)
+    and mirrors the same numbers into the active ExecStats collector so
+    EXPLAIN ANALYZE, the profile, and the tracing spans agree.
     """
+    import time as _time
+
     import jax
 
+    from ..common import exec_stats
+    from ..common.telemetry import propagate, span
+    from ..storage.region import ScanProfile
     from .tpu_exec import _collect_moment_frame, _launch_scan_kernel
 
+    prof = ScanProfile(path="streamed")
+    _t_start = _time.perf_counter()
     snap = region.snapshot()
     schema = snap.schema
     tc = schema.timestamp_column
     unit = tc.dtype.time_unit if tc is not None else None
     stats = _region_slice_stats(region, snap, unit)
-    if not stats:
-        return []
     jobs = _plan_jobs(stats, _SLICE_ROWS[0], plan.time_lo, plan.time_hi,
-                      unit)
+                      unit) if stats else []
+    prof.mark("slice_plan", _time.perf_counter() - _t_start)
+    prof.bump("slices", len(jobs))
+    exec_stats.record("slice_plan", elapsed_s=prof.stages["slice_plan"],
+                      slices=len(jobs))
     if not jobs:
+        prof.total_s = _time.perf_counter() - _t_start
+        region.last_scan_profile = prof
         return []
     needed = sorted({m.column for m in plan.moments if m.column is not None}
                     | {ff.column for ff in plan.field_filters})
@@ -904,42 +948,62 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     frames: List[pd.DataFrame] = []
     # two-deep prefetch: decode slices i+1, i+2 while slice i launches
     # (decode is the cold-path bottleneck; two workers keep parquet
-    # threads busy without unbounded slice residency)
+    # threads busy without unbounded slice residency). propagate()
+    # carries the trace context + ExecStats collector into the workers.
     depth = 2
-    with ThreadPoolExecutor(max_workers=depth,
-                            thread_name_prefix="stream-scan") as pool:
-        futs = [pool.submit(_load_slice, snap, dim, lo, hi, unit, needed,
+    _t_stream = _time.perf_counter()
+    load = propagate(_load_slice)
+    with span("stream_scan", region=region.name, slices=len(jobs),
+              mode=mode), \
+            ThreadPoolExecutor(max_workers=depth,
+                               thread_name_prefix="stream-scan") as pool:
+        futs = [pool.submit(load, snap, dim, lo, hi, unit, needed,
                             sd, _ROW_BUCKET_MIN, clip, plan, mode,
                             sid_keys)
                 for dim, lo, hi, clip in jobs[:depth]]
         for i in range(len(jobs)):
-            scan = futs[i].result()
+            res = futs[i].result()
             if i + depth < len(jobs):
                 dim, lo, hi, clip = jobs[i + depth]
-                futs.append(pool.submit(_load_slice, snap, dim, lo, hi,
+                futs.append(pool.submit(load, snap, dim, lo, hi,
                                         unit, needed, sd, _ROW_BUCKET_MIN,
                                         clip, plan, mode, sid_keys))
             futs[i] = None                   # free the slice as we go
-            if scan is None:
+            if res is None:
+                prof.bump("empty_slices")
                 continue
-            if isinstance(scan, tuple) and scan[0] == "frames":
-                frames.extend(scan[1])
+            kind, payload, info = res
+            prof.rows += info.get("rows", 0)
+            for k in ("lean_slices", "merged_slices", "dedup_skip_slices"):
+                if info.get(k):
+                    prof.bump(k, info[k])
+            if kind == "frames":
+                frames.extend(payload)
                 continue
-            if isinstance(scan, tuple) and scan[0] == "frame":
-                if scan[1] is not None and len(scan[1]):
-                    frames.append(scan[1])
+            if kind == "frame":
+                if payload is not None and len(payload):
+                    frames.append(payload)
                 continue
-            ln = _launch_scan_kernel(scan, schema, plan)
+            prof.bump("device_slices")
+            ln = _launch_scan_kernel(payload, schema, plan)
             if ln is not None:
                 launched.append(ln)
-            del scan
+            del payload, res
+    prof.mark("decode_reduce", _time.perf_counter() - _t_stream)
+    _publish_stream_stats(prof)
     if sid_keys and frames:
+        _t_fold = _time.perf_counter()
         frames = _fold_sid_frames(frames, plan, sd)
+        prof.mark("fold", _time.perf_counter() - _t_fold)
+        exec_stats.record("fold", elapsed_s=prof.stages["fold"])
     if not launched:
+        prof.total_s = _time.perf_counter() - _t_start
+        region.last_scan_profile = prof
         return frames
     # overlap the D2H copies: fetch every per-slice array concurrently —
     # a sequential device_get pays the (tunneled) device-link round-trip
     # latency once per array, which dominates for these small partials
+    _t_fetch = _time.perf_counter()
     flat: List = []
     for ln in launched:
         flat.append(ln.counts)
@@ -962,4 +1026,24 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
         part = _collect_moment_frame(ln, plan, counts, res_np)
         if part is not None and len(part):
             frames.append(part)
+    prof.mark("device_fetch", _time.perf_counter() - _t_fetch)
+    exec_stats.record("device_fetch", elapsed_s=prof.stages["device_fetch"])
+    prof.total_s = _time.perf_counter() - _t_start
+    region.last_scan_profile = prof
     return frames
+
+
+def _publish_stream_stats(prof) -> None:
+    """Mirror a streamed region's profile into the ExecStats collector
+    (stream_scan row) and prometheus counters, so EXPLAIN ANALYZE,
+    /metrics and Region.last_scan_profile tell one story."""
+    from ..common import exec_stats
+    from ..common.telemetry import increment_counter
+    exec_stats.record(
+        "stream_scan", rows=prof.rows,
+        elapsed_s=prof.stages.get("decode_reduce", 0.0),
+        **{k: v for k, v in prof.counters.items() if v})
+    for k in ("lean_slices", "merged_slices", "dedup_skip_slices"):
+        n = prof.counters.get(k, 0)
+        if n:
+            increment_counter(f"stream_{k}", n)

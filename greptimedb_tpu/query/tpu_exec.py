@@ -160,8 +160,15 @@ class _ScanCache:
         self.budget_bytes = budget_bytes
         self._lock = threading.Lock()
         self._entries: Dict[str, _CacheEntry] = {}   # insertion = LRU order
+        # per-thread outcome of the most recent get(): "hit" /
+        # "incremental" / "full" — read by the resident scan profiler
+        self._last = threading.local()
+
+    def last_outcome(self) -> Optional[str]:
+        return getattr(self._last, "outcome", None)
 
     def get(self, region) -> MergedScan:
+        from ..common.telemetry import increment_counter
         snap = region.snapshot()
         v = snap._version
         visible = snap.visible_sequence
@@ -176,9 +183,15 @@ class _ScanCache:
                 and entry.retraction_epoch == epoch \
                 and entry.visible <= visible:
             if entry.visible == visible and entry.sst_names == sst_names:
+                self._last.outcome = "hit"
+                increment_counter("scan_cache_hit")
                 return entry.scan
+            self._last.outcome = "incremental"
+            increment_counter("scan_cache_incremental")
             scan = self._incremental(region, snap, v, entry, visible)
         else:
+            self._last.outcome = "full"
+            increment_counter("scan_cache_miss")
             scan = self._full(region, snap)
         entry = _CacheEntry(scan, visible, sst_names, v.schema.version,
                             epoch)
@@ -746,6 +759,9 @@ def cached_table_frame(table) -> Optional[pd.DataFrame]:
 
 
 def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
+    from ..common import exec_stats
+    from ..common.telemetry import span, timer
+
     plan = plan_for(table, a, query)
     if plan is None:
         return None
@@ -755,17 +771,26 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
         # columnar path, which is faster and float64-exact.
         est = _estimated_table_rows(table)
         if est is not None and est < _dispatch_min_rows():
+            exec_stats.set_dispatch(
+                f"cpu-small-scan (est_rows={est} < "
+                f"dispatch_floor={_dispatch_min_rows()})")
             return None
     try:
         if hasattr(table, "execute_tpu_plan"):
             # distributed: aggregate pushdown — datanodes reduce their
             # regions, the frontend folds moment frames (_finalize)
-            frames = [f for f in table.execute_tpu_plan(plan)
-                      if f is not None and len(f)]
+            exec_stats.set_dispatch(
+                "aggregate-pushdown (datanodes reduce, frontend folds)")
+            with span("tpu_pushdown", table=table.name), \
+                    timer("tpu_pushdown"):
+                frames = [f for f in table.execute_tpu_plan(plan)
+                          if f is not None and len(f)]
         else:
             import time as _time
             t0 = _time.perf_counter()
-            frames = region_moment_frames(table, plan)
+            with span("tpu_execute", table=table.name), \
+                    timer("tpu_execute"):
+                frames = region_moment_frames(table, plan)
             _note_device_query_time(_time.perf_counter() - t0)
     except UnsupportedError:
         return None
@@ -780,8 +805,31 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
         row = {slot: (0 if op == "count" else np.nan)
                for slot, op, _ in plan.finals}
         return pd.DataFrame([row])
-    merged = pd.concat(frames, ignore_index=True)
-    return _finalize(merged, plan)
+    with exec_stats.stage("finalize", partial_frames=len(frames)):
+        merged = pd.concat(frames, ignore_index=True)
+        out = _finalize(merged, plan)
+    exec_stats.record("finalize", rows=len(out))
+    return out
+
+
+def local_dispatch_decision(table, cold=None) -> str:
+    """The resident / streamed / mixed decision string for a local
+    region-backed table — the ONE source both EXPLAIN (query/engine.py)
+    and execution (region_moment_frames → ExecStats) print, so the two
+    views cannot drift. `cold` lets a caller that already evaluated
+    region_streams_cold per region pass the answers in."""
+    from . import stream_exec
+    regions = list(table.regions.values())
+    if cold is None:
+        cold = [region_streams_cold(r) for r in regions]
+    n_stream = sum(cold)
+    if n_stream == 0:
+        return "device-resident (scan cache)"
+    if n_stream == len(regions):
+        return (f"streamed-cold (est_rows={_estimated_table_rows(table)}, "
+                f"stream_threshold_rows="
+                f"{stream_exec.stream_threshold_rows()})")
+    return f"mixed ({n_stream}/{len(regions)} regions streamed-cold)"
 
 
 def region_streams_cold(region) -> bool:
@@ -808,10 +856,14 @@ def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
     their time domain is sliced and streamed through the device instead
     (query/stream_exec.py), bounding host+HBM residency by the slice
     budget rather than the region size."""
+    from ..common import exec_stats
     from . import stream_exec
+    regions = list(table.regions.values())
+    cold = [region_streams_cold(r) for r in regions]
+    exec_stats.set_dispatch(local_dispatch_decision(table, cold))
     frames = []
-    for region in table.regions.values():
-        if region_streams_cold(region):
+    for region, streams in zip(regions, cold):
+        if streams:
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))
             continue
@@ -822,10 +874,37 @@ def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
 
 
 def _execute_region(region, table, plan: TpuPlan) -> Optional[pd.DataFrame]:
-    scan = SCAN_CACHE.get(region)
-    if scan.num_rows == 0:
-        return None
-    return _moment_frame_for_scan(scan, table.schema, plan)
+    import time as _time
+
+    from ..common import exec_stats
+    from ..common.telemetry import span
+    from ..storage.region import ScanProfile
+
+    prof = ScanProfile(path="resident")
+    _t0 = _time.perf_counter()
+    with span("region_scan", region=region.name, path="resident"):
+        scan = SCAN_CACHE.get(region)
+        prep = _time.perf_counter() - _t0
+        prof.mark("scan_prep", prep)
+        outcome = SCAN_CACHE.last_outcome() or "full"
+        # same outcome vocabulary as ExecStats (cache=...) and the
+        # scan_cache_* prometheus counters: hit / incremental / full
+        prof.bump(f"cache_{outcome}")
+        prof.rows = scan.num_rows
+        exec_stats.record("scan_prep", rows=scan.num_rows, elapsed_s=prep,
+                          cache=outcome)
+        if scan.num_rows == 0:
+            prof.total_s = _time.perf_counter() - _t0
+            region.last_scan_profile = prof
+            return None
+        _t1 = _time.perf_counter()
+        out = _moment_frame_for_scan(scan, table.schema, plan)
+        prof.mark("reduce", _time.perf_counter() - _t1)
+        prof.total_s = _time.perf_counter() - _t0
+        region.last_scan_profile = prof
+        exec_stats.record("reduce", rows=scan.num_rows,
+                          elapsed_s=prof.stages["reduce"])
+    return out
 
 
 @dataclass

@@ -65,6 +65,7 @@ class HttpServer:
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
+        self._start_time = time.time()
 
     # ---- app ----
     def make_app(self) -> web.Application:
@@ -425,8 +426,44 @@ class HttpServer:
         return web.json_response({})
 
     async def handle_status(self, request):
+        """Server status: version, uptime, region count, cache health and
+        the latest ingest/scan stage profiles (reference: the /status
+        build+state handler, src/servers/src/http/handler.rs) — the quick
+        'what is this node doing' view the observability tests assert."""
         from .. import __version__
-        return web.json_response({"version": __version__})
+        regions = []
+        try:
+            cat = self.frontend.catalog
+            for schema_name in cat.schema_names(DEFAULT_CATALOG_NAME):
+                for tname in cat.table_names(DEFAULT_CATALOG_NAME,
+                                             schema_name):
+                    t = cat.table(DEFAULT_CATALOG_NAME, schema_name,
+                                  tname)
+                    regions.extend(
+                        getattr(t, "regions", {}).values())
+        except Exception:  # noqa: BLE001 — status must never 500
+            pass
+        ingest = scan = None
+        for r in regions:
+            p = getattr(r, "last_ingest_profile", None)
+            if p is not None:
+                ingest = p.describe()
+            p = getattr(r, "last_scan_profile", None)
+            if p is not None:
+                scan = p.describe()
+        from ..query.tpu_exec import SCAN_CACHE
+        store = getattr(self.frontend.datanode, "store", None) \
+            if hasattr(self.frontend, "datanode") else None
+        ratio = store.hit_ratio() if hasattr(store, "hit_ratio") else None
+        return web.json_response({
+            "version": __version__,
+            "uptime_s": round(time.time() - self._start_time, 3),
+            "region_count": len(regions),
+            "read_cache_hit_ratio": ratio,
+            "scan_cache_resident_bytes": SCAN_CACHE.resident_bytes(),
+            "last_ingest_profile": ingest,
+            "last_scan_profile": scan,
+        })
 
     async def handle_flush(self, request):
         ctx = self._ctx(request)

@@ -95,8 +95,16 @@ class LruCacheLayer(ObjectStore):
                     except OSError:
                         pass
 
+    def hit_ratio(self) -> float:
+        """Fraction of reads served from the local cache (0.0 when the
+        layer has seen no traffic) — surfaced by /status and the
+        information_schema.runtime_metrics gauges."""
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
     # ---- ObjectStore surface ----
     def read(self, key: str) -> bytes:
+        from ..common.telemetry import increment_counter
         with self._lock:
             if key in self._entries:
                 self._touch(key)
@@ -107,10 +115,13 @@ class LruCacheLayer(ObjectStore):
         if path is not None:
             try:
                 with open(path, "rb") as f:
-                    return f.read()
+                    data = f.read()
+                increment_counter("read_cache_hit")
+                return data
             except FileNotFoundError:
                 self._invalidate(key)
         self.misses += 1
+        increment_counter("read_cache_miss")
         data = self.inner.read(key)
         self._admit(key, data)
         return data

@@ -85,6 +85,21 @@ def run_compaction(region, plan: CompactionPlan,
     writer lock."""
     if not plan.inputs and not plan.expired:
         return []
+    from ..common.telemetry import increment_counter, span, timer
+    with span("compaction", region=region.name,
+              inputs=len(plan.inputs), expired=len(plan.expired)), \
+            timer("compaction"):
+        out = _run_compaction_inner(region, plan, ttl_ms=ttl_ms,
+                                    now_ms=now_ms)
+    increment_counter("compaction_runs")
+    increment_counter("compaction_files_in", len(plan.inputs))
+    increment_counter("compaction_files_out", len(out))
+    return out
+
+
+def _run_compaction_inner(region, plan: CompactionPlan,
+                          *, ttl_ms: Optional[int] = None,
+                          now_ms: Optional[int] = None) -> List[FileMeta]:
     now_ms = int(time.time() * 1000) if now_ms is None else now_ms
     al = region.access_layer
     schema = region.schema

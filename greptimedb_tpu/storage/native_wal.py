@@ -119,8 +119,12 @@ class NativeWal(Wal):
                                          payload, len(payload))
         if ticket < 0:
             raise StorageError(f"wal_append failed: errno {-ticket}")
+        from ..common.telemetry import increment_counter
+        increment_counter("wal_bytes", len(payload))
         if self.sync_on_write:
-            rc = self._libref.wal_wait(handle, ticket, 30_000)
+            from ..common.telemetry import timer
+            with timer("wal_fsync"):
+                rc = self._libref.wal_wait(handle, ticket, 30_000)
             if rc != 0:
                 raise StorageError(f"wal_wait failed: {rc}")
 

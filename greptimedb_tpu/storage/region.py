@@ -79,6 +79,37 @@ class IngestProfile:
 
 
 @dataclass
+class ScanProfile:
+    """Stage-by-stage breakdown of the last aggregate scan over this
+    region — the scan twin of IngestProfile (published via EXPLAIN
+    ANALYZE, /status and bench.py; the observability tests assert the
+    two views agree). `path` names the route taken: "resident" (scan
+    cache + device kernel) or "streamed" (cold slice streaming).
+    `counters` carries path facts (slices, lean vs merged, cache hit)
+    under the same names EXPLAIN ANALYZE prints."""
+    path: str = ""
+    rows: int = 0
+    total_s: float = 0.0
+    stages: Dict[str, float] = field(default_factory=dict)
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    def mark(self, stage: str, seconds: float) -> None:
+        self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def describe(self) -> str:
+        parts = ", ".join(f"{k}={v:.3f}s"
+                          for k, v in sorted(self.stages.items(),
+                                             key=lambda kv: -kv[1]))
+        cnts = ", ".join(f"{k}={v}" for k, v in sorted(
+            self.counters.items()))
+        return (f"{self.path}: {self.rows} rows in {self.total_s:.3f}s"
+                f" ({parts})" + (f" [{cnts}]" if cnts else ""))
+
+
+@dataclass
 class ScanData:
     """Concatenated unsorted runs from memtables + SSTs (SoA).
 
@@ -329,6 +360,7 @@ class Region:
         self._persisted_series = 0
         self.version_control: Optional[VersionControl] = None
         self.last_ingest_profile: Optional[IngestProfile] = None
+        self.last_scan_profile: Optional[ScanProfile] = None
         self.closed = False
 
     # ---- lifecycle ----
@@ -450,14 +482,16 @@ class Region:
     # ---- write path ----
     def write(self, batch: WriteBatch) -> int:
         """WAL append → memtable insert → sequence bump. Returns rows written."""
+        from ..common.telemetry import increment_counter, timer
         stall = False
-        with self._writer_lock:
+        with timer("region_write"), self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
             vc = self.version_control
             seq = vc.next_sequence()
-            self.wal.append(seq, batch.encode(),
-                            schema_version=vc.current.schema.version)
+            with timer("wal_append"):
+                self.wal.append(seq, batch.encode(),
+                                schema_version=vc.current.schema.version)
             # committed_sequence advances only after the memtable insert:
             # snapshot readers sample it without the writer lock, so rows
             # must be visible in the memtable before their sequence is —
@@ -481,7 +515,9 @@ class Region:
         if stall and self.scheduler is not None:
             # write stall: block (outside the writer lock so the flush
             # worker can commit) until the backlog drains
+            increment_counter("region_write_stalls")
             self._flush_done.wait(timeout=300)
+        increment_counter("region_write_rows", batch.num_rows)
         return batch.num_rows
 
     def bulk_ingest(self, data, *,
@@ -506,6 +542,7 @@ class Region:
         import time as _time
 
         from ..common.runtime import parallel_map
+        from ..common.telemetry import increment_counter
         from ..ops.kernels import _merge_order
 
         prof = IngestProfile()
@@ -687,6 +724,10 @@ class Region:
             mark("manifest")
             prof.total_s = _time.perf_counter() - _t0
             self.last_ingest_profile = prof
+        increment_counter("ingest_rows", n)
+        increment_counter("ingest_sst_files", len(files))
+        from ..common.telemetry import _observe
+        _observe("bulk_ingest", prof.total_s)
         if self.scheduler is not None and l0_count >= self.max_l0_files:
             self.schedule_compaction()
         return n
@@ -740,10 +781,20 @@ class Region:
             self._flush_done.set()
 
     def _flush_job_inner(self) -> List[FileMeta]:
+        from ..common.telemetry import increment_counter, span, timer
         vc = self.version_control
         to_flush = list(vc.current.memtables.immutables)
         if not to_flush:
             return []
+        with span("region_flush", region=self.name), timer("region_flush"):
+            files = self._flush_memtables(to_flush)
+        increment_counter("flush_files", len(files))
+        increment_counter("flush_rows",
+                          sum(f.num_rows for f in files))
+        return files
+
+    def _flush_memtables(self, to_flush) -> List[FileMeta]:
+        vc = self.version_control
         # safe WAL truncation point: every row with seq <= the max sequence
         # in the frozen set lives in these memtables (the mutable only
         # receives later sequences)

@@ -190,6 +190,16 @@ class AccessLayer:
         n = len(ts)
         if n == 0:
             return None
+        from ..common.telemetry import timer as _timer
+        with _timer("sst_write"):
+            return self._write_sst_inner(
+                level=level, series_ids=series_ids, ts=ts, seq=seq,
+                op_types=op_types, fields=fields, tag_columns=tag_columns,
+                schema=schema)
+
+    def _write_sst_inner(self, *, level, series_ids, ts, seq, op_types,
+                         fields, tag_columns, schema) -> Optional[FileMeta]:
+        n = len(ts)
         schema = schema if schema is not None else self.schema
         arrays: List[pa.Array] = []
         names: List[str] = []
@@ -328,6 +338,10 @@ class AccessLayer:
                 if int(stats.max) >= s0 and int(stats.min) < s1:
                     kept.append(g)
             groups = kept
+        from ..common import exec_stats
+        exec_stats.record("prune", files=1,
+                          row_groups=pf.metadata.num_row_groups,
+                          row_groups_kept=len(groups))
         field_names = [c.name for c in self.schema.field_columns()
                        if projection is None or c.name in projection]
         # schema-compat: an SST written before an ALTER may lack new columns —
@@ -350,7 +364,13 @@ class AccessLayer:
             z64 = np.zeros(0, np.int64)
             return SstData(np.zeros(0, np.int32), z64, z64,
                            np.zeros(0, np.int8), empty_fields, 0)
+        import time as _time
+        _t0 = _time.perf_counter()
         table = pf.read_row_groups(groups, columns=cols, use_threads=True)
+        _dt = _time.perf_counter() - _t0
+        exec_stats.record("decode", rows=table.num_rows, elapsed_s=_dt)
+        from ..common.telemetry import _observe
+        _observe("sst_read", _dt)
         if need_ts:
             tcol = table.column(ts_name)
             if pa.types.is_timestamp(tcol.type):

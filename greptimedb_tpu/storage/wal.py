@@ -70,14 +70,20 @@ class Wal:
             self._fh.write(rec)
             self._fh.flush()
             if self.sync_on_write:
-                os.fsync(self._fh.fileno())
+                from ..common.telemetry import timer
+                with timer("wal_fsync"):
+                    os.fsync(self._fh.fileno())
             self._fh_size += len(rec)
+            from ..common.telemetry import increment_counter
+            increment_counter("wal_bytes", len(rec))
 
     def sync(self) -> None:
         with self._lock:
             if self._fh is not None:
+                from ..common.telemetry import timer
                 self._fh.flush()
-                os.fsync(self._fh.fileno())
+                with timer("wal_fsync"):
+                    os.fsync(self._fh.fileno())
 
     def read_from(self, start_seq: int) -> Iterator[Tuple[int, int, bytes]]:
         """Yield (seq, schema_version, payload) for all records with

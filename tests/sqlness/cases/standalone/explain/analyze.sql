@@ -1,0 +1,49 @@
+-- EXPLAIN ANALYZE goldens (ISSUE 2): the per-stage breakdown collected
+-- by the ExecStats collector for each dispatch path — CPU columnar
+-- fallback, device-resident scan cache, and streamed-cold slices. The
+-- elapsed_ms column is wall clock and is normalized by the runner; the
+-- stage names, row counts and path facts are deterministic.
+
+CREATE TABLE cpu_analyze (
+    hostname STRING,
+    ts TIMESTAMP TIME INDEX,
+    usage_user DOUBLE,
+    PRIMARY KEY(hostname)
+);
+
+INSERT INTO cpu_analyze VALUES
+    ('h1', 1000, 10.0),
+    ('h1', 2000, 20.0),
+    ('h2', 1000, 30.0);
+
+-- pin the static floor first: SET also resets the latency-adaptive
+-- floor, which earlier queries in this process may have raised
+SET tpu_dispatch_min_rows = 131072;
+
+-- small table: the cost model routes to the CPU columnar path
+-- (scan -> aggregate -> project)
+EXPLAIN ANALYZE SELECT hostname, avg(usage_user)
+    FROM cpu_analyze GROUP BY hostname;
+
+-- pin the dispatch floor (this also resets the latency-adaptive floor):
+-- device-resident execution, scan_prep names the scan-cache outcome
+SET tpu_dispatch_min_rows = 1;
+
+EXPLAIN ANALYZE SELECT hostname, avg(usage_user)
+    FROM cpu_analyze GROUP BY hostname;
+
+-- stream the same query: one host-reduced slice; memtable rows defeat
+-- the dedup-skip proof, so it reports merged_slices, not lean_slices
+SET tpu_dispatch_min_rows = 1;
+
+SET stream_threshold_rows = 2;
+
+EXPLAIN ANALYZE SELECT hostname, avg(usage_user)
+    FROM cpu_analyze GROUP BY hostname;
+
+-- restore defaults (these knobs are process-global)
+SET stream_threshold_rows = 64000000;
+
+SET tpu_dispatch_min_rows = 131072;
+
+DROP TABLE cpu_analyze;

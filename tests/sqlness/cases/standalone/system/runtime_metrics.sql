@@ -1,0 +1,30 @@
+-- information_schema.runtime_metrics (ISSUE 2): the prometheus registry
+-- plus live engine gauges, queryable over SQL exactly like /metrics.
+-- Counter/timer VALUES are run-dependent, so the goldens select either
+-- deterministic engine gauges or name/kind only.
+
+CREATE TABLE rm (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+INSERT INTO rm VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+-- engine gauges are synthesized from live region state: deterministic
+-- on a fresh environment (1 region, 2 memtable rows, no SSTs yet)
+SELECT metric_name, labels, value
+    FROM information_schema.runtime_metrics
+    WHERE metric_name IN ('greptime_region_count',
+                          'greptime_region_memtable_rows',
+                          'greptime_region_sst_files')
+    ORDER BY metric_name;
+
+-- the statement timer the frontend records for every statement is
+-- exported under the same name /metrics renders
+SELECT metric_name, kind
+    FROM information_schema.runtime_metrics
+    WHERE metric_name = 'greptime_stmt_execute_seconds_count';
+
+DROP TABLE rm;

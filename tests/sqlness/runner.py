@@ -66,8 +66,39 @@ def _strip_comment_lines(stmt: str) -> str:
     return "\n".join(lines).strip()
 
 
+def _normalize_timings(out):
+    """Replace wall-clock columns (elapsed_ms in EXPLAIN ANALYZE output)
+    with a fixed placeholder so goldens byte-compare across runs — the
+    runner's stand-in for reference sqlness' result REPLACE directives.
+    Rebuilds the batch with the column retyped to STRING so the pretty
+    table renders identical widths every run."""
+    from greptimedb_tpu.datatypes import data_type as dt
+    from greptimedb_tpu.datatypes.record_batch import RecordBatch
+    from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+    from greptimedb_tpu.query.output import Output
+
+    if not out.is_batches or not out.batches:
+        return out
+    if not any("elapsed_ms" in b.schema.names() for b in out.batches):
+        return out
+    batches = []
+    for b in out.batches:
+        data = b.to_pydict()
+        cols = []
+        for cs in b.schema.column_schemas:
+            if cs.name == "elapsed_ms":
+                data[cs.name] = ["<elapsed>"] * b.num_rows
+                cols.append(ColumnSchema(cs.name, dt.STRING))
+            else:
+                cols.append(cs)
+        schema = Schema(cols)
+        batches.append(RecordBatch.from_pydict(schema, data))
+    return Output.record_batches(batches, batches[0].schema)
+
+
 def render_output(out) -> str:
     from greptimedb_tpu.datatypes.record_batch import pretty_print
+    out = _normalize_timings(out)
     if out.is_batches:
         if not out.batches or all(b.num_rows == 0 for b in out.batches):
             names = out.batches[0].schema.names() if out.batches else []

@@ -56,6 +56,81 @@ class TestRuntime:
 
 
 class TestTelemetry:
+    def test_span_propagates_into_pool_workers(self):
+        """_tls.spans is thread-local, so pool stages used to detach
+        from the parent trace; telemetry.propagate() (wired into
+        spawn_* and parallel_map) captures the stack at submit and
+        re-installs it in the worker."""
+        from greptimedb_tpu.common.runtime import parallel_map
+        from greptimedb_tpu.common.telemetry import propagate
+
+        with span("parent") as parent:
+            def work(_):
+                with span("child") as child:
+                    return child["trace_id"], child["parent_id"]
+            # len > 1 so parallel_map actually uses its pool
+            results = parallel_map(work, [1, 2])
+            for trace_id, parent_id in results:
+                assert trace_id == parent["trace_id"]
+                assert parent_id == parent["span_id"]
+            fut = spawn_bg(lambda: current_span())
+            assert fut.result()["trace_id"] == parent["trace_id"]
+            # direct helper: captured stack installs and restores
+            wrapped = propagate(lambda: current_span()["span_id"])
+        assert current_span() is None
+        import threading
+        out = []
+        t = threading.Thread(target=lambda: out.append(wrapped()))
+        t.start()
+        t.join()
+        assert out == [parent["span_id"]]
+
+    def test_propagate_without_span_is_identity(self):
+        from greptimedb_tpu.common.telemetry import propagate
+
+        def fn():
+            return 7
+        assert propagate(fn) is fn
+
+    def test_metric_sanitize_collision_detected(self, caplog):
+        """"a.b" and "a-b" both sanitize to "a_b": the second name must
+        get its own histogram (deterministic crc suffix) and the
+        collision must be logged, not silently share one series."""
+        from greptimedb_tpu.common.telemetry import (
+            _histograms, _sanitize, _sanitized_owners)
+        base = "collide.test.metric"
+        other = "collide-test-metric"
+        key1 = _sanitize(base)
+        with caplog.at_level(logging.ERROR,
+                             logger="greptimedb_tpu.common.telemetry"):
+            key2 = _sanitize(other)
+        assert key1 == "collide_test_metric"
+        assert key2 != key1
+        assert key2.startswith(key1 + "_x")
+        assert any("collision" in r.message for r in caplog.records)
+        # stable: the same colliding name keeps resolving to one key
+        assert _sanitize(other) == key2
+        assert _sanitized_owners[key1] == base
+        assert _sanitized_owners[key2] == other
+        with timer(base):
+            pass
+        with timer(other):
+            pass
+        assert key1 in _histograms and key2 in _histograms
+        assert _histograms[key1] is not _histograms[key2]
+
+    def test_slow_query_threshold_set_get(self):
+        from greptimedb_tpu.common.telemetry import (
+            set_slow_query_threshold_ms, slow_query_threshold_ms)
+        old = slow_query_threshold_ms()
+        try:
+            set_slow_query_threshold_ms(250)
+            assert slow_query_threshold_ms() == 250
+            set_slow_query_threshold_ms(0)      # 0 disables
+            assert slow_query_threshold_ms() is None
+        finally:
+            set_slow_query_threshold_ms(old)
+
     def test_nested_spans_share_trace(self):
         with span("outer") as outer:
             assert current_span() is outer
@@ -130,6 +205,73 @@ class TestTelemetry:
             exporter.flush()
         finally:
             configure_otlp(None)
+            srv.shutdown()
+
+    def test_otlp_batch_golden_shape(self):
+        """Golden-check one enqueued span's OTLP JSON — exact id padding
+        (16-byte trace / 8-byte span ids), parentSpanId, attribute
+        encoding and nanosecond window — plus the bounded queue's
+        drop-when-full counter (ISSUE 2 satellite)."""
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from greptimedb_tpu.common.telemetry import OtlpExporter
+
+        received = []
+
+        class Collector(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers["Content-Length"]))
+                received.append(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Collector)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        exporter = OtlpExporter(
+            f"http://127.0.0.1:{srv.server_port}",
+            service_name="gdb-golden", flush_interval=60, max_queue=1)
+        try:
+            fake = {
+                "name": "scan_slice",
+                "trace_id": "abcd1234abcd1234",        # 16 hex chars
+                "span_id": "11223344",                 # 8 hex chars
+                "parent_id": "55667788",
+                "attrs": {"region": "r1", "slices": 3},
+                "start_unix_ns": 1_700_000_000_000_000_000,
+            }
+            exporter.enqueue(fake, duration_ns=42_000_000)
+            # queue is full (max_queue=1): the next span must be DROPPED
+            # and counted, never block or grow the buffer
+            exporter.enqueue(dict(fake, span_id="99999999"), 1)
+            assert exporter.dropped == 1
+            exporter.flush()
+            assert len(received) == 1
+            doc = received[0]
+            spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            assert len(spans) == 1
+            golden = {
+                "traceId": "abcd1234abcd12340000000000000000",
+                "spanId": "1122334400000000",
+                "parentSpanId": "5566778800000000",
+                "name": "scan_slice",
+                "kind": 1,
+                "startTimeUnixNano": "1700000000000000000",
+                "endTimeUnixNano": "1700000000042000000",
+                "attributes": [
+                    {"key": "region", "value": {"stringValue": "r1"}},
+                    {"key": "slices", "value": {"stringValue": "3"}},
+                ],
+            }
+            assert spans[0] == golden
+            assert exporter.exported == 1
+        finally:
+            exporter.shutdown()
             srv.shutdown()
 
 

@@ -1,0 +1,274 @@
+"""Engine-wide observability tests (ISSUE 2).
+
+EXPLAIN ANALYZE must render the per-stage breakdown of the execution
+that actually ran — differential-checked against the storage-side scan
+profiler (`Region.last_scan_profile`), so the two views cannot drift.
+Plus: the slow-query log (fires over threshold, silent when disabled)
+and the ExecStats collector semantics.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.common import exec_stats
+from greptimedb_tpu.datanode.instance import (
+    DatanodeInstance, DatanodeOptions)
+from greptimedb_tpu.frontend.instance import FrontendInstance
+from greptimedb_tpu.query import stream_exec, tpu_exec
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(
+        data_home=str(tmp_path / "d"), register_numbers_table=False))
+    dn.start()
+    f = FrontendInstance(dn)
+    f.start()
+    yield f
+    f.shutdown()
+
+
+def analyze(fe, sql, ctx):
+    """EXPLAIN ANALYZE -> {stage: (rows, files, elapsed_ms, detail)}."""
+    out = fe.do_query("EXPLAIN ANALYZE " + sql, ctx)[0]
+    rows = {}
+    for b in out.batches:
+        for stage, r, files, ms, detail in b.rows():
+            rows[stage] = (r, files, ms, detail)
+    return rows
+
+
+def _force_device_dispatch(monkeypatch):
+    """Defeat both the static and latency-adaptive dispatch floors so a
+    tiny test table still takes the device/streamed paths."""
+    monkeypatch.setattr(tpu_exec, "TPU_DISPATCH_MIN_ROWS", 1)
+    monkeypatch.setattr(tpu_exec, "_observed_min_dt", [None])
+
+
+class TestExplainAnalyzeDifferential:
+    def test_streamed_lean_path_matches_profile(self, fe, monkeypatch):
+        """A persisted clean bulk region streams via the dedup-skip lean
+        path; EXPLAIN ANALYZE must name that path with the same counts
+        the region's scan profiler recorded."""
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE m (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+        table = fe.catalog.table("greptime", "public", "m")
+        hosts, per = 4, 300
+        ts = np.tile(np.arange(per, dtype=np.int64) * 1000, hosts)
+        host = np.repeat(np.array([f"h{i}" for i in range(hosts)]),
+                         per).astype(object)
+        rng = np.random.default_rng(3)
+        table.bulk_load({"host": host, "ts": ts,
+                         "cpu": rng.random(hosts * per)})
+        region = next(iter(table.regions.values()))
+        assert region.last_scan_profile is None
+        _force_device_dispatch(monkeypatch)
+        monkeypatch.setattr(stream_exec, "_STREAM_THRESHOLD_ROWS", [1])
+
+        rows = analyze(fe, "SELECT host, avg(cpu) FROM m GROUP BY host",
+                       ctx)
+        assert "streamed-cold" in rows["dispatch"][3]
+
+        prof = region.last_scan_profile
+        assert prof is not None and prof.path == "streamed"
+        # the actual path taken: dedup-skip lean slices, zero merged
+        assert prof.counters.get("lean_slices", 0) >= 1
+        assert prof.counters.get("merged_slices", 0) == 0
+        assert prof.counters["dedup_skip_slices"] == \
+            prof.counters["lean_slices"]
+
+        # differential: EXPLAIN ANALYZE's stream_scan row carries the
+        # SAME row count and path counters the profiler recorded
+        ss_rows, _, _, ss_detail = rows["stream_scan"]
+        assert ss_rows == prof.rows == hosts * per
+        assert f"lean_slices={prof.counters['lean_slices']}" in ss_detail
+        assert (f"dedup_skip_slices="
+                f"{prof.counters['dedup_skip_slices']}") in ss_detail
+        assert "merged_slices" not in ss_detail
+        # shared stage vocabulary between the two views
+        assert "slice_plan" in rows and "slice_plan" in prof.stages
+        assert "decode_reduce" in prof.stages
+        # the lean reader reported its decode (rows + files read)
+        assert rows["decode"][0] == hosts * per
+        assert rows["decode"][1] >= 1
+
+    def test_streamed_merged_path_named(self, fe, monkeypatch):
+        """Memtable rows defeat the dedup-skip proof: the same query
+        must now be reported as merged, by both views."""
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE mm (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+        fe.do_query("INSERT INTO mm VALUES ('a', 1000, 1.0), "
+                    "('a', 2000, 2.0), ('b', 1000, 3.0)")
+        table = fe.catalog.table("greptime", "public", "mm")
+        region = next(iter(table.regions.values()))
+        _force_device_dispatch(monkeypatch)
+        monkeypatch.setattr(stream_exec, "_STREAM_THRESHOLD_ROWS", [1])
+
+        rows = analyze(fe, "SELECT host, avg(cpu) FROM mm GROUP BY host",
+                       ctx)
+        assert "streamed-cold" in rows["dispatch"][3]
+        prof = region.last_scan_profile
+        assert prof.path == "streamed"
+        assert prof.counters.get("merged_slices", 0) >= 1
+        assert prof.counters.get("lean_slices", 0) == 0
+        assert (f"merged_slices={prof.counters['merged_slices']}"
+                in rows["stream_scan"][3])
+
+    def test_resident_matches_profile(self, fe, monkeypatch):
+        """Device-resident path: EXPLAIN ANALYZE and the profiler agree
+        on rows, stages and the scan-cache outcome."""
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE r (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+        fe.do_query("INSERT INTO r VALUES ('a', 1000, 1.0), "
+                    "('b', 1000, 2.0)")
+        table = fe.catalog.table("greptime", "public", "r")
+        region = next(iter(table.regions.values()))
+        _force_device_dispatch(monkeypatch)
+
+        rows = analyze(fe, "SELECT host, avg(cpu) FROM r GROUP BY host",
+                       ctx)
+        assert rows["dispatch"][3].startswith("device-resident")
+        prof = region.last_scan_profile
+        assert prof is not None and prof.path == "resident"
+        assert rows["scan_prep"][0] == prof.rows == 2
+        assert "scan_prep" in prof.stages and "reduce" in prof.stages
+        assert "reduce" in rows
+        # cache outcome agrees (first scan of this region: a full build)
+        assert prof.counters.get("cache_full") == 1
+        assert "cache=full" in rows["scan_prep"][3]
+
+        # second run: exact cache hit, both views say so (reset the
+        # adaptive floor the first device query just raised)
+        tpu_exec._observed_min_dt[0] = None
+        rows = analyze(fe, "SELECT host, avg(cpu) FROM r GROUP BY host",
+                       ctx)
+        prof = region.last_scan_profile
+        assert prof.counters.get("cache_hit") == 1
+        assert "cache=hit" in rows["scan_prep"][3]
+
+    def test_cpu_fallback_stages(self, fe):
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE c (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, cpu DOUBLE, PRIMARY KEY(host))")
+        fe.do_query("INSERT INTO c VALUES ('a', 1000, 1.0), "
+                    "('b', 1000, 5.0)")
+        rows = analyze(fe, "SELECT host, cpu FROM c WHERE cpu > 2",
+                       ctx)
+        assert rows["dispatch"][3] == "cpu-fallback"
+        assert rows["scan"][0] == 2
+        assert rows["filter"][0] == 1          # rows out of the filter
+        assert rows["project"][0] == 1
+        # plan row carries the logical plan text
+        assert "CpuProjectionExec" in rows["plan"][3]
+
+
+class TestSlowQueryLog:
+    def test_fires_over_threshold_and_silent_when_disabled(self, fe,
+                                                           caplog):
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE s (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        fe.do_query("INSERT INTO s VALUES (1000, 1.0)")
+        from greptimedb_tpu.common.telemetry import (
+            set_slow_query_threshold_ms)
+        try:
+            # 1ms: any Python-side SELECT takes longer
+            fe.do_query("SET slow_query_threshold_ms = 1")
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.slow_query"):
+                fe.do_query("SELECT v FROM s", ctx)
+            slow = [r for r in caplog.records
+                    if "slow query" in r.getMessage()]
+            assert slow, "slow-query log did not fire"
+            msg = slow[-1].getMessage()
+            assert "trace=" in msg
+            assert "SELECT v FROM s" in msg
+            assert "stats=[" in msg and "dispatch=" in msg
+            assert slow[-1].levelno == logging.WARNING
+
+            # disabled (0 => off): stays silent
+            fe.do_query("SET slow_query_threshold_ms = 0")
+            caplog.clear()
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.slow_query"):
+                fe.do_query("SELECT v FROM s", ctx)
+            assert not [r for r in caplog.records
+                        if "slow query" in r.getMessage()]
+        finally:
+            set_slow_query_threshold_ms(None)
+
+    def test_slow_ddl_does_not_report_stale_query_stats(self, fe,
+                                                        caplog):
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE s2 (ts TIMESTAMP TIME INDEX, "
+                    "v DOUBLE)")
+        fe.do_query("SELECT 1", ctx)      # leaves ExecStats behind
+        from greptimedb_tpu.common.telemetry import (
+            set_slow_query_threshold_ms)
+        try:
+            fe.do_query("SET slow_query_threshold_ms = 1")
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.slow_query"):
+                fe.do_query("INSERT INTO s2 VALUES (1, 1.0)", ctx)
+            slow = [r for r in caplog.records
+                    if "slow query" in r.getMessage()]
+            assert slow
+            assert "stats=[n/a]" in slow[-1].getMessage()
+        finally:
+            set_slow_query_threshold_ms(None)
+
+
+class TestExecStats:
+    def test_collect_accumulate_and_render(self):
+        with exec_stats.collect() as st:
+            exec_stats.record("scan", rows=5, elapsed_s=0.01,
+                              cached=True)
+            exec_stats.record("scan", rows=3, files=2, lean_slices=1)
+            exec_stats.record("scan", lean_slices=2)
+            exec_stats.set_dispatch("first")
+            exec_stats.set_dispatch("second")    # first wins
+        assert exec_stats.current() is None
+        s = st.stages["scan"]
+        assert s.rows == 8 and s.files == 2
+        assert s.detail["lean_slices"] == 3      # numeric details add up
+        assert st.dispatch == "first"
+        assert st.total_s > 0
+        assert "dispatch=first" in st.summary()
+        tab = st.rows_table()
+        assert tab["stage"][0] == "dispatch"
+        assert tab["stage"][-1] == "total"
+        assert tab["detail"][0] == "first"
+
+    def test_noop_without_collector(self):
+        exec_stats.record("x", rows=1)
+        with exec_stats.stage("y"):
+            pass
+        assert exec_stats.current() is None
+
+    def test_nested_collect_records_into_outer(self):
+        with exec_stats.collect() as outer:
+            with exec_stats.collect(outer):
+                exec_stats.record("inner", rows=1)
+        assert outer.stages["inner"].rows == 1
+
+    def test_collector_rides_propagate_into_workers(self):
+        from greptimedb_tpu.common.runtime import parallel_map
+        with exec_stats.collect() as st:
+            parallel_map(
+                lambda i: exec_stats.record("worker", rows=i), [1, 2, 3])
+        assert st.stages["worker"].rows == 6
+
+    def test_engine_saves_last_exec_stats(self, fe):
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE e (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        fe.do_query("INSERT INTO e VALUES (1000, 1.0)")
+        fe.do_query("SELECT v FROM e", ctx)
+        st = fe.query_engine.last_exec_stats
+        assert st is not None
+        assert st.dispatch is not None
+        assert "scan" in st.stages

@@ -102,6 +102,86 @@ class TestHttpSql:
         status, body = req(server, "/metrics")
         assert status == 200
 
+    def test_status_shape(self, server):
+        """/status reports uptime, region count, cache health and the
+        latest ingest/scan profile summaries (ISSUE 2 satellite)."""
+        sql(server, "CREATE TABLE st (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, v DOUBLE, PRIMARY KEY(host))")
+        sql(server, "INSERT INTO st VALUES ('a', 1000, 1.0)")
+        t = server.frontend.catalog.table("greptime", "public", "st")
+        region = next(iter(t.regions.values()))
+        region.bulk_ingest({"host": np.array(["b"], dtype=object),
+                            "ts": np.array([2000], dtype=np.int64),
+                            "v": np.array([2.0])})
+        status, body = req(server, "/status")
+        assert status == 200
+        data = json.loads(body)
+        for key in ("version", "uptime_s", "region_count",
+                    "read_cache_hit_ratio", "scan_cache_resident_bytes",
+                    "last_ingest_profile", "last_scan_profile"):
+            assert key in data, f"/status missing {key}"
+        assert data["uptime_s"] >= 0
+        assert data["region_count"] >= 1
+        # the bulk ingest above left a stage profile behind
+        assert "rows" in data["last_ingest_profile"]
+        # a scan leaves the scan twin behind
+        t.flush()
+        from greptimedb_tpu.query import stream_exec, tpu_exec
+        old = stream_exec.stream_threshold_rows()
+        old_floor = tpu_exec.TPU_DISPATCH_MIN_ROWS
+        old_dt = tpu_exec._observed_min_dt[0]
+        stream_exec.configure_streaming(threshold_rows=1)
+        tpu_exec.TPU_DISPATCH_MIN_ROWS = 1
+        tpu_exec._observed_min_dt[0] = None
+        try:
+            sql(server, "SELECT host, avg(v) FROM st GROUP BY host")
+        finally:
+            stream_exec.configure_streaming(threshold_rows=old)
+            tpu_exec.TPU_DISPATCH_MIN_ROWS = old_floor
+            tpu_exec._observed_min_dt[0] = old_dt
+        status, body = req(server, "/status")
+        data = json.loads(body)
+        assert data["last_scan_profile"] is not None
+        assert data["last_scan_profile"].startswith("streamed:")
+
+    def test_runtime_metrics_matches_metrics_endpoint(self, server):
+        """SELECT over information_schema.runtime_metrics returns the
+        same counters /metrics exports, with the same values (ISSUE 2
+        acceptance)."""
+        sql(server, "CREATE TABLE rmm (host STRING, ts TIMESTAMP TIME "
+                    "INDEX, v DOUBLE, PRIMARY KEY(host))")
+        sql(server, "INSERT INTO rmm VALUES ('a', 1000, 1.0)")
+        out = sql(server, "SELECT metric_name, value FROM "
+                          "information_schema.runtime_metrics")
+        table_vals = {}
+        for name, value in out["output"][0]["records"]["rows"]:
+            table_vals[name] = value
+        assert "greptime_region_write_rows_total" in table_vals
+        status, body = req(server, "/metrics")
+        exported = {}
+        for line in body.decode().splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name, _, value = line.rpartition(" ")
+            if "{" in name:
+                name = name[:name.index("{")]
+            try:
+                exported.setdefault(name, float(value))
+            except ValueError:
+                continue
+        # every label-free counter the endpoint exports is queryable
+        # over SQL; values may drift between the two reads only for
+        # metrics the comparison itself bumps, so check a quiet one
+        assert "greptime_region_write_rows_total" in exported
+        # the SELECT ran before /metrics: the write counter is stable
+        # between the two reads (no writes in between)
+        assert table_vals["greptime_region_write_rows_total"] == \
+            exported["greptime_region_write_rows_total"]
+        # and the table is a superset modulo the engine gauges
+        missing = [n for n in exported
+                   if n.startswith("greptime_") and n not in table_vals]
+        assert not missing, f"runtime_metrics missing {missing[:5]}"
+
     def test_db_param(self, server):
         sql(server, "CREATE DATABASE db9")
         status, _ = req(
