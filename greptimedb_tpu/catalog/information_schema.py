@@ -52,6 +52,18 @@ _RUNTIME_METRICS_SCHEMA = Schema([
     ColumnSchema("kind", dt.STRING),
 ])
 
+_FLOWS_SCHEMA = Schema([
+    ColumnSchema("flow_name", dt.STRING),
+    ColumnSchema("source_table", dt.STRING),
+    ColumnSchema("sink_table", dt.STRING),
+    ColumnSchema("stride_ms", dt.INT64),
+    ColumnSchema("aggs", dt.STRING),
+    ColumnSchema("watermark", dt.INT64, nullable=True),
+    ColumnSchema("folds", dt.INT64),
+    ColumnSchema("rows_folded", dt.INT64),
+    ColumnSchema("buckets_written", dt.INT64),
+])
+
 
 def _engine_gauges(catalog_manager, catalog_name: str):
     """Live engine state as gauge samples: per-region storage facts plus
@@ -88,6 +100,22 @@ def _engine_gauges(catalog_manager, catalog_name: str):
                              "gauge"))
     rows.append(("greptime_region_count", "", float(region_count),
                  "gauge"))
+    # flow fold state: watermark timestamp + lifetime counters per flow
+    # (the flow_* prometheus counters cover rates; these are the gauges)
+    fm = getattr(catalog_manager, "flow_manager", None)
+    if fm is not None:
+        for spec in fm.flows(catalog_name):
+            labels = f'{{flow="{spec.name}", source="{spec.source}"}}'
+            wm = spec.watermark_ts()
+            if wm is not None:
+                rows.append(("greptime_flow_watermark_ts", labels,
+                             float(wm), "gauge"))
+            rows.append(("greptime_flow_rows_folded", labels,
+                         float(spec.stats.get("rows_folded", 0)),
+                         "gauge"))
+            rows.append(("greptime_flow_buckets_written", labels,
+                         float(spec.stats.get("buckets_written", 0)),
+                         "gauge"))
     from ..query.tpu_exec import SCAN_CACHE
     rows.append(("greptime_scan_cache_resident_bytes", "",
                  float(SCAN_CACHE.resident_bytes()), "gauge"))
@@ -188,6 +216,25 @@ def information_schema_table(catalog_manager, catalog_name: str,
                             "YES" if cs.nullable else "NO")
             return rows
         return _VirtualTable("columns", _COLUMNS_SCHEMA, build_columns)
+    if name == "flows":
+        def build_flows():
+            rows = {k: [] for k in _FLOWS_SCHEMA.names()}
+            fm = getattr(catalog_manager, "flow_manager", None)
+            for spec in (fm.flows(catalog_name) if fm is not None else []):
+                rows["flow_name"].append(spec.name)
+                rows["source_table"].append(spec.source)
+                rows["sink_table"].append(spec.sink)
+                rows["stride_ms"].append(spec.stride_ms)
+                rows["aggs"].append(", ".join(a.describe()
+                                              for a in spec.aggs))
+                rows["watermark"].append(spec.watermark_ts())
+                rows["folds"].append(spec.stats.get("folds", 0))
+                rows["rows_folded"].append(
+                    spec.stats.get("rows_folded", 0))
+                rows["buckets_written"].append(
+                    spec.stats.get("buckets_written", 0))
+            return rows
+        return _VirtualTable("flows", _FLOWS_SCHEMA, build_flows)
     if name == "runtime_metrics":
         def build_metrics():
             samples = _prometheus_samples() + \

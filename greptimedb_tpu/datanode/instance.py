@@ -29,6 +29,10 @@ class DatanodeOptions:
     wal_sync_on_write: bool = False
     disable_wal: bool = False
     register_numbers_table: bool = True   # test fixture, like the reference
+    #: continuous-flow background fold cadence; the free-running task is
+    #: never started under pytest (tests drive FlowManager.tick()
+    #: cooperatively — tier-1 safety), and 0 disables it everywhere
+    flow_tick_interval_s: float = 10.0
 
 
 class DatanodeInstance:
@@ -61,14 +65,40 @@ class DatanodeInstance:
         from ..procedure import ProcedureManager
         self.procedure_manager = ProcedureManager(self.store, state_prefix=prefix)
         register_loaders(self.procedure_manager, self.mito, self.catalog)
+        # continuous rollup flows: specs + watermarks persist next to the
+        # mito manifests; the query engine gets the manager for the
+        # transparent rollup rewrite
+        from ..flow import FlowManager, ObjectStoreFlowStore
+        self.flow_manager = FlowManager(
+            self.catalog, ObjectStoreFlowStore(self.store, prefix),
+            create_sink_fn=self._create_flow_sink)
+        self.query_engine.flow_manager = self.flow_manager
+        # information_schema gauges read flow watermarks off the catalog
+        self.catalog.flow_manager = self.flow_manager
         self._started = False
         self._heartbeat_task = None
 
+    def _create_flow_sink(self, spec, schema, pk_indices):
+        from ..table.requests import CreateTableRequest
+        table = self.mito.create_table(CreateTableRequest(
+            spec.sink, schema, catalog_name=spec.catalog,
+            schema_name=spec.schema, primary_key_indices=pk_indices,
+            create_if_not_exists=True))
+        if self.catalog.table(spec.catalog, spec.schema, spec.sink) is None:
+            self.catalog.register_table(spec.catalog, spec.schema,
+                                        spec.sink, table)
+        return table
+
     def start(self) -> None:
         """Catalog replay → table open → region WAL replay → resume
-        in-flight procedures."""
+        in-flight procedures → reload flow specs + watermarks."""
         self.catalog.start()
         self.procedure_manager.recover()
+        self.flow_manager.recover()
+        if self.opts.flow_tick_interval_s > 0 and \
+                "PYTEST_CURRENT_TEST" not in os.environ:
+            self.flow_manager.start_background(
+                self.opts.flow_tick_interval_s)
         if self.opts.register_numbers_table and \
                 self.catalog.table(DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME,
                                    "numbers") is None:
@@ -120,6 +150,7 @@ class DatanodeInstance:
                     msg["catalog"], msg["schema"], msg["table"], table)
 
     def shutdown(self) -> None:
+        self.flow_manager.stop()
         if self._heartbeat_task is not None:
             self._heartbeat_task.stop()
         for engine in self.engines.values():

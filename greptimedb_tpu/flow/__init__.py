@@ -1,0 +1,15 @@
+"""Continuous rollup flows: streaming downsample with query rewrite.
+
+The TPU-native analog of GreptimeDB's flow engine: `CREATE FLOW` registers
+a standing aggregate over a source table; a background (or cooperative)
+task folds newly-written rows past a per-region watermark into a rollup
+sink table via the sorted-segment reducer (storage/downsample.py); the
+query planner transparently re-targets compatible `GROUP BY date_bin`
+queries at the 60x-smaller sink (flow/rewrite.py).
+"""
+
+from .manager import (FlowAgg, FlowManager, FlowSpec, KvFlowStore,
+                      ObjectStoreFlowStore, compile_flow)
+
+__all__ = ["FlowAgg", "FlowManager", "FlowSpec", "KvFlowStore",
+           "ObjectStoreFlowStore", "compile_flow"]

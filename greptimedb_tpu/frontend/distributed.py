@@ -191,6 +191,38 @@ class DistInstance:
         self.clients = clients
         self.catalog = _RouteHydratingCatalog(self)
         self.query_engine = QueryEngine(self.catalog)
+        # continuous rollup flows: specs live in the meta kv so every
+        # frontend (and a restarted one) sees the same flows; folds run
+        # through the generic scan-based path over DistTables
+        from ..flow import FlowManager, KvFlowStore
+        # wire meta clients without kv passthroughs still get in-memory
+        # flows; the in-process MetaClient persists specs under __flow/
+        store = KvFlowStore(meta) \
+            if hasattr(meta, "kv_put") or hasattr(meta, "put") else None
+        self.flow_manager = FlowManager(
+            self.catalog, store, create_sink_fn=self._create_flow_sink)
+        self.flow_manager.recover()
+        self.query_engine.flow_manager = self.flow_manager
+        self.catalog.flow_manager = self.flow_manager
+
+    def _create_flow_sink(self, spec, schema, pk_indices):
+        """Materialize a flow sink as an ordinary distributed table."""
+        cols = []
+        for cs in schema.column_schemas:
+            cols.append(ast.ColumnDef(
+                name=cs.name, type_name=cs.dtype.name,
+                nullable=cs.nullable,
+                is_time_index=cs.is_time_index,
+                is_primary_key=cs.is_tag))
+        stmt = ast.CreateTable(
+            name=ast.ObjectName([spec.catalog, spec.schema, spec.sink]),
+            columns=cols,
+            time_index=spec.ts_column,
+            primary_keys=[c.name for c in schema.column_schemas
+                          if c.is_tag],
+            if_not_exists=True)
+        ctx = QueryContext(spec.catalog, spec.schema)
+        return self.create_table(stmt, ctx)
 
     # ---- DDL ----
     def create_table(self, stmt: ast.CreateTable,
@@ -498,6 +530,16 @@ class DistInstance:
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt, ctx)
+        if isinstance(stmt, ast.CreateFlow):
+            self.flow_manager.create_flow(stmt, ctx)
+            return Output.rows(0)
+        if isinstance(stmt, ast.DropFlow):
+            self.flow_manager.drop_flow(stmt.name, ctx,
+                                        if_exists=stmt.if_exists)
+            return Output.rows(0)
+        if isinstance(stmt, ast.ShowFlows):
+            from .statement import show_flows_output
+            return show_flows_output(self.flow_manager, stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):

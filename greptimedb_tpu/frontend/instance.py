@@ -39,7 +39,8 @@ class FrontendInstance:
         self.query_engine = datanode.query_engine
         self.statement_executor = StatementExecutor(
             self.catalog, datanode.engines, self.query_engine,
-            procedure_manager=datanode.procedure_manager)
+            procedure_manager=datanode.procedure_manager,
+            flow_manager=getattr(datanode, "flow_manager", None))
         self._tql_engine = None
         self.script_engine = None
         from ..common.plugins import Plugins
@@ -127,6 +128,12 @@ class FrontendInstance:
             return ex.insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
             return ex.delete(stmt, ctx)
+        if isinstance(stmt, ast.CreateFlow):
+            return ex.create_flow(stmt, ctx)
+        if isinstance(stmt, ast.DropFlow):
+            return ex.drop_flow(stmt, ctx)
+        if isinstance(stmt, ast.ShowFlows):
+            return ex.show_flows(stmt, ctx)
         if isinstance(stmt, ast.Use):
             return ex.use_database(stmt, ctx)
         if isinstance(stmt, ast.SetVariable):

@@ -109,6 +109,43 @@ def evaluate_insert_rows(stmt: ast.Insert, columns, query_engine, ctx
     return {c: [r[i] for r in rows] for i, c in enumerate(columns)}
 
 
+def show_flows_output(flow_manager, stmt: ast.ShowFlows,
+                      ctx: QueryContext) -> Output:
+    """SHOW FLOWS rendering (shared by the standalone and distributed
+    executors). The `watermark` column carries wall-advancing fold state;
+    the sqlness runner normalizes it in goldens."""
+    import re
+
+    from ..datatypes import data_type as dt
+    from ..datatypes.record_batch import RecordBatch
+    from ..datatypes.schema import ColumnSchema, Schema
+    from ..query.expr import like_to_regex
+
+    flows = flow_manager.flows(ctx.current_catalog, ctx.current_schema)
+    if stmt.like:
+        rx = re.compile(like_to_regex(stmt.like))
+        flows = [f for f in flows if rx.match(f.name)]
+    schema = Schema([
+        ColumnSchema("flow_name", dt.STRING),
+        ColumnSchema("source", dt.STRING),
+        ColumnSchema("sink", dt.STRING),
+        ColumnSchema("stride_ms", dt.INT64),
+        ColumnSchema("aggs", dt.STRING),
+        ColumnSchema("watermark", dt.INT64, nullable=True),
+        ColumnSchema("rows_folded", dt.INT64),
+    ])
+    rb = RecordBatch.from_pydict(schema, {
+        "flow_name": [f.name for f in flows],
+        "source": [f.source for f in flows],
+        "sink": [f.sink for f in flows],
+        "stride_ms": [f.stride_ms for f in flows],
+        "aggs": [", ".join(a.describe() for a in f.aggs) for f in flows],
+        "watermark": [f.watermark_ts() for f in flows],
+        "rows_folded": [f.stats.get("rows_folded", 0) for f in flows],
+    })
+    return Output.record_batches([rb], schema)
+
+
 def delete_matching_rows(table, stmt: ast.Delete) -> Output:
     """DELETE ... WHERE: scan key columns, filter, delete by key (shared by
     the standalone and distributed executors)."""
@@ -135,13 +172,15 @@ def delete_matching_rows(table, stmt: ast.Delete) -> Output:
 class StatementExecutor:
     def __init__(self, catalog: CatalogManager,
                  engines: Dict[str, TableEngine], query_engine,
-                 procedure_manager=None):
+                 procedure_manager=None, flow_manager=None):
         self.catalog = catalog
         self.engines = engines
         self.query_engine = query_engine
         # when present, DDL runs as durable procedures (reference:
         # table-procedure + mito DDL procedures)
         self.procedure_manager = procedure_manager
+        # continuous rollup flows (flow/manager.py)
+        self.flow_manager = flow_manager
 
     def engine_for(self, name: str) -> TableEngine:
         engine = self.engines.get(name)
@@ -278,6 +317,24 @@ class StatementExecutor:
         engine.truncate_table(catalog, schema_name, table_name)
         return Output.rows(0)
 
+    # ---- flows (continuous rollups) ----
+    def _require_flows(self):
+        if self.flow_manager is None:
+            raise UnsupportedError("flows are not enabled on this node")
+        return self.flow_manager
+
+    def create_flow(self, stmt: ast.CreateFlow, ctx: QueryContext) -> Output:
+        self._require_flows().create_flow(stmt, ctx)
+        return Output.rows(0)
+
+    def drop_flow(self, stmt: ast.DropFlow, ctx: QueryContext) -> Output:
+        self._require_flows().drop_flow(stmt.name, ctx,
+                                        if_exists=stmt.if_exists)
+        return Output.rows(0)
+
+    def show_flows(self, stmt: ast.ShowFlows, ctx: QueryContext) -> Output:
+        return show_flows_output(self._require_flows(), stmt, ctx)
+
     # ---- DML ----
     def insert(self, stmt: ast.Insert, ctx: QueryContext) -> Output:
         catalog, schema_name, table_name = ctx.resolve(stmt.table)
@@ -325,6 +382,15 @@ class StatementExecutor:
             # GREPTIME_SLOW_QUERY_MS env/config (off when unset)
             from ..common.telemetry import set_slow_query_threshold_ms
             set_slow_query_threshold_ms(value)
+        elif name == "rollup_rewrite":
+            # flow rollup-rewrite kill switch (differential tests and
+            # operators compare against the raw path with it off)
+            from ..flow import rewrite as flow_rewrite
+            try:
+                flow_rewrite.set_enabled(bool(int(stmt.value)))
+            except (TypeError, ValueError):
+                raise InvalidArgumentsError(
+                    f"SET {stmt.name}: expected 0 or 1, got {stmt.value!r}")
         elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
             try:
                 value = int(stmt.value)

@@ -349,3 +349,17 @@ class MetaClient:
 
     def delete_table_info(self, full_name: str) -> bool:
         return self._srv.delete_table_info(full_name)
+
+    # generic kv passthroughs (flow specs persist under __flow/ so a
+    # restarted frontend recovers its continuous rollups from meta)
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._srv.kv.put(key, value)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self._srv.kv.get(key)
+
+    def kv_range(self, prefix: str):
+        return self._srv.kv.range(prefix)
+
+    def kv_delete(self, key: str) -> bool:
+        return self._srv.kv.delete(key)

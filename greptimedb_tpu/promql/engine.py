@@ -327,8 +327,8 @@ class PromqlEngine:
         parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
         for region in table.regions.values():
-            scan = SCAN_CACHE.get(region)
-            if scan.num_rows == 0:
+            scan = self._region_scan(region, fields, lo_ms, hi_ms)
+            if scan is None or scan.num_rows == 0:
                 continue
             sd = scan.series_dict
             S = sd.num_series
@@ -394,6 +394,33 @@ class PromqlEngine:
             gids, ts, vals = gids[order], ts[order], vals[order]
         sm = SeriesMatrix.build(gids, ts, vals, len(glabels))
         return _Selection(glabels, sm, int(ts.min()), int(ts.max()))
+
+    def _region_scan(self, region, fields: List[str], lo_ms: int,
+                     hi_ms: int):
+        """Rows for one region: the device-resident scan cache for warm
+        regions; a window-bounded streamed cold read for regions past the
+        streaming threshold (VERDICT gap: the PromQL path was hard-wired
+        to the resident cache, so a range query over a huge cold region
+        paid — and pinned — full residency for a small time window).
+        Both shapes expose series_ids/ts/fields/series_dict."""
+        from ..common.telemetry import increment_counter
+        from ..common.time import TimestampRange
+        from ..query.tpu_exec import SCAN_CACHE, region_streams_cold
+
+        if not region_streams_cold(region):
+            increment_counter("promql_select_resident")
+            return SCAN_CACHE.get(region)
+        # cold path: merged host read of only the selector's window and
+        # fields — proportional to the window, never enters the scan
+        # cache, leaves no device residency behind
+        increment_counter("promql_select_streamed")
+        from ..common import exec_stats
+        with exec_stats.stage("promql_cold_scan", region=region.name):
+            data = region.snapshot().read_merged(
+                projection=list(fields),
+                time_range=TimestampRange(lo_ms, hi_ms + 1))
+        exec_stats.record("promql_cold_scan", rows=data.num_rows)
+        return data
 
 
 def _label_str(v) -> str:

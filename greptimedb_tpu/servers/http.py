@@ -537,18 +537,16 @@ class HttpServer:
             dst_regions = list(getattr(dst, "regions", {}).values())
             if not src_regions or not dst_regions:
                 raise ValueError("downsample needs region-backed tables")
-            if len(dst_regions) > 1:
-                # writing into one region of a partitioned table would
-                # strand rows outside their partition's region
-                raise ValueError(
-                    "downsample into a partitioned destination is not "
-                    "supported; use an unpartitioned dst table")
             fields = [c.name for c in src.schema.field_columns()
                       if not src.schema.column_schema(c.name)
                       .dtype.is_string]
             aggs = {f: agg for f in fields}
             for region in src_regions:
-                total += downsample_region(region, dst_regions[0],
+                # destination rows go through the TABLE so a partitioned
+                # dst routes each bucket row to its region via the
+                # partition rule (partition/splitter.py); this endpoint
+                # stays the manual backfill path for flows
+                total += downsample_region(region, dst,
                                            stride_ms=stride_ms, aggs=aggs)
             return total
 

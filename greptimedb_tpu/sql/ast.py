@@ -22,6 +22,7 @@ __all__ = [
     "DropColumn", "RenameTable", "ShowDatabases", "ShowTables",
     "ShowCreateTable", "DescribeTable", "ShowVariable", "Use", "Tql", "Copy",
     "Explain", "SetVariable", "TruncateTable", "ObjectName",
+    "CreateFlow", "DropFlow", "ShowFlows",
 ]
 
 
@@ -344,6 +345,29 @@ class RenameTable:
 class AlterTable(Statement):
     table: ObjectName
     operation: Any                  # AddColumn | DropColumn | RenameTable
+
+
+@dataclass
+class CreateFlow(Statement):
+    """CREATE FLOW name [SINK TO table] AS SELECT <aggs> FROM src
+    GROUP BY date_bin(stride, ts)[, tags...] — a continuous rollup
+    (reference: GreptimeDB's flow engine CREATE FLOW statement)."""
+    name: str
+    query: "Query" = None
+    sink: Optional[str] = None      # defaults to the flow name
+    if_not_exists: bool = False
+    raw_sql: str = ""               # SELECT text for SHOW FLOWS
+
+
+@dataclass
+class DropFlow(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class ShowFlows(Statement):
+    like: Optional[str] = None
 
 
 @dataclass

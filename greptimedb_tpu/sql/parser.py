@@ -13,12 +13,13 @@ from typing import Any, List, Optional, Tuple
 from .ast import *  # noqa: F401,F403
 from .ast import (
     AddColumn, AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef,
-    Copy, CreateDatabase, CreateTable, Delete, DescribeTable, DropColumn,
-    DropDatabase, DropTable, Explain, Expr, FunctionCall, InList, Insert,
-    Interval, IsNull, Join, Literal, ObjectName, PartitionEntry, Partitions,
-    Placeholder, Query, RenameTable, SelectItem, SetQuery, SetVariable,
-    ShowCreateTable, ShowDatabases, ShowTables, ShowVariable, Star, Statement, Subquery,
-    TableRef, Tql, TruncateTable, UnaryOp, Use,
+    Copy, CreateDatabase, CreateFlow, CreateTable, Delete, DescribeTable,
+    DropColumn, DropDatabase, DropFlow, DropTable, Explain, Expr,
+    FunctionCall, InList, Insert, Interval, IsNull, Join, Literal, ObjectName,
+    PartitionEntry, Partitions, Placeholder, Query, RenameTable, SelectItem,
+    SetQuery, SetVariable, ShowCreateTable, ShowDatabases, ShowFlows,
+    ShowTables, ShowVariable, Star, Statement, Subquery, TableRef, Tql,
+    TruncateTable, UnaryOp, Use,
 )
 from .tokenizer import EOF, IDENT, NUMBER, OP, QIDENT, STRING, Token, tokenize
 
@@ -797,6 +798,8 @@ class Parser:
         if self.match_kw("DATABASE") or self.match_kw("SCHEMA"):
             ine = self._parse_if_not_exists()
             return CreateDatabase(self.parse_identifier(), ine)
+        if self.at_kw("FLOW"):
+            return self.parse_create_flow()
         self.expect_kw("TABLE")
         ine = self._parse_if_not_exists()
         name = self.parse_object_name()
@@ -819,6 +822,28 @@ class Parser:
         if not stmt.external and stmt.columns and stmt.time_index is None:
             raise ParserError("missing TIME INDEX constraint in CREATE TABLE")
         return stmt
+
+    def parse_create_flow(self) -> CreateFlow:
+        """CREATE FLOW [IF NOT EXISTS] name [SINK TO table] AS SELECT ...
+        (reference: GreptimeDB flow DDL, simplified — the SELECT must be
+        a single-table aggregate over date_bin/date_trunc)."""
+        self.expect_kw("FLOW")
+        ine = self._parse_if_not_exists()
+        name = self.parse_identifier()
+        sink = None
+        if self.match_kw("SINK"):
+            self.expect_kw("TO")
+            sink = self.parse_identifier()
+        self.expect_kw("AS")
+        start_pos = self.peek().pos
+        if not self.at_kw("SELECT"):
+            raise ParserError("expected SELECT after CREATE FLOW ... AS")
+        query = self.parse_query()
+        end_pos = self.peek().pos if self.peek().kind != EOF \
+            else len(self.sql)
+        raw = self.sql[start_pos:end_pos].strip().rstrip(";").strip()
+        return CreateFlow(name=name, query=query, sink=sink,
+                          if_not_exists=ine, raw_sql=raw)
 
     def _parse_if_not_exists(self) -> bool:
         if self.match_kw("IF"):
@@ -969,6 +994,9 @@ class Parser:
         if self.match_kw("DATABASE") or self.match_kw("SCHEMA"):
             ie = self._parse_if_exists()
             return DropDatabase(self.parse_identifier(), ie)
+        if self.match_kw("FLOW"):
+            ie = self._parse_if_exists()
+            return DropFlow(self.parse_identifier(), ie)
         self.expect_kw("TABLE")
         ie = self._parse_if_exists()
         return DropTable(self.parse_object_name(), ie)
@@ -1106,6 +1134,11 @@ class Parser:
                 database = self.parse_identifier()
             like, where = self._parse_show_filter()
             return ShowTables(database, like, where, full)
+        if self.match_kw("FLOWS"):
+            like, where = self._parse_show_filter()
+            if where is not None:
+                raise ParserError("SHOW FLOWS supports LIKE, not WHERE")
+            return ShowFlows(like)
         if self.match_kw("CREATE"):
             self.expect_kw("TABLE")
             return ShowCreateTable(self.parse_object_name())

@@ -4,8 +4,9 @@ The north-star maintenance job (BASELINE config 5: 1s→1m downsample).
 The reference has no downsample in v0.2 — its compaction only merges
 files — so this is a capability extension: a background job that reduces
 every (series, bucket) group with the scatter-free sorted-segment TPU
-kernel and writes the result into a destination region whose time index
-carries the bucket timestamps.
+kernel and writes the result into a destination whose time index carries
+the bucket timestamps. The continuous-flow subsystem (flow/manager.py)
+drives the same reducer incrementally from a per-flow watermark.
 
 TPU-first data flow: the job rides the SAME device-resident merged-scan
 cache the query path uses (`query/tpu_exec.SCAN_CACHE`) — on a region
@@ -20,7 +21,7 @@ kernel execution instead of serializing behind it.
 from __future__ import annotations
 
 import logging
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -28,15 +29,38 @@ logger = logging.getLogger(__name__)
 
 _SUPPORTED = ("avg", "sum", "min", "max", "count", "first", "last")
 
+#: one output column: (destination column name, op, source field or None).
+#: A None source means count-rows — the op must be "count" (count(*)).
+AggSpec = Tuple[str, str, Optional[str]]
+
+
+def _normalize_aggs(src_schema, aggs: Union[None, Dict[str, str],
+                                            Sequence[AggSpec]]
+                    ) -> List[AggSpec]:
+    """Accept the legacy field→op dict (dest column = field name) or the
+    flow-style (dest, op, src) triples; default to avg of every numeric
+    field."""
+    if aggs is None:
+        fields = [c.name for c in src_schema.field_columns()
+                  if not src_schema.column_schema(c.name).dtype.is_string]
+        return [(f, "avg", f) for f in fields]
+    if isinstance(aggs, dict):
+        return [(f, op, f) for f, op in aggs.items()]
+    return [tuple(a) for a in aggs]
+
 
 def downsample_region(src, dst, *, stride_ms: int,
-                      aggs: Optional[Dict[str, str]] = None,
-                      time_range=None) -> int:
-    """Aggregate `src` rows into `stride_ms` buckets and append to `dst`.
+                      aggs: Union[None, Dict[str, str],
+                                  Sequence[AggSpec]] = None,
+                      time_range=None, origin_ms: int = 0) -> int:
+    """Aggregate `src` rows into `stride_ms` buckets and write to `dst`.
 
-    aggs maps field name → op (default: avg for every numeric field).
-    Destination schema must have the same tags, a timestamp column, and the
-    aggregated field columns. Returns the number of rows written."""
+    `dst` may be a Region (direct WriteBatch) or a Table — a partitioned
+    table routes destination rows through its partition rule
+    (partition/splitter.py), so multi-region rollup tables work.
+    Re-running over an already-folded window is idempotent: bucket rows
+    carry the same (tags, bucket_ts) key, so MVCC dedup keeps the newest
+    fold. Returns the number of bucket rows written."""
     import jax
 
     from ..ops.kernels import shape_bucket, sorted_grouped_aggregate
@@ -44,13 +68,12 @@ def downsample_region(src, dst, *, stride_ms: int,
     from .write_batch import WriteBatch
 
     schema = src.schema
-    field_names = [c.name for c in schema.field_columns()
-                   if not schema.column_schema(c.name).dtype.is_string]
-    if aggs is None:
-        aggs = {f: "avg" for f in field_names}
-    for f, op in aggs.items():
+    agg_specs = _normalize_aggs(schema, aggs)
+    for dest, op, col in agg_specs:
         if op not in _SUPPORTED:
             raise ValueError(f"unsupported downsample op {op}")
+        if col is None and op != "count":
+            raise ValueError(f"{op} needs a source column")
 
     # merged + MVCC-deduped view, sorted by (series, ts); PUT rows only
     # (tombstones are dropped by the merge). Device mirrors of ts/fields
@@ -74,7 +97,7 @@ def downsample_region(src, dst, *, stride_ms: int,
     # run ids over (series, bucket): rows are sorted by (series, ts) so
     # pair changes are run boundaries — vectorized host pass, and the
     # segment ends ship with the call (no device binary search)
-    buckets = ts // stride_ms
+    buckets = (ts - origin_ms) // stride_ms
     flags = np.empty(n, dtype=bool)
     flags[0] = True
     np.not_equal(sids[1:], sids[:-1], out=flags[1:])
@@ -90,18 +113,20 @@ def downsample_region(src, dst, *, stride_ms: int,
     # with host-precomputed ends the kernel reads gids only for first/last
     # (arg-extreme tie-break); every other op works off the segment bounds,
     # so the O(n) rid upload is skipped and ts stands in for shape
-    needs_gids = any(op in ("first", "last") for op in aggs.values())
+    needs_gids = any(op in ("first", "last") for _, op, _ in agg_specs)
     d_rid = jax.device_put(rid) if needs_gids else d_ts
 
     values, col_masks, ops, slots = [], [], [], []
-    for fname in field_names:
-        if fname not in aggs:
-            continue
-        op = aggs[fname]
-        values.append(d_ts if op == "count" else scan.device_field(fname))
-        col_masks.append(scan.device_valid(fname))
+    for dest, op, col in agg_specs:
+        if col is None:
+            values.append(d_ts)            # count(*): mask-only reduce
+            col_masks.append(scan.device_valid_all())
+        else:
+            values.append(d_ts if op == "count"
+                          else scan.device_field(col))
+            col_masks.append(scan.device_valid(col))
         ops.append(op)
-        slots.append(fname)
+        slots.append(dest)
 
     run_ends = np.full(nbucket, n, dtype=np.int32)
     run_ends[:nruns - 1] = run_starts[1:]
@@ -114,7 +139,7 @@ def downsample_region(src, dst, *, stride_ms: int,
     # (dispatch above is async); the single batched fetch below is the
     # only synchronization point
     out_sids = sids[run_starts]
-    out_ts = buckets[run_starts] * stride_ms
+    out_ts = buckets[run_starts] * stride_ms + origin_ms
     counts, results = jax.device_get((counts, list(results)))
     counts = counts[:nruns]
     live = counts > 0
@@ -126,18 +151,24 @@ def downsample_region(src, dst, *, stride_ms: int,
         cols[tag] = sd.decode_tag_column(out_sids, i)
     ts_name = dst.schema.timestamp_column.name
     cols[ts_name] = out_ts
-    for fname, op, res in zip(slots, ops, results):
+    for dest, res in zip(slots, results):
         vals = np.asarray(res)[:nruns][live].astype(np.float64)
         nan = np.isnan(vals)
-        cols[fname] = vals if not nan.any() else \
+        cols[dest] = vals if not nan.any() else \
             [None if m else float(v) for v, m in zip(vals, nan)]
 
     n_out = len(out_ts)
     if n_out == 0:
         return 0
-    wb = WriteBatch(dst.schema)
-    wb.put(cols)
-    dst.write(wb)
+    if hasattr(dst, "regions"):
+        # table destination: insert() splits rows per the partition rule
+        dst.insert(cols)
+    else:
+        wb = WriteBatch(dst.schema)
+        wb.put(cols)
+        dst.write(wb)
     logger.info("downsampled %s -> %s: %d rows into %d buckets (stride %dms)",
-                src.name, dst.name, n, n_out, stride_ms)
+                src.name, getattr(dst, "name", dst.info.name
+                                  if hasattr(dst, "info") else "?"),
+                n, n_out, stride_ms)
     return n_out

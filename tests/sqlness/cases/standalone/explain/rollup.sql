@@ -1,0 +1,43 @@
+-- EXPLAIN golden pinning the `rollup-rewrite` dispatch (ISSUE 3): a
+-- GROUP BY date_bin whose stride is a multiple of a flow's stride is
+-- re-targeted at the rollup sink; the rewrite line leads, the sink's
+-- own dispatch decision follows. Plain EXPLAIN never folds, so the
+-- sink stays empty (est_rows=0) and the text is deterministic.
+
+CREATE TABLE cpu_roll (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+INSERT INTO cpu_roll VALUES
+    ('a', 0, 1.0), ('a', 60000, 2.0), ('b', 0, 3.0);
+
+CREATE FLOW cpu_roll_1m AS
+    SELECT host, date_bin(INTERVAL '1 minute', ts) AS b,
+           sum(v) AS v_sum, count(v) AS v_cnt
+    FROM cpu_roll GROUP BY host, b;
+
+-- stride 5m = 5 x flow stride: rewritten onto the sink
+EXPLAIN SELECT host, date_bin(INTERVAL '5 minutes', ts) AS b,
+               sum(v), avg(v)
+        FROM cpu_roll GROUP BY host, b;
+
+-- aligned time range + tag filter still rewrite
+EXPLAIN SELECT date_bin(INTERVAL '1 minute', ts) AS b, count(v)
+        FROM cpu_roll WHERE host = 'a' AND ts >= 60000 GROUP BY b;
+
+-- 90s is not a multiple of 1m: raw scan
+EXPLAIN SELECT date_bin(INTERVAL '90 seconds', ts) AS b, sum(v)
+        FROM cpu_roll GROUP BY b;
+
+-- an aggregate the flow does not store: raw scan
+EXPLAIN SELECT date_bin(INTERVAL '5 minutes', ts) AS b, stddev(v)
+        FROM cpu_roll GROUP BY b;
+
+DROP FLOW cpu_roll_1m;
+
+DROP TABLE cpu_roll_1m;
+
+DROP TABLE cpu_roll;

@@ -1,0 +1,72 @@
+-- Continuous rollup flows (ISSUE 3): CREATE/SHOW/DROP FLOW lifecycle +
+-- error cases. The `watermark` column is wall-advancing state and is
+-- normalized by the runner; rows_folded is deterministic because the
+-- only fold here is the rollup-rewritten SELECT's refresh.
+
+CREATE TABLE cpu_flow (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+INSERT INTO cpu_flow VALUES
+    ('a', 0, 1.0), ('a', 30000, 3.0), ('a', 60000, 5.0),
+    ('a', 90000, 7.0), ('b', 0, 10.0), ('b', 30000, 30.0),
+    ('b', 60000, 50.0), ('b', 90000, 70.0);
+
+CREATE FLOW cpu_flow_1m AS
+    SELECT host, date_bin(INTERVAL '1 minute', ts) AS b,
+           sum(v) AS v_sum, count(v) AS v_cnt
+    FROM cpu_flow GROUP BY host, b;
+
+SHOW FLOWS;
+
+-- the sink is an ordinary table
+SHOW TABLES LIKE 'cpu_flow_1m';
+
+-- a compatible coarser query is served via the rollup (and its refresh
+-- folds the pending rows first, advancing the watermark)
+SELECT host, date_bin(INTERVAL '2 minutes', ts) AS b, sum(v), count(v), avg(v)
+FROM cpu_flow GROUP BY host, b ORDER BY host, b;
+
+-- the sink now holds one row per (host, minute)
+SELECT host, ts, v_sum, v_cnt FROM cpu_flow_1m ORDER BY host, ts;
+
+SHOW FLOWS;
+
+-- error: avg is not incrementally mergeable (store sum + count)
+CREATE FLOW bad_avg AS
+    SELECT avg(v) FROM cpu_flow
+    GROUP BY date_bin(INTERVAL '1 minute', ts);
+
+-- error: non-derivable aggregate
+CREATE FLOW bad_agg AS
+    SELECT stddev(v) FROM cpu_flow
+    GROUP BY date_bin(INTERVAL '1 minute', ts);
+
+-- error: zero stride
+CREATE FLOW bad_stride AS
+    SELECT sum(v) FROM cpu_flow
+    GROUP BY date_bin(INTERVAL '0 minutes', ts);
+
+-- error: no time bucket at all
+CREATE FLOW bad_groups AS
+    SELECT host, sum(v) FROM cpu_flow GROUP BY host;
+
+-- error: duplicate flow
+CREATE FLOW cpu_flow_1m AS
+    SELECT sum(v) AS v_sum FROM cpu_flow
+    GROUP BY date_bin(INTERVAL '1 minute', ts);
+
+DROP FLOW cpu_flow_1m;
+
+SHOW FLOWS;
+
+DROP FLOW cpu_flow_1m;
+
+DROP FLOW IF EXISTS cpu_flow_1m;
+
+DROP TABLE cpu_flow_1m;
+
+DROP TABLE cpu_flow;

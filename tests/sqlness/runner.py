@@ -66,12 +66,18 @@ def _strip_comment_lines(stmt: str) -> str:
     return "\n".join(lines).strip()
 
 
+#: column name -> placeholder: wall-clock / wall-advancing columns whose
+#: values cannot byte-compare across runs (elapsed_ms in EXPLAIN ANALYZE;
+#: flow watermark timestamps in SHOW FLOWS / information_schema.flows)
+_VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>"}
+
+
 def _normalize_timings(out):
-    """Replace wall-clock columns (elapsed_ms in EXPLAIN ANALYZE output)
-    with a fixed placeholder so goldens byte-compare across runs — the
-    runner's stand-in for reference sqlness' result REPLACE directives.
-    Rebuilds the batch with the column retyped to STRING so the pretty
-    table renders identical widths every run."""
+    """Replace volatile columns with fixed placeholders so goldens
+    byte-compare across runs — the runner's stand-in for reference
+    sqlness' result REPLACE directives. Rebuilds the batch with the
+    column retyped to STRING so the pretty table renders identical
+    widths every run."""
     from greptimedb_tpu.datatypes import data_type as dt
     from greptimedb_tpu.datatypes.record_batch import RecordBatch
     from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
@@ -79,15 +85,16 @@ def _normalize_timings(out):
 
     if not out.is_batches or not out.batches:
         return out
-    if not any("elapsed_ms" in b.schema.names() for b in out.batches):
+    if not any(set(b.schema.names()) & set(_VOLATILE_COLUMNS)
+               for b in out.batches):
         return out
     batches = []
     for b in out.batches:
         data = b.to_pydict()
         cols = []
         for cs in b.schema.column_schemas:
-            if cs.name == "elapsed_ms":
-                data[cs.name] = ["<elapsed>"] * b.num_rows
+            if cs.name in _VOLATILE_COLUMNS:
+                data[cs.name] = [_VOLATILE_COLUMNS[cs.name]] * b.num_rows
                 cols.append(ColumnSchema(cs.name, dt.STRING))
             else:
                 cols.append(cs)
