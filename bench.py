@@ -242,6 +242,80 @@ def bench_rollup_e2e(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_ingest_failpoint_overhead(n_rows: int):
+    """Fourth driver metric (ISSUE 4): bulk-ingest throughput with the
+    failpoint layer compiled in but INACTIVE, differentialed against the
+    same ingest with every failpoint call stubbed out entirely. The
+    instrumented sites are one module-bool branch each, so the ratio must
+    sit inside run-to-run noise — BASELINE.md publishes the numbers and
+    the assert here keeps future instrumentation honest."""
+    import shutil
+    import tempfile
+    import timeit
+
+    from greptimedb_tpu.common import failpoint as fp
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+
+    assert fp.active_count() == 0
+    # (a) raw cost of one inactive fail_point() evaluation
+    per_call_ns = timeit.timeit(
+        lambda: fp.fail_point("wal_append"), number=1_000_000) * 1e3
+
+    # (b) end-to-end bulk ingest, instrumented vs stubbed
+    rng = np.random.default_rng(11)
+    hosts = 200
+    per = n_rows // hosts
+    host = np.repeat(np.array([f"host_{i}" for i in range(hosts)]),
+                     per).astype(object)
+    ts = np.tile(np.arange(per, dtype=np.int64) * 1000, hosts)
+    vals = rng.random(hosts * per)
+
+    def ingest_once() -> float:
+        tmpdir = tempfile.mkdtemp(prefix="bench-fp-")
+        try:
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=tmpdir, register_numbers_table=False))
+            dn.start()
+            from greptimedb_tpu.frontend.instance import FrontendInstance
+            fe = FrontendInstance(dn)
+            fe.start()
+            fe.do_query("CREATE TABLE cpu (hostname STRING, ts TIMESTAMP "
+                        "TIME INDEX, usage_user DOUBLE, "
+                        "PRIMARY KEY(hostname))")
+            table = fe.catalog.table("greptime", "public", "cpu")
+            t0 = time.perf_counter()
+            table.bulk_load({"hostname": host, "ts": ts,
+                             "usage_user": vals})
+            dt = time.perf_counter() - t0
+            fe.shutdown()
+            return dt
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    ingest_once()                         # absorb one-time costs
+    # interleave the two configurations (best of 2 each) so slow-drift
+    # on a shared box lands on both sides of the differential
+    saved = (fp.fail_point, fp.fires)
+    dt_instrumented = dt_stubbed = float("inf")
+    try:
+        for _ in range(2):
+            fp.fail_point, fp.fires = saved
+            dt_instrumented = min(dt_instrumented, ingest_once())
+            fp.fail_point = lambda name: None   # the layer compiled "out"
+            fp.fires = lambda name: False
+            dt_stubbed = min(dt_stubbed, ingest_once())
+    finally:
+        fp.fail_point, fp.fires = saved
+    ratio = dt_stubbed / dt_instrumented  # 1.0 = zero overhead
+    # instrumented must stay within noise of stubbed-out: on a 2-vCPU
+    # shared box run-to-run jitter is ~±10%; a 30% wall-clock regression
+    # would mean someone put a failpoint in a per-row loop
+    assert ratio >= 0.7, (
+        f"inactive failpoint layer cost {1/ratio:.2f}x on bulk ingest")
+    return len(ts) / dt_instrumented, ratio, per_call_ns
+
+
 def main():
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
@@ -287,6 +361,18 @@ def main():
         "unit": "Mrows/s",
         "vs_raw_scan": round(vs_raw, 2),
         "rows": roll_rows,
+    }))
+
+    fp_rows = int(os.environ.get("GREPTIME_BENCH_FAILPOINT_ROWS",
+                                 2_000_000))
+    ingest_rps, fp_ratio, fp_ns = bench_ingest_failpoint_overhead(fp_rows)
+    print(json.dumps({
+        "metric": "bulk_ingest_e2e_throughput",
+        "value": round(ingest_rps / 1e6, 2),
+        "unit": "Mrows/s",
+        "rows": fp_rows,
+        "failpoint_inactive_ratio": round(fp_ratio, 3),
+        "failpoint_inactive_ns_per_call": round(fp_ns, 1),
     }))
 
 

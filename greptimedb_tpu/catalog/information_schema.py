@@ -52,6 +52,13 @@ _RUNTIME_METRICS_SCHEMA = Schema([
     ColumnSchema("kind", dt.STRING),
 ])
 
+_FAILPOINTS_SCHEMA = Schema([
+    ColumnSchema("name", dt.STRING),
+    ColumnSchema("action", dt.STRING, nullable=True),
+    ColumnSchema("hits", dt.INT64),
+    ColumnSchema("fires", dt.INT64),
+])
+
 _FLOWS_SCHEMA = Schema([
     ColumnSchema("flow_name", dt.STRING),
     ColumnSchema("source_table", dt.STRING),
@@ -235,6 +242,18 @@ def information_schema_table(catalog_manager, catalog_name: str,
                     spec.stats.get("buckets_written", 0))
             return rows
         return _VirtualTable("flows", _FLOWS_SCHEMA, build_flows)
+    if name == "failpoints":
+        def build_failpoints():
+            from ..common import failpoint
+            points = failpoint.list_points()
+            return {
+                "name": [p["name"] for p in points],
+                "action": [p["action"] for p in points],
+                "hits": [p["hits"] for p in points],
+                "fires": [p["fires"] for p in points],
+            }
+        return _VirtualTable("failpoints", _FAILPOINTS_SCHEMA,
+                             build_failpoints)
     if name == "runtime_metrics":
         def build_metrics():
             samples = _prometheus_samples() + \

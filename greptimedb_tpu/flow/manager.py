@@ -31,12 +31,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import failpoint as _fp
 from ..common.time import TimestampRange, TimeUnit
 from ..errors import (InvalidArgumentsError, PlanError, TableNotFoundError,
                       UnsupportedError)
 from ..sql import ast
 
 logger = logging.getLogger(__name__)
+
+_fp.register("flow_fold")
+_fp.register("flow_fold_commit")
 
 #: aggregate ops a flow can materialize. avg is intentionally absent: it
 #: is not mergeable across folds — store sum + count and avg queries are
@@ -592,6 +596,7 @@ class FlowManager:
                            spec.key)
             return 0
         with self._fold_lock:
+            _fp.fail_point("flow_fold")
             wm_before = json.dumps(spec.watermarks, sort_keys=True)
             with span("flow_fold", flow=spec.name, source=spec.source,
                       sink=spec.sink), timer("flow_fold"):
@@ -618,6 +623,10 @@ class FlowManager:
             # background tick must not PUT a byte-identical doc per flow
             dirty = bool(written or new_rows) or \
                 json.dumps(spec.watermarks, sort_keys=True) != wm_before
+            # crash HERE = sink rows written, watermark never persisted:
+            # the reopened flow re-folds the same window, and sink MVCC
+            # overwrite keeps the re-fold idempotent (no double counting)
+            _fp.fail_point("flow_fold_commit")
             with self._lock:
                 if dirty and self.store is not None and \
                         spec.key in self._flows:

@@ -391,6 +391,30 @@ class StatementExecutor:
             except (TypeError, ValueError):
                 raise InvalidArgumentsError(
                     f"SET {stmt.name}: expected 0 or 1, got {stmt.value!r}")
+        elif name.startswith("failpoint_"):
+            # fault-injection surface: SET failpoint_<point> = 'action'
+            # ('off' or 0 disarms). Same registry as GREPTIME_FAILPOINTS
+            # and /v1/admin/failpoints (common/failpoint.py).
+            from ..common import failpoint
+            point = name[len("failpoint_"):]
+            spec = str(stmt.value)
+            try:
+                failpoint.configure(point, None if spec in ("0", "off")
+                                    else spec)
+            except ValueError as e:
+                raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+        elif name in ("objstore_max_retries", "objstore_retry_base_ms"):
+            from ..storage.retry import configure_retry
+            try:
+                value = int(stmt.value)
+            except (TypeError, ValueError):
+                raise InvalidArgumentsError(
+                    f"SET {stmt.name}: expected an integer, "
+                    f"got {stmt.value!r}")
+            if name == "objstore_max_retries":
+                configure_retry(max_retries=value)
+            else:
+                configure_retry(base_ms=value)
         elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
             try:
                 value = int(stmt.value)

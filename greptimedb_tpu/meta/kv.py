@@ -10,6 +10,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..common import failpoint as _fp
+
+_fp.register("meta_kv_put")
+
 
 class MemKv:
     def __init__(self):
@@ -107,16 +111,14 @@ class FileKv(MemKv):
             self._data = {k: base64.b64decode(v) for k, v in doc.items()}
 
     def _persist_locked(self) -> None:
-        import os
-        import tempfile
+        from ..utils import atomic_write
+        _fp.fail_point("meta_kv_put")
         doc = {k: self._b64.b64encode(v).decode()
                for k, v in self._data.items()}
-        d = os.path.dirname(self._path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".kv-")
-        with os.fdopen(fd, "w") as f:
-            self._json.dump(doc, f)
-        os.replace(tmp, self._path)
+        # fsync before the rename: the rename alone orders directory
+        # metadata, not the data blocks — a power cut could otherwise
+        # promote an empty/short snapshot
+        atomic_write(self._path, self._json.dumps(doc), tmp_prefix=".kv-")
 
     def put(self, key, value):
         with self._lock:

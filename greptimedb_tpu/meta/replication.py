@@ -32,7 +32,6 @@ from __future__ import annotations
 import json
 import os
 import random
-import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -130,12 +129,12 @@ class RaftNode:
 
     # ---- persistence ----
     def _write_json(self, path: str, doc: dict) -> None:
-        d = os.path.dirname(path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-")
-        with os.fdopen(fd, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
+        # no fsync: this persists on EVERY log append and the raft quorum
+        # (not the disk) is the durability story — the atomic rename alone
+        # guarantees a reader never sees a half-written doc
+        from ..utils import atomic_write
+        atomic_write(path, json.dumps(doc), fsync=False,
+                     tmp_prefix=".raft-")
 
     def _persist_locked(self) -> None:
         """Persist term/vote and the (compaction-bounded) log tail. The

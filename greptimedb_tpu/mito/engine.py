@@ -440,11 +440,16 @@ class MitoEngine(TableEngine):
             ropts = region_opts_from_table_options(info.meta.options)
             opened = {}
             for rn in region_numbers:
+                # no orphan sweep on adoption: fencing a partitioned-but-
+                # alive old owner is future lease work, and sweeping here
+                # could delete the old owner's mid-flush output right
+                # before its manifest commit references it
+                adopt_opts = {**(ropts or {}), "sweep_orphans": False}
                 region = self.storage.open_region(
-                    region_name(tid, rn), schema, opts=ropts)
+                    region_name(tid, rn), schema, opts=adopt_opts)
                 if region is None:
                     region = self.storage.create_region(
-                        region_name(tid, rn), schema, opts=ropts)
+                        region_name(tid, rn), schema, opts=adopt_opts)
                 opened[rn] = region
             if table is None:
                 rule = _deserialize_rule(info.meta.partition_rule)

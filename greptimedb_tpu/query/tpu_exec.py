@@ -32,9 +32,12 @@ from ..sql.ast import (
     Between, BinaryOp, Column, Expr, FunctionCall, InList, Interval, IsNull,
     Literal, Query, UnaryOp,
 )
+from ..common.failpoint import register as _fp_register
 from .expr import Evaluator, expr_name
 from .functions import TPU_AGGREGATES, parse_interval_ms
 from .planner import Analysis, _group_slot
+
+_fp_register("scan_cache_incremental")
 
 _CMP_OPS = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
             ">=": "ge"}
@@ -186,9 +189,27 @@ class _ScanCache:
                 self._last.outcome = "hit"
                 increment_counter("scan_cache_hit")
                 return entry.scan
-            self._last.outcome = "incremental"
-            increment_counter("scan_cache_incremental")
-            scan = self._incremental(region, snap, v, entry, visible)
+            try:
+                from ..common.failpoint import fail_point
+                fail_point("scan_cache_incremental")
+                scan = self._incremental(region, snap, v, entry, visible)
+                self._last.outcome = "incremental"
+                increment_counter("scan_cache_incremental")
+            except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                # a corrupt/unusable cached scan must never fail the
+                # query: drop the entry and rebuild cold from storage —
+                # counted as a miss (that is what the reader pays), plus
+                # the recovery marker for dashboards
+                import logging
+                logging.getLogger(__name__).warning(
+                    "scan cache entry for region %s unusable (%s); "
+                    "rebuilding cold", region.name, e)
+                increment_counter("scan_cache_recovered")
+                increment_counter("scan_cache_miss")
+                with self._lock:
+                    self._entries.pop(region.uid, None)
+                self._last.outcome = "full"
+                scan = self._full(region, snap)
         else:
             self._last.outcome = "full"
             increment_counter("scan_cache_miss")

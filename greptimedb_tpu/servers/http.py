@@ -85,6 +85,7 @@ class HttpServer:
         r.add_post("/v1/admin/flush", self.handle_flush)
         r.add_post("/v1/admin/compact", self.handle_compact)
         r.add_post("/v1/admin/downsample", self.handle_downsample)
+        r.add_route("*", "/v1/admin/failpoints", self.handle_failpoints)
         r.add_post("/v1/scripts", self.handle_scripts)
         r.add_post("/v1/run-script", self.handle_run_script)
         r.add_get("/v1/prof/mem", self.handle_mem_prof)
@@ -455,6 +456,14 @@ class HttpServer:
         store = getattr(self.frontend.datanode, "store", None) \
             if hasattr(self.frontend, "datanode") else None
         ratio = store.hit_ratio() if hasattr(store, "hit_ratio") else None
+        # degraded-mode health: regions whose background flush/compaction
+        # has been failing, and the fault-injection state (robustness PR)
+        background_errors = {}
+        for r in regions:
+            errs = getattr(r, "bg_errors", None)
+            if errs:
+                background_errors[r.name] = errs
+        from ..common import failpoint
         return web.json_response({
             "version": __version__,
             "uptime_s": round(time.time() - self._start_time, 3),
@@ -463,6 +472,8 @@ class HttpServer:
             "scan_cache_resident_bytes": SCAN_CACHE.resident_bytes(),
             "last_ingest_profile": ingest,
             "last_scan_profile": scan,
+            "background_errors": background_errors,
+            "failpoints_active": failpoint.active_count(),
         })
 
     async def handle_flush(self, request):
@@ -497,6 +508,57 @@ class HttpServer:
                     region.compact()
 
         await loop.run_in_executor(None, work)
+        return web.json_response({"code": 0})
+
+    async def handle_failpoints(self, request):
+        """Fault-injection admin surface (common/failpoint.py):
+
+        - GET  /v1/admin/failpoints                  — list points
+        - POST /v1/admin/failpoints?name=X&action=A  — arm (A='off' clears)
+        - DELETE /v1/admin/failpoints[?name=X]       — disarm one / all
+        """
+        from ..common import failpoint
+        self.user_provider.auth_http_basic(
+            request.headers.get("Authorization"))
+        if request.method == "GET":
+            return web.json_response({"code": 0,
+                                      "failpoints": failpoint.list_points()})
+        if request.method == "DELETE":
+            name = request.query.get("name")
+            if name:
+                try:
+                    failpoint.configure(name, None)
+                except ValueError as e:
+                    return web.json_response(
+                        {"code": int(StatusCode.INVALID_ARGUMENTS),
+                         "error": str(e)}, status=400)
+            else:
+                failpoint.clear_all()
+            return web.json_response({"code": 0})
+        if request.method != "POST":
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": f"unsupported method {request.method}"},
+                status=405)
+        name = await self._param(request, "name")
+        action = await self._param(request, "action")
+        if not name:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "missing 'name' parameter"}, status=400)
+        if not action:
+            # a bare POST must not silently disarm a live experiment —
+            # DELETE is the disarm surface
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "missing 'action' parameter ('off' or DELETE "
+                          "disarms)"}, status=400)
+        try:
+            failpoint.configure(name, action)
+        except ValueError as e:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": str(e)}, status=400)
         return web.json_response({"code": 0})
 
     async def handle_downsample(self, request):

@@ -16,7 +16,10 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional
 
+from ..common import failpoint as _fp
 from .object_store import ObjectStore
+
+_fp.register("cache_read")
 
 
 class LruCacheLayer(ObjectStore):
@@ -108,17 +111,37 @@ class LruCacheLayer(ObjectStore):
         with self._lock:
             if key in self._entries:
                 self._touch(key)
-                self.hits += 1
                 path = self._cache_path(key)
+                expect_size = self._entries[key]
             else:
                 path = None
         if path is not None:
             try:
+                _fp.fail_point("cache_read")
                 with open(path, "rb") as f:
                     data = f.read()
+                if len(data) != expect_size:
+                    # truncated/overwritten cache blob: disk corruption,
+                    # not a miss — fall through to a cold read
+                    raise OSError(
+                        f"cache blob for {key} is {len(data)}B, "
+                        f"expected {expect_size}B")
+                # count the hit only once the blob actually served: a
+                # corrupt entry must not inflate the hit ratio AND the
+                # miss counter for one read
+                self.hits += 1
                 increment_counter("read_cache_hit")
                 return data
             except FileNotFoundError:
+                self._invalidate(key)
+            except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                # a corrupt cache entry must never surface to the reader:
+                # drop it and serve the authoritative backend copy cold
+                import logging
+                logging.getLogger(__name__).warning(
+                    "read cache entry for %s unusable (%s); falling back "
+                    "to cold read", key, e)
+                increment_counter("read_cache_corrupt")
                 self._invalidate(key)
         self.misses += 1
         increment_counter("read_cache_miss")

@@ -46,9 +46,17 @@ class StorageEngine:
     def __init__(self, config: EngineConfig,
                  store: Optional[ObjectStore] = None):
         from .file_purger import FilePurger
+        from .retry import RetryingObjectStore
         from .scheduler import LocalScheduler, RepeatedTask
         self.config = config
-        self.store = store or FsObjectStore(os.path.join(config.data_home, "data"))
+        if store is None:
+            # default Fs store rides behind the retry layer too: local
+            # disks rarely fault transiently, but injected faults (and
+            # network filesystems) do — and the wrapper is one branch per
+            # object op, invisible next to the IO it guards
+            store = RetryingObjectStore(
+                FsObjectStore(os.path.join(config.data_home, "data")))
+        self.store = store
         self.wal_home = os.path.join(config.data_home, "wal")
         self._regions: Dict[str, Region] = {}
         self._lock = threading.Lock()

@@ -19,7 +19,11 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import failpoint as _fp
 from .object_store import ObjectStore
+
+_fp.register("manifest_commit")
+_fp.register("manifest_checkpoint")
 
 _DELTA_RE = re.compile(r"^(\d{20})\.json$")
 _CKPT_RE = re.compile(r"^(\d{20})\.checkpoint\.json$")
@@ -39,6 +43,7 @@ class RegionManifest:
     def save(self, actions: List[dict]) -> int:
         """Append an action list; returns the new manifest version."""
         with self._lock:
+            _fp.fail_point("manifest_commit")
             self._version += 1
             v = self._version
             key = f"{self.dir}/{v:020d}.json"
@@ -49,6 +54,7 @@ class RegionManifest:
 
     def save_checkpoint(self, state: dict) -> None:
         with self._lock:
+            _fp.fail_point("manifest_checkpoint")
             v = self._version
             if v < 0:
                 return

@@ -112,6 +112,8 @@ class NativeWal(Wal):
     # ---- overridden hot path ----
     def append(self, seq: int, payload: bytes,
                schema_version: int = 0) -> None:
+        from ..common.failpoint import fail_point
+        fail_point("wal_append")
         handle = self._handle
         if handle is None:
             raise StorageError("append on closed NativeWal")
@@ -122,7 +124,9 @@ class NativeWal(Wal):
         from ..common.telemetry import increment_counter
         increment_counter("wal_bytes", len(payload))
         if self.sync_on_write:
+            from ..common.failpoint import fail_point
             from ..common.telemetry import timer
+            fail_point("wal_fsync")
             with timer("wal_fsync"):
                 rc = self._libref.wal_wait(handle, ticket, 30_000)
             if rc != 0:
@@ -143,13 +147,14 @@ class NativeWal(Wal):
         for i, (first, path) in enumerate(segs):
             if i + 1 < len(segs) and segs[i + 1][0] <= start_seq:
                 continue
-            records, clean = self._read_segment(path, start_seq)
+            records, clean, good_pos = self._read_segment(path, start_seq)
             yield from records
             if not clean:
                 if i + 1 < len(segs):
                     raise StorageError(
                         f"corrupt WAL record mid-log in {path}; refusing "
                         f"to replay past the gap")
+                self._repair_torn_tail(path, good_pos)
                 return
 
     def obsolete(self, seq: int) -> None:

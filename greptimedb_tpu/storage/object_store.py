@@ -15,6 +15,12 @@ import tempfile
 import threading
 from typing import List, Optional
 
+from ..common import failpoint as _fp
+
+_fp.register("objstore_read")
+_fp.register("objstore_write")
+_fp.register("objstore_delete")
+
 
 class ObjectStore:
     """Flat key → bytes store. Keys use '/' separators."""
@@ -119,27 +125,17 @@ class FsObjectStore(ObjectStore):
         return p
 
     def read(self, key: str) -> bytes:
+        _fp.fail_point("objstore_read")
         with open(self._path(key), "rb") as f:
             return f.read()
 
     def write(self, key: str, data: bytes) -> None:
-        path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp-")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        _fp.fail_point("objstore_write")
+        from ..utils import atomic_write
+        atomic_write(self._path(key), data)
 
     def delete(self, key: str) -> None:
+        _fp.fail_point("objstore_delete")
         try:
             os.unlink(self._path(key))
         except FileNotFoundError:
@@ -180,6 +176,7 @@ def build_object_store(storage: dict, data_home: str) -> "ObjectStore":
     """Construct the configured backend (reference: datanode builds its
     object store from ObjectStoreConfig — Fs/S3/Oss — and optionally wraps
     the LRU disk cache, src/datanode/src/instance.rs:334-359)."""
+    from .retry import RetryingObjectStore
     kind = str(storage.get("type", "File")).lower()
     if kind in ("file", "fs"):
         store: ObjectStore = FsObjectStore(
@@ -195,6 +192,10 @@ def build_object_store(storage: dict, data_home: str) -> "ObjectStore":
             secret_access_key=storage.get("secret_access_key", "")))
     else:
         raise ValueError(f"unknown storage type {storage.get('type')!r}")
+    # transient faults (S3 5xx/429, socket resets, injected failpoints)
+    # retry with backoff before any engine code sees them; the cache
+    # layer stacks on top so cache hits never pay the wrapper
+    store = RetryingObjectStore(store)
     cache = storage.get("cache_path")
     if cache:
         from .cache import LruCacheLayer

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import failpoint as _fp
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
 from ..datatypes.vector import compat_column, null_column
@@ -40,6 +41,12 @@ from .write_batch import OP_DELETE, OP_PUT, WriteBatch
 from ..ops.kernels import merge_dedup_numpy
 
 logger = logging.getLogger(__name__)
+
+_fp.register("flush_commit")
+_fp.register("bulk_commit")
+_fp.register("compaction_commit")
+_fp.register("dict_persist")
+_fp.register("region_write_memtable")
 
 
 @dataclass
@@ -314,7 +321,8 @@ class Region:
                  compaction_time_window_ms: Optional[int] = None,
                  max_l0_files: int = 4,
                  stall_bytes: Optional[int] = None,
-                 wal_opts: Optional[dict] = None):
+                 wal_opts: Optional[dict] = None,
+                 sweep_orphans: bool = True):
         self.descriptor = descriptor
         self.name = descriptor.name
         # unique per in-process region object: cache keys must not collide
@@ -330,6 +338,11 @@ class Region:
         self.ttl_ms = ttl_ms
         self.compaction_time_window_ms = compaction_time_window_ms
         self.max_l0_files = max_l0_files
+        # open-time orphan-SST sweep switch: failover adoption on a SHARED
+        # object store must not sweep (an unfenced old owner may still be
+        # mid-flush; deleting its yet-uncommitted output would poison the
+        # manifest edit it is about to write)
+        self.sweep_orphans = sweep_orphans
         # writers stall when frozen-but-unflushed memtables pile up past
         # this (reference write-stall: src/storage/src/region/writer.rs:584)
         self.stall_bytes = stall_bytes if stall_bytes is not None \
@@ -361,6 +374,10 @@ class Region:
         self.version_control: Optional[VersionControl] = None
         self.last_ingest_profile: Optional[IngestProfile] = None
         self.last_scan_profile: Optional[ScanProfile] = None
+        # background-job health: consecutive failures drive retry backoff,
+        # lifetime counts + last error surface in /status
+        self._bg_failures: Dict[str, int] = {}
+        self.bg_errors: Dict[str, Dict] = {}
         self.closed = False
 
     # ---- lifecycle ----
@@ -453,8 +470,45 @@ class Region:
                           manifest_version=region.manifest._version)
         region.version_control = VersionControl(
             version, committed_sequence=max(committed_sequence, flushed_sequence))
+        if region.sweep_orphans:
+            region._sweep_orphan_ssts()
         region._replay_wal(flushed_sequence)
         return region
+
+    def _sweep_orphan_ssts(self) -> int:
+        """Delete SST files the recovered manifest does not reference.
+
+        At open the region is exclusive and the manifest is authoritative,
+        so an unreferenced parquet file is garbage from a crash: a flush /
+        compaction / bulk-ingest output whose manifest commit never landed,
+        or a compaction victim whose purger delete never ran. Sweeping here
+        keeps crashes from leaking storage forever (nothing else ever
+        revisits unreferenced files)."""
+        referenced = {f.file_name for f in
+                      self.version_control.current.ssts.all_files()}
+        prefix = f"{self.descriptor.region_dir}/sst/"
+        removed = 0
+        try:
+            keys = self.store.list(prefix)
+        except Exception as e:  # noqa: BLE001 — sweep must not fail open
+            logger.warning("region %s: orphan sweep list failed: %s",
+                           self.name, e)
+            return 0
+        for key in keys:
+            if key.rsplit("/", 1)[-1] in referenced:
+                continue
+            try:
+                self.store.delete(key)
+                removed += 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("region %s: orphan sweep could not delete "
+                               "%s: %s", self.name, key, e)
+        if removed:
+            from ..common.telemetry import increment_counter
+            increment_counter("orphan_ssts_purged", removed)
+            logger.warning("region %s: purged %d orphan SST file(s) left "
+                           "by a crash", self.name, removed)
+        return removed
 
     def _replay_wal(self, flushed_sequence: int) -> None:
         vc = self.version_control
@@ -490,8 +544,17 @@ class Region:
             vc = self.version_control
             seq = vc.next_sequence()
             with timer("wal_append"):
-                self.wal.append(seq, batch.encode(),
-                                schema_version=vc.current.schema.version)
+                try:
+                    self.wal.append(seq, batch.encode(),
+                                    schema_version=vc.current.schema.version)
+                except BaseException:
+                    # the record may already be durable (fsync failed AFTER
+                    # the write, an injected wal_fsync fault, a torn tail):
+                    # burn the sequence — reusing it would put two different
+                    # batches at one seq and make the replay winner undefined
+                    vc.set_committed_sequence(
+                        max(vc.committed_sequence, seq))
+                    raise
             # committed_sequence advances only after the memtable insert:
             # snapshot readers sample it without the writer lock, so rows
             # must be visible in the memtable before their sequence is —
@@ -500,6 +563,11 @@ class Region:
             # The finally still consumes the sequence on insert failure
             # (it hit the WAL; reuse would corrupt replay).
             try:
+                # crash HERE = killed between WAL append and memtable
+                # insert: the row is unacked but durable, so recovery may
+                # legally surface it (once) — the torture matrix asserts
+                # exactly that
+                _fp.fail_point("region_write_memtable")
                 vc.current.memtables.mutable.write(seq, batch)
             finally:
                 vc.set_committed_sequence(seq)
@@ -508,7 +576,7 @@ class Region:
                 if self.scheduler is None:
                     self.flush()          # no background pool: inline
                 else:
-                    self._freeze_and_schedule_flush()
+                    self._freeze_and_schedule_flush(background=True)
             stall = (self.version_control.current.memtables.total_bytes -
                      self.version_control.current.memtables.mutable_bytes
                      ) >= self.stall_bytes
@@ -715,6 +783,9 @@ class Region:
             }
             if dict_file:
                 edit["series_dict_file"] = dict_file
+            # crash HERE = SSTs durable but uncommitted: the batch was
+            # never acked, reopen must sweep the orphans and show nothing
+            _fp.fail_point("bulk_commit")
             mv = self.manifest.save([edit])
             vc.apply_flush(memtable_ids=[], files=files,
                            flushed_sequence=flushed_seq,
@@ -733,9 +804,17 @@ class Region:
         return n
 
     # ---- flush ----
-    def _freeze_and_schedule_flush(self):
+    #: background flush/compaction failures retry this many times with
+    #: exponential backoff before standing down until the next trigger
+    BG_MAX_RETRIES = 8
+
+    def _freeze_and_schedule_flush(self, background: bool = False):
         """Freeze the mutable memtable and queue a background flush.
-        Caller holds the writer lock."""
+        Caller holds the writer lock. background=True (the write-path
+        trigger, no caller waits) routes through the retrying wrapper:
+        a transient failure backs off and re-runs instead of wedging
+        the region behind a memtable backlog forever; the synchronous
+        flush() path keeps raw error propagation through its handle."""
         vc = self.version_control
         if vc.current.memtables.mutable.num_rows:
             vc.freeze_mutable(Memtable(vc.current.schema, self.series_dict))
@@ -743,13 +822,66 @@ class Region:
             return None
         self._flush_done.clear()
         try:
-            return self.scheduler.submit(f"flush:{self.uid}",
-                                         self._flush_job)
+            job = self._flush_job_bg if background else self._flush_job
+            return self.scheduler.submit(f"flush:{self.uid}", job)
         except RuntimeError:
             # engine shutting down: skip — the WAL keeps the frozen data
             # durable and replay restores it on the next open
             self._flush_done.set()
             return None
+
+    # ---- background-job degradation ----
+    def _flush_job_bg(self) -> List[FileMeta]:
+        try:
+            files = self._flush_job()
+        except Exception as e:  # noqa: BLE001 — retried below
+            self._note_bg_failure("flush", e)
+            return []
+        self._note_bg_success("flush")
+        return files
+
+    def _compact_job_bg(self) -> List[FileMeta]:
+        try:
+            files = self._compact_job()
+        except Exception as e:  # noqa: BLE001 — retried below
+            self._note_bg_failure("compaction", e)
+            return []
+        self._note_bg_success("compaction")
+        return files
+
+    def _note_bg_success(self, op: str) -> None:
+        self._bg_failures.pop(op, None)
+
+    def _note_bg_failure(self, op: str, e: Exception) -> None:
+        """A background flush/compaction failed: record it for /status,
+        then re-queue with exponential backoff. After BG_MAX_RETRIES
+        consecutive failures the job stands down (the next write-path
+        trigger starts a fresh attempt cycle) instead of spinning."""
+        from ..common.telemetry import increment_counter
+        n = self._bg_failures.get(op, 0) + 1
+        self._bg_failures[op] = n
+        info = self.bg_errors.setdefault(op, {"count": 0, "last_error": ""})
+        info["count"] += 1
+        info["last_error"] = f"{type(e).__name__}: {e}"
+        increment_counter(f"{op}_bg_failures")
+        if self.closed or self.scheduler is None:
+            return
+        if n > self.BG_MAX_RETRIES:
+            logger.error(
+                "region %s: background %s failed %d times (%s); standing "
+                "down until the next trigger", self.name, op, n, e)
+            self._bg_failures.pop(op, None)
+            return
+        delay = min(0.05 * (2 ** (n - 1)), 30.0)
+        increment_counter(f"{op}_bg_retries")
+        logger.warning(
+            "region %s: background %s failed (%s); retry %d/%d in %.2fs",
+            self.name, op, e, n, self.BG_MAX_RETRIES, delay)
+        if op == "flush":
+            key, fn = f"flush:{self.uid}", self._flush_job_bg
+        else:
+            key, fn = f"compact:{self.uid}", self._compact_job_bg
+        self.scheduler.submit_later(key, fn, delay)
 
     def flush(self) -> List[FileMeta]:
         """Flush all frozen + mutable data to L0 SSTs and wait for
@@ -766,7 +898,21 @@ class Region:
                 return self._flush_job()
         with self._writer_lock:
             handle = self._freeze_and_schedule_flush()
-        return handle.wait(timeout=600) if handle is not None else []
+            frozen = {m.id for m in
+                      self.version_control.current.memtables.immutables}
+        files = handle.wait(timeout=600) if handle is not None else []
+        # the submit may have coalesced onto an already-queued BACKGROUND
+        # flush whose failure is swallowed for retry — a synchronous flush
+        # must not report success while the memtables it froze are still
+        # unflushed (callers like /v1/admin/flush rely on the contract)
+        if not self.closed and frozen & {
+                m.id for m in
+                self.version_control.current.memtables.immutables}:
+            last = self.bg_errors.get("flush", {}).get("last_error",
+                                                       "unknown error")
+            raise StorageError(
+                f"flush of region {self.name} failed: {last}")
+        return files
 
     def _flush_job(self) -> List[FileMeta]:
         """Write every frozen memtable to L0 SSTs; record the edit in the
@@ -782,6 +928,11 @@ class Region:
 
     def _flush_job_inner(self) -> List[FileMeta]:
         from ..common.telemetry import increment_counter, span, timer
+        if self.closed:
+            # a delayed retry may fire after DROP destroyed the region
+            # dir: writing SSTs there would leak files forever (a dropped
+            # region never reopens, so no sweep collects them)
+            return []
         vc = self.version_control
         to_flush = list(vc.current.memtables.immutables)
         if not to_flush:
@@ -820,6 +971,10 @@ class Region:
             }
             if dict_file:
                 edit["series_dict_file"] = dict_file
+            # crash HERE = flush SSTs durable but uncommitted: the WAL
+            # still covers every frozen row, so reopen replays them and
+            # sweeps the orphan files — no loss, no duplication
+            _fp.fail_point("flush_commit")
             mv = self.manifest.save([edit])
             vc.apply_flush(memtable_ids=[m.id for m in to_flush],
                            files=files, flushed_sequence=flushed_seq,
@@ -857,6 +1012,7 @@ class Region:
     def _persist_series_dict(self) -> Optional[str]:
         if self.series_dict.num_series == self._persisted_series:
             return None
+        _fp.fail_point("dict_persist")
         name = f"dict/series-{self._dict_version}.json"
         self.store.write(f"{self.descriptor.region_dir}/{name}",
                          json.dumps(self.series_dict.to_dict()).encode())
@@ -887,12 +1043,23 @@ class Region:
         if self.scheduler is None:
             return self._compact_job()
         try:
-            handle = self.scheduler.submit(f"compact:{self.uid}",
-                                           self._compact_job)
+            # fire-and-forget submits degrade gracefully (retry with
+            # backoff on failure); waited submits keep raw errors so the
+            # caller sees them on handle.wait()
+            job = self._compact_job if wait else self._compact_job_bg
+            handle = self.scheduler.submit(f"compact:{self.uid}", job)
         except RuntimeError:
             return None                  # engine shutting down
         if wait:
-            return handle.wait(timeout=600)
+            out = handle.wait(timeout=600)
+            # the submit may have coalesced onto a queued BACKGROUND job
+            # whose failure was swallowed for retry: a pending failure
+            # count means the compaction the caller waited on did not land
+            if not out and self._bg_failures.get("compaction"):
+                raise StorageError(
+                    f"compaction of region {self.name} failed: "
+                    f"{self.bg_errors.get('compaction', {}).get('last_error', 'unknown error')}")
+            return out
         return handle
 
     def compact(self, now_ms: Optional[int] = None) -> List[FileMeta]:
@@ -953,6 +1120,10 @@ class Region:
         with self._writer_lock:
             if self.closed:
                 return
+            # crash HERE = compaction outputs durable but uncommitted:
+            # inputs stay referenced (still readable), outputs are
+            # orphans for the reopen sweep — no data moves twice
+            _fp.fail_point("compaction_commit")
             mv = self.manifest.save([{
                 "type": "edit",
                 "added": [f.to_dict() for f in added],

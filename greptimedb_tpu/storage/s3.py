@@ -19,8 +19,11 @@ import xml.etree.ElementTree as ET
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
+from ..common.failpoint import register as _fp_register
 from ..errors import GreptimeError
 from .object_store import ObjectStore
+
+_fp_register("objstore_request")
 
 
 @dataclass
@@ -34,7 +37,27 @@ class S3Config:
 
 
 class S3Error(GreptimeError):
-    pass
+    """Terminal S3 failure (4xx, signature mismatch, malformed reply)."""
+
+
+class S3TransientError(S3Error):
+    """Retryable S3 failure: HTTP 5xx/429 (service hiccup, throttling)
+    or a socket-level error before a status line arrived. The
+    RetryingObjectStore wrapper backs off and retries these; plain
+    S3Error surfaces immediately."""
+
+
+#: statuses worth retrying: server errors + explicit throttling
+_TRANSIENT_STATUSES = frozenset({429, 500, 502, 503, 504, 509})
+
+
+def _status_error(op: str, key: str, status: int, body: bytes = b"") -> S3Error:
+    detail = f"S3 {op} {key}: HTTP {status}"
+    if body:
+        detail += f" {body[:200]!r}"
+    if status in _TRANSIENT_STATUSES:
+        return S3TransientError(detail)
+    return S3Error(detail)
 
 
 def _sha256(data: bytes) -> str:
@@ -93,6 +116,8 @@ class S3ObjectStore(ObjectStore):
 
     def _request(self, method: str, key: str = "", query: str = "",
                  body: bytes = b"") -> Tuple[int, dict, bytes]:
+        from ..common.failpoint import fail_point
+        fail_point("objstore_request")
         path = "/" + urllib.parse.quote(self.config.bucket)
         if key:
             path += "/" + urllib.parse.quote(key, safe="/")
@@ -108,6 +133,11 @@ class S3ObjectStore(ObjectStore):
             resp = conn.getresponse()
             data = resp.read()
             return resp.status, dict(resp.getheaders()), data
+        except (OSError, http.client.HTTPException) as e:
+            # no S3 status line arrived: connection refused/reset, DNS
+            # hiccup, short read — all worth a retry, none a 4xx
+            raise S3TransientError(
+                f"S3 {method} {key or path}: {e}") from e
         finally:
             conn.close()
 
@@ -120,19 +150,18 @@ class S3ObjectStore(ObjectStore):
         if status == 404:
             raise FileNotFoundError(key)
         if status != 200:
-            raise S3Error(f"S3 GET {key}: HTTP {status}")
+            raise _status_error("GET", key, status)
         return data
 
     def write(self, key: str, data: bytes) -> None:
         status, _, body = self._request("PUT", self._key(key), body=data)
         if status not in (200, 201):
-            raise S3Error(f"S3 PUT {key}: HTTP {status} "
-                          f"{body[:200]!r}")
+            raise _status_error("PUT", key, status, body)
 
     def delete(self, key: str) -> None:
         status, _, _ = self._request("DELETE", self._key(key))
         if status not in (200, 204, 404):
-            raise S3Error(f"S3 DELETE {key}: HTTP {status}")
+            raise _status_error("DELETE", key, status)
 
     def delete_dir(self, key: str) -> None:
         prefix = key if key.endswith("/") else key + "/"
@@ -145,7 +174,7 @@ class S3ObjectStore(ObjectStore):
             return True
         if status in (404, 403):
             return False
-        raise S3Error(f"S3 HEAD {key}: HTTP {status}")
+        raise _status_error("HEAD", key, status)
 
     def list(self, prefix: str) -> List[str]:
         full_prefix = self._key(prefix) if prefix else self._root
@@ -158,7 +187,7 @@ class S3ObjectStore(ObjectStore):
             query = urllib.parse.urlencode(sorted(q.items()))
             status, _, data = self._request("GET", "", query=query)
             if status != 200:
-                raise S3Error(f"S3 LIST {prefix}: HTTP {status}")
+                raise _status_error("LIST", prefix, status)
             root = ET.fromstring(data)
             ns = ""
             if root.tag.startswith("{"):

@@ -79,6 +79,21 @@ class LocalScheduler:
             self._wake.notify()
             return handle
 
+    def submit_later(self, key: str, fn: Callable[[], object],
+                     delay_s: float) -> None:
+        """Queue `fn` under `key` after a delay — the retry-with-backoff
+        hook for failed background jobs. Fire-and-forget: if the
+        scheduler stops before the timer fires, the submit is dropped
+        (shutdown must not resurrect work)."""
+        def fire():
+            try:
+                self.submit(key, fn)
+            except RuntimeError:
+                pass                      # scheduler stopped meanwhile
+        t = threading.Timer(delay_s, fire)
+        t.daemon = True
+        t.start()
+
     def _worker_loop(self):
         while True:
             with self._lock:

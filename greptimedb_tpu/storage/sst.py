@@ -23,10 +23,14 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..common import failpoint as _fp
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
 from ..datatypes.vector import compat_column, null_column
 from .object_store import ObjectStore
+
+_fp.register("sst_write")
+_fp.register("sst_write_after")
 
 SERIES_COL = "__series_id"
 SEQ_COL = "__sequence"
@@ -199,6 +203,7 @@ class AccessLayer:
 
     def _write_sst_inner(self, *, level, series_ids, ts, seq, op_types,
                          fields, tag_columns, schema) -> Optional[FileMeta]:
+        _fp.fail_point("sst_write")
         n = len(ts)
         schema = schema if schema is not None else self.schema
         arrays: List[pa.Array] = []
@@ -276,6 +281,9 @@ class AccessLayer:
             data = sink.getvalue()
             size = len(data)
             self.store.write(key, data)
+        # the parquet file is durable but unreferenced: a crash HERE
+        # leaves an orphan SST for the reopen sweep to collect
+        _fp.fail_point("sst_write_after")
         dups = 0
         if n > 1:
             # rows are (sid, ts, seq)-sorted: duplicate keys are adjacent

@@ -13,15 +13,23 @@ at the region writer level by batching mutations into one WriteBatch).
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
+from ..common import failpoint as _fp
 from ..errors import StorageError
 
+logger = logging.getLogger(__name__)
+
 _REC_HDR = struct.Struct("<IIQI")  # len, crc, seq, schema_version
+
+_fp.register("wal_append")
+_fp.register("wal_append_torn")
+_fp.register("wal_fsync")
 
 
 class Wal:
@@ -39,6 +47,12 @@ class Wal:
         self._fh = None
         self._fh_path: Optional[str] = None
         self._fh_size = 0
+        # set when an injected torn write left garbage at the tail of the
+        # OPEN segment and the process survived (the torture rig abandons
+        # the object; a live server does not) — the next append must cut
+        # the garbage off before writing or it would bury later acked
+        # records behind bytes replay cannot cross
+        self._fh_dirty_tail = False
 
     # ---- segments ----
     def _segments(self) -> List[Tuple[int, str]]:
@@ -63,17 +77,37 @@ class Wal:
     # ---- api ----
     def append(self, seq: int, payload: bytes, schema_version: int = 0) -> None:
         with self._lock:
+            _fp.fail_point("wal_append")
+            if self._fh is not None and self._fh_dirty_tail:
+                # in-process recovery from an injected torn write: drop
+                # the garbage (_fh_size never advanced past it) so this
+                # record lands replayable. Runs BEFORE the rotation check
+                # so a full segment can never rotate away with garbage
+                # buried mid-log.
+                self._fh.truncate(self._fh_size)
+                self._fh.flush()
+                self._fh_dirty_tail = False
             if self._fh is None or self._fh_size >= self.segment_bytes:
                 self._open_segment(seq)
             crc = zlib.crc32(payload)
             rec = _REC_HDR.pack(len(payload), crc, seq, schema_version) + payload
+            if _fp.fires("wal_append_torn"):
+                # crash mid-append: half the record reaches the file —
+                # recovery must truncate it away and keep earlier records
+                self._fh.write(rec[:max(1, len(rec) // 2)])
+                self._fh.flush()
+                self._fh_dirty_tail = True
+                raise _fp.SimulatedCrash("wal_append_torn")
             self._fh.write(rec)
             self._fh.flush()
+            # account the record before the fsync: it is in the file now,
+            # so a failed fsync must not leave segment rotation blind to it
+            self._fh_size += len(rec)
             if self.sync_on_write:
                 from ..common.telemetry import timer
+                _fp.fail_point("wal_fsync")
                 with timer("wal_fsync"):
                     os.fsync(self._fh.fileno())
-            self._fh_size += len(rec)
             from ..common.telemetry import increment_counter
             increment_counter("wal_bytes", len(rec))
 
@@ -89,10 +123,15 @@ class Wal:
         """Yield (seq, schema_version, payload) for all records with
         seq >= start_seq.
 
-        A torn/corrupt record in the FINAL segment is a crash mid-append and
-        terminates the scan cleanly; the same in an EARLIER segment means
-        acknowledged writes were lost (bit rot) — replay aborts with
-        StorageError rather than silently skipping to newer segments."""
+        A torn/corrupt record in the FINAL segment is a crash mid-append:
+        the scan terminates cleanly AND the segment is truncated at the
+        last good record (with a WARN) so later appends never land past
+        the garbage — without the truncate, append-mode writes would bury
+        the torn bytes mid-segment and brick the next replay. The same in
+        an EARLIER segment means acknowledged writes were lost (bit rot) —
+        replay aborts with StorageError rather than silently skipping to
+        newer segments. Each record carries a CRC32 over its payload, so a
+        corrupt-but-complete record is detected, never silently replayed."""
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
@@ -102,22 +141,42 @@ class Wal:
             # bounds this one's contents)
             if i + 1 < len(segs) and segs[i + 1][0] <= start_seq:
                 continue
-            records, clean = self._read_segment(path, start_seq)
+            records, clean, good_pos = self._read_segment(path, start_seq)
             yield from records
             if not clean:
                 if i + 1 < len(segs):
                     raise StorageError(
                         f"corrupt WAL record mid-log in {path}; refusing to "
                         f"replay past the gap")
+                self._repair_torn_tail(path, good_pos)
                 return  # torn tail of the active segment: normal crash
 
+    def _repair_torn_tail(self, path: str, good_pos: int) -> None:
+        """Drop a torn/corrupt tail record left by a crash mid-append."""
+        with self._lock:
+            if self._fh is not None and self._fh_path == path:
+                return  # segment reopened for appends already; leave it
+            try:
+                size = os.path.getsize(path)
+                logger.warning(
+                    "wal %s: torn/corrupt tail record; truncating %d bytes "
+                    "at offset %d (crash mid-append)", path,
+                    size - good_pos, good_pos)
+                with open(path, "rb+") as f:
+                    f.truncate(good_pos)
+                    os.fsync(f.fileno())
+            except OSError as e:  # pragma: no cover
+                raise StorageError(f"wal tail repair failed: {e}", cause=e)
+
     def _read_segment(self, path: str, start_seq: int
-                      ) -> Tuple[List[Tuple[int, int, bytes]], bool]:
+                      ) -> Tuple[List[Tuple[int, int, bytes]], bool, int]:
+        """Returns (records >= start_seq, clean, offset past the last good
+        record) — the offset is the truncation point on a torn tail."""
         try:
             with open(path, "rb") as f:
                 data = f.read()
         except FileNotFoundError:
-            return [], True
+            return [], True, 0
         out: List[Tuple[int, int, bytes]] = []
         pos = 0
         n = len(data)
@@ -125,14 +184,14 @@ class Wal:
             ln, crc, seq, sv = _REC_HDR.unpack_from(data, pos)
             body_start = pos + _REC_HDR.size
             if body_start + ln > n:
-                return out, False  # torn record
+                return out, False, pos  # torn record
             payload = data[body_start:body_start + ln]
             if zlib.crc32(payload) != crc:
-                return out, False  # corrupt record
+                return out, False, pos  # corrupt record
             pos = body_start + ln
             if seq >= start_seq:
                 out.append((seq, sv, payload))
-        return out, pos == n
+        return out, pos == n, pos
 
     def obsolete(self, seq: int) -> None:
         """Delete segments whose entire contents are <= seq."""

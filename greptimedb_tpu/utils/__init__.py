@@ -1,1 +1,33 @@
 """Shared host-side utilities."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def atomic_write(path: str, data, *, fsync: bool = True,
+                 tmp_prefix: str = ".tmp-") -> None:
+    """Write `data` (bytes or str) to `path` atomically: temp file in the
+    same directory, optional fsync, rename. A crash at any point leaves
+    either the old file or the complete new one — never a torn mix — and
+    the temp file is unlinked on failure. One implementation shared by
+    every state-doc writer (object store, meta kv, raft persistence) so
+    a durability fix lands everywhere at once."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=tmp_prefix)
+    try:
+        mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+        with os.fdopen(fd, mode) as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,46 @@
+-- information_schema.failpoints (ISSUE 4): the fault-injection registry
+-- is queryable over SQL, and SET failpoint_<name> arms/disarms a point
+-- (same registry as GREPTIME_FAILPOINTS and /v1/admin/failpoints).
+
+SET failpoint_flush_commit = 'err';
+
+SELECT name, action, hits, fires FROM information_schema.failpoints
+    WHERE name = 'flush_commit';
+
+-- a zero-millisecond delay is observable only through its counters:
+-- each WAL append below evaluates the armed point once
+SET failpoint_wal_append = 'delay(0)';
+
+CREATE TABLE fpt (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+INSERT INTO fpt VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+INSERT INTO fpt VALUES ('c', 3000, 3.0);
+
+SELECT name, action, hits, fires FROM information_schema.failpoints
+    WHERE name LIKE 'wal_%' ORDER BY name;
+
+-- NxM one-in-N arming renders verbatim
+SET failpoint_objstore_read = '1x3*err(transient)';
+
+SELECT name, action FROM information_schema.failpoints
+    WHERE name = 'objstore_read';
+
+-- malformed actions are rejected, not armed
+SET failpoint_objstore_read = 'explode';
+
+SET failpoint_flush_commit = 'off';
+
+SET failpoint_wal_append = 'off';
+
+SET failpoint_objstore_read = 'off';
+
+SELECT count(*) FROM information_schema.failpoints
+    WHERE action IS NOT NULL;
+
+DROP TABLE fpt;

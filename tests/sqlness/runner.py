@@ -196,6 +196,10 @@ def case_files(filters: List[str]) -> List[Path]:
 def run_one(sql_path: Path, update: bool) -> Optional[str]:
     result_path = sql_path.with_suffix(".result")
     distributed = "distributed" in sql_path.relative_to(CASES_DIR).parts
+    # failpoint state/counters are process-global; a case sees them as a
+    # fresh server would (system/failpoints.sql pins exact hit counts)
+    from greptimedb_tpu.common import failpoint
+    failpoint.reset()
     with tempfile.TemporaryDirectory() as home:
         fe = _DistEnv(home) if distributed else make_frontend(home)
         try:

@@ -208,8 +208,10 @@ class TestStorageEngineOnS3:
         fe.shutdown()
 
     def test_build_object_store_factory(self, mock_s3, tmp_path):
+        from greptimedb_tpu.storage.retry import RetryingObjectStore
         fs = build_object_store({"type": "File"}, str(tmp_path / "fs"))
-        assert isinstance(fs, FsObjectStore)
+        assert isinstance(fs, RetryingObjectStore)
+        assert isinstance(fs.inner, FsObjectStore)
         s3b = build_object_store(
             {"type": "S3", "bucket": "b", "endpoint": mock_s3,
              "cache_path": str(tmp_path / "c")}, "")

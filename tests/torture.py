@@ -1,0 +1,323 @@
+"""Crash-recovery torture harness: the failpoint-driven crash matrix.
+
+For every registered crash point in the storage stack, one case:
+
+1. build a region on disk and ingest acknowledged batches (interleaved
+   writes, flushes, compactions — enough state that every recovery path
+   has something to get wrong);
+2. arm the crash point (``crash`` action) and drive the operation that
+   reaches it until :class:`SimulatedCrash` fires;
+3. simulate the kill: drop the region object with **no** close/flush —
+   the only state the next lifetime may rely on is what hit disk;
+4. reopen the region from the same home and assert the invariants:
+
+   - **no acked row lost** — every acknowledged (host, ts) key is
+     present with its written value;
+   - **no row duplicated** — no (series, ts) key appears twice in a raw
+     (pre-dedup) scan: a WAL entry replayed on top of its flushed copy,
+     or a manifest edit applied twice, shows up here;
+   - **unacked rows appear at most once, or not at all** — a batch that
+     crashed mid-write may legally be durable (it hit the WAL) but must
+     never be half-applied or doubled; rows whose commit point was never
+     reached (bulk ingest) must be absent;
+   - **manifest references only existing SSTs** — no dangling file names;
+   - **no orphan SSTs** — files a crashed flush/compaction/bulk-ingest
+     left behind are swept by the reopen;
+5. prove the reopened region is alive: one more acked write + flush +
+   scan round-trips.
+
+tests/test_fault_injection.py parametrizes this matrix as tier-1 tests
+(quick shapes) and as a `slow`-marked extended sweep (both WAL fsync
+modes). The harness is importable on its own::
+
+    python -c "import tests.torture as t; print(t.run_all('/tmp/tort'))"
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from greptimedb_tpu.common import failpoint as fp
+from greptimedb_tpu.datatypes import Schema
+from greptimedb_tpu.datatypes.data_type import (FLOAT64, STRING,
+                                                TIMESTAMP_MILLISECOND)
+from greptimedb_tpu.datatypes.schema import ColumnSchema, SemanticType
+from greptimedb_tpu.storage.file_purger import FilePurger
+from greptimedb_tpu.storage.object_store import FsObjectStore
+from greptimedb_tpu.storage.region import Region, RegionDescriptor
+from greptimedb_tpu.storage.wal import Wal
+from greptimedb_tpu.storage.write_batch import WriteBatch
+
+BASE_HOSTS = ("h0", "h1", "h2")
+ROWS_PER_BATCH = 24
+
+
+def make_schema() -> Schema:
+    return Schema([
+        ColumnSchema("host", STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("v", FLOAT64),
+    ])
+
+
+def make_batch(i: int, n: int = ROWS_PER_BATCH) -> Dict[Tuple[str, int], float]:
+    """Batch i: unique (host, ts) keys whose ts ranges OVERLAP across
+    batches (so compactions really merge instead of trivially moving
+    disjoint files), plus one host this batch introduces (so every flush
+    has fresh series and the dict-persist crash point is reachable)."""
+    rows: Dict[Tuple[str, int], float] = {}
+    hosts = BASE_HOSTS + (f"n{i}",)
+    for j in range(n):
+        host = hosts[j % len(hosts)]
+        ts = i + j * 1000          # i < 1000 keeps keys globally unique
+        rows[(host, ts)] = float(ts) * 0.5 + i
+    return rows
+
+
+class TortureRig:
+    """One simulated datanode lifetime over a shared on-disk home.
+    Synchronous everywhere (no scheduler) so an armed crash propagates
+    to the driver instead of dying on a worker thread; purges run on
+    demand with zero grace so the purger crash point is drivable."""
+
+    def __init__(self, home: str, *, sync_wal: bool = False,
+                 checkpoint_margin: int = 10):
+        self.home = home
+        self.sync_wal = sync_wal
+        self.checkpoint_margin = checkpoint_margin
+        self.store = FsObjectStore(os.path.join(home, "data"))
+        self.purger = FilePurger(grace_s=0.0)
+        self.schema = make_schema()
+        self.region: Optional[Region] = None
+
+    def _desc(self) -> RegionDescriptor:
+        return RegionDescriptor(
+            name="torture", schema=self.schema, region_dir="torture",
+            wal_dir=os.path.join(self.home, "wal"))
+
+    def _wal(self) -> Wal:
+        return Wal(os.path.join(self.home, "wal"),
+                   sync_on_write=self.sync_wal)
+
+    def _kwargs(self) -> dict:
+        return dict(wal=self._wal(), scheduler=None, purger=self.purger,
+                    checkpoint_margin=self.checkpoint_margin,
+                    max_l0_files=10_000)   # compaction only when driven
+
+    def create(self) -> None:
+        self.region = Region.create(self._desc(), self.store,
+                                    **self._kwargs())
+
+    def open(self) -> None:
+        self.region = Region.open(self._desc(), self.store,
+                                  **self._kwargs())
+        assert self.region is not None, "region vanished across the crash"
+
+    def write(self, rows: Dict[Tuple[str, int], float]) -> None:
+        wb = WriteBatch(self.region.schema)
+        wb.put({"host": [k[0] for k in rows],
+                "ts": [k[1] for k in rows],
+                "v": list(rows.values())})
+        self.region.write(wb)
+
+    def bulk(self, rows: Dict[Tuple[str, int], float]) -> None:
+        self.region.bulk_ingest({
+            "host": np.array([k[0] for k in rows], dtype=object),
+            "ts": np.array([k[1] for k in rows], dtype=np.int64),
+            "v": np.array(list(rows.values()), dtype=np.float64)})
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+def recovered_rows(region: Region) -> Dict[Tuple[str, int], float]:
+    """(host, ts) → v from a merged (MVCC-deduped) scan."""
+    data = region.snapshot().read_merged()
+    hosts = region.series_dict.decode_tag_column(data.series_ids, 0)
+    vals = data.fields["v"][0]
+    return {(h, int(t)): float(v)
+            for h, t, v in zip(hosts, data.ts, vals)}
+
+
+def check_invariants(region: Region,
+                     acked: Dict[Tuple[str, int], float],
+                     maybe: Dict[Tuple[str, int], float]) -> None:
+    # 1. raw (pre-dedup) scan: every (series, ts) key at most once —
+    #    unique-key ingest means ANY raw duplicate is a double-apply
+    raw = region.snapshot().scan()
+    raw_keys = list(zip(raw.series_ids.tolist(), raw.ts.tolist()))
+    assert len(raw_keys) == len(set(raw_keys)), \
+        "rows duplicated after recovery (replay on top of flushed data?)"
+    got = recovered_rows(region)
+    # 2. no acked row lost, values intact
+    for key, v in acked.items():
+        assert key in got, f"acked row {key} lost in the crash"
+        assert got[key] == v, \
+            f"acked row {key}: value {got[key]} != written {v}"
+    # 3. nothing beyond acked ∪ maybe-durable-inflight
+    for key in got:
+        assert key in acked or key in maybe, \
+            f"phantom row {key} appeared after recovery"
+    # 4. manifest references only existing SSTs
+    for f in region.version_control.current.ssts.all_files():
+        key = f"{region.descriptor.region_dir}/sst/{f.file_name}"
+        assert region.store.exists(key), \
+            f"manifest references missing SST {f.file_name}"
+    # 5. no orphan SSTs survive the reopen sweep
+    referenced = {f.file_name for f in
+                  region.version_control.current.ssts.all_files()}
+    on_disk = {k.rsplit("/", 1)[-1]
+               for k in region.store.list(
+                   f"{region.descriptor.region_dir}/sst/")}
+    orphans = on_disk - referenced
+    assert not orphans, f"orphan SSTs survived reopen: {orphans}"
+
+
+# ---------------------------------------------------------------------------
+# drivers: reach each crash point from a realistic op sequence.
+# Each returns the inflight rows that may LEGALLY be visible after
+# recovery (durable before the crash but never acknowledged).
+# ---------------------------------------------------------------------------
+
+def _drive_write(rig: TortureRig, point: str, batch_no: int,
+                 durable_ok: bool) -> Dict:
+    rows = make_batch(batch_no)
+    with fp.cfg(point, "crash"):
+        try:
+            rig.write(rows)
+        except fp.SimulatedCrash:
+            return rows if durable_ok else {}
+    raise AssertionError(f"crash point {point} never fired")
+
+
+def _drive_flush(rig: TortureRig, point: str, batch_no: int,
+                 acked: Dict) -> Dict:
+    rows = make_batch(batch_no)
+    rig.write(rows)
+    acked.update(rows)                    # write() returned: acked
+    with fp.cfg(point, "crash"):
+        try:
+            rig.region.flush()
+        except fp.SimulatedCrash:
+            return {}
+    raise AssertionError(f"crash point {point} never fired")
+
+
+def _drive_bulk(rig: TortureRig, point: str, batch_no: int) -> Dict:
+    rows = make_batch(batch_no)
+    with fp.cfg(point, "crash"):
+        try:
+            rig.bulk(rows)
+        except fp.SimulatedCrash:
+            return {}                     # commit never landed: must vanish
+    raise AssertionError(f"crash point {point} never fired")
+
+
+def _drive_compact(rig: TortureRig, point: str) -> Dict:
+    with fp.cfg(point, "crash"):
+        try:
+            rig.region.compact()
+        except fp.SimulatedCrash:
+            return {}
+    raise AssertionError(f"crash point {point} never fired")
+
+
+def _drive_purge(rig: TortureRig, point: str) -> Dict:
+    rig.region.compact()                  # queues input files for purge
+    with fp.cfg(point, "crash"):
+        try:
+            rig.purger.sweep()
+        except fp.SimulatedCrash:
+            return {}
+    raise AssertionError(f"crash point {point} never fired")
+
+
+#: point → (driver kind, durable_ok) — the full crash matrix
+CRASH_POINTS: Dict[str, Tuple[str, bool]] = {
+    "wal_append":           ("write", False),
+    "wal_append_torn":      ("write", False),
+    "wal_fsync":            ("write", True),   # record written pre-fsync
+    "region_write_memtable": ("write", True),  # WAL holds it already
+    "sst_write":            ("flush", False),
+    "sst_write_after":      ("flush", False),
+    "dict_persist":         ("flush", False),
+    "flush_commit":         ("flush", False),
+    "manifest_commit":      ("flush", False),
+    "manifest_checkpoint":  ("flush", False),
+    "objstore_write":       ("flush", False),
+    "bulk_commit":          ("bulk", False),
+    "compaction_commit":    ("compact", False),
+    "purger_delete":        ("purge", False),
+}
+
+
+def run_crash_case(home: str, point: str, *,
+                   sync_wal: bool = False,
+                   baseline_batches: int = 3) -> Dict:
+    """One cell of the crash matrix; raises AssertionError on any
+    invariant violation. Returns a small result dict for reporting."""
+    kind, durable_ok = CRASH_POINTS[point]
+    if point == "wal_fsync":
+        sync_wal = True                   # the point only exists then
+    checkpoint_margin = 1 if point == "manifest_checkpoint" else 10
+    fp.clear_all()
+    rig = TortureRig(home, sync_wal=sync_wal,
+                     checkpoint_margin=checkpoint_margin)
+    rig.create()
+    acked: Dict[Tuple[str, int], float] = {}
+    # baseline: interleaved writes and flushes → overlapping L0 files,
+    # rows in SSTs AND rows only in the WAL at crash time
+    for i in range(baseline_batches):
+        rows = make_batch(i)
+        rig.write(rows)
+        acked.update(rows)
+        if i % 2 == 0:
+            rig.region.flush()
+    if kind in ("compact", "purge"):
+        rig.region.flush()                # compactions need L0 inputs
+
+    batch_no = baseline_batches
+    if kind == "write":
+        maybe = _drive_write(rig, point, batch_no, durable_ok)
+    elif kind == "flush":
+        maybe = _drive_flush(rig, point, batch_no, acked)
+    elif kind == "bulk":
+        maybe = _drive_bulk(rig, point, batch_no)
+    elif kind == "compact":
+        maybe = _drive_compact(rig, point)
+    else:
+        maybe = _drive_purge(rig, point)
+    fp.clear_all()
+
+    # simulated kill: the region object is abandoned un-closed; only
+    # what is on disk carries over
+    rig2 = TortureRig(home, sync_wal=sync_wal,
+                      checkpoint_margin=checkpoint_margin)
+    rig2.open()
+    check_invariants(rig2.region, acked, maybe)
+
+    # post-recovery liveness: ack one more batch through a full cycle
+    rows = make_batch(batch_no + 1)
+    rig2.write(rows)
+    acked.update(rows)
+    rig2.region.flush()
+    check_invariants(rig2.region, acked, maybe)
+    rig2.region.close()
+    return {"point": point, "acked_rows": len(acked),
+            "maybe_rows": len(maybe)}
+
+
+def run_all(base_dir: str, *, sync_wal: bool = False) -> Dict[str, Dict]:
+    """The whole matrix, one fresh home per point (CLI convenience)."""
+    results = {}
+    for point in CRASH_POINTS:
+        home = os.path.join(base_dir, point)
+        os.makedirs(home, exist_ok=True)
+        results[point] = run_crash_case(home, point, sync_wal=sync_wal)
+    return results
