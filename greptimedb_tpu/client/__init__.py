@@ -45,14 +45,18 @@ class DatanodeClient:
         raise NotImplementedError
 
     def region_moments(self, catalog: str, schema: str, table: str,
-                       plan) -> List[pd.DataFrame]:
+                       plan, regions: Optional[Sequence[int]] = None
+                       ) -> List[pd.DataFrame]:
         """Run the TPU aggregate plan over this node's regions of the
-        table; returns per-region moment frames for the frontend fold."""
+        table (restricted to `regions` when the frontend pruned);
+        returns per-region moment frames for the frontend fold."""
         raise NotImplementedError
 
     def scan_batches(self, catalog: str, schema: str, table: str,
                      projection: Optional[Sequence[str]] = None,
-                     time_range=None) -> list:
+                     time_range=None, limit: Optional[int] = None,
+                     filters: Optional[Sequence] = None,
+                     regions: Optional[Sequence[int]] = None) -> list:
         raise NotImplementedError
 
     def flush_table(self, catalog: str, schema: str, table: str) -> None:
@@ -109,16 +113,20 @@ class LocalDatanodeClient(DatanodeClient):
             region_number, columns, op)
 
     def region_moments(self, catalog: str, schema: str, table: str,
-                       plan) -> List[pd.DataFrame]:
+                       plan, regions: Optional[Sequence[int]] = None
+                       ) -> List[pd.DataFrame]:
         from ..query.tpu_exec import region_moment_frames
         return region_moment_frames(self._table(catalog, schema, table),
-                                    plan)
+                                    plan, regions=regions)
 
     def scan_batches(self, catalog: str, schema: str, table: str,
                      projection: Optional[Sequence[str]] = None,
-                     time_range=None) -> list:
+                     time_range=None, limit: Optional[int] = None,
+                     filters: Optional[Sequence] = None,
+                     regions: Optional[Sequence[int]] = None) -> list:
         return self._table(catalog, schema, table).scan_batches(
-            projection=projection, time_range=time_range)
+            projection=projection, time_range=time_range, limit=limit,
+            filters=filters, regions=regions)
 
     def flush_table(self, catalog: str, schema: str, table: str) -> None:
         self._table(catalog, schema, table).flush()

@@ -33,8 +33,14 @@ def _columns_to_arrow(columns: Dict[str, Sequence]) -> pa.Table:
 
 def _to_greptime_error(e: flight.FlightError) -> GreptimeError:
     """Server-side GreptimeErrors cross the wire as gRPC status messages;
-    rebuild the closest taxonomy member so callers keep one except path."""
+    rebuild the closest taxonomy member so callers keep one except path.
+    Unavailable/timeout faults map to TransientRpcError so the
+    distributed fan-out's retry loop recognizes real network hops."""
+    from ..errors import TransientRpcError
     msg = str(e).split(". gRPC client debug context:")[0]
+    if isinstance(e, (flight.FlightUnavailableError,
+                      flight.FlightTimedOutError)):
+        return TransientRpcError(msg)
     if "not found" in msg or "not on datanode" in msg:
         return TableNotFoundError(msg)
     return GreptimeError(msg)
@@ -116,12 +122,14 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
             _columns_to_arrow(columns))
 
     def region_moments(self, catalog: str, schema: str, table: str,
-                       plan) -> List[pd.DataFrame]:
+                       plan, regions=None) -> List[pd.DataFrame]:
         from ..query.plan_codec import plan_to_dict
         ticket = flight.Ticket(json.dumps(
             {"type": "region_moments", "catalog": catalog,
              "schema": schema, "table": table,
-             "plan": plan_to_dict(plan)}).encode())
+             "plan": plan_to_dict(plan),
+             "regions": list(regions) if regions is not None
+             else None}).encode())
         frames = []
         try:
             reader = self.conn.do_get(ticket)
@@ -138,13 +146,23 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
 
     def scan_batches(self, catalog: str, schema: str, table: str,
                      projection: Optional[Sequence[str]] = None,
-                     time_range=None) -> list:
+                     time_range=None, limit: Optional[int] = None,
+                     filters: Optional[Sequence] = None,
+                     regions: Optional[Sequence[int]] = None) -> list:
+        from ..query.plan_codec import expr_to_dict
+        if time_range is not None and hasattr(time_range, "start"):
+            time_range = (time_range.start, time_range.end)
         ticket = flight.Ticket(json.dumps(
             {"type": "scan", "catalog": catalog, "schema": schema,
              "table": table, "projection": list(projection)
              if projection is not None else None,
              "time_range": list(time_range)
-             if time_range is not None else None}).encode())
+             if time_range is not None else None,
+             "limit": limit,
+             "filters": [expr_to_dict(f) for f in filters]
+             if filters else None,
+             "regions": list(regions)
+             if regions is not None else None}).encode())
         out = []
         try:
             reader = self.conn.do_get(ticket)

@@ -2,28 +2,58 @@
 
 Reference behavior: src/common/runtime — named tokio runtimes with
 `spawn_bg/spawn_read/spawn_write` globals (global.rs) and `RepeatedTask`
-(repeated_task.rs). Python twin: three shared ThreadPoolExecutors sized
-for their roles; background storage jobs, scan fan-out, and protocol
-write handling each land on their own pool so a flood of one cannot
-starve the others.
+(repeated_task.rs). Python twin: shared ThreadPoolExecutors sized for
+their roles; background storage jobs, scan fan-out, protocol write
+handling, and the distributed scatter-gather each land on their own pool
+so a flood of one cannot starve the others.
+
+The ``dist`` pool is the long-lived executor behind the frontend's
+datanode fan-out (frontend/distributed.py): RPCs to N datanodes overlap
+instead of summing, and the per-query in-flight window is bounded by the
+``dist_fanout`` knob (``SET dist_fanout`` / ``GREPTIME_DIST_FANOUT``)
+so one wide query cannot monopolize every connection.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import threading
-from typing import Callable, Optional
+from typing import Callable, Iterator, Optional
 
 from ..storage.scheduler import RepeatedTask  # canonical impl, re-export
 
 __all__ = ["RepeatedTask", "spawn_bg", "spawn_read", "spawn_write",
-           "bg_runtime", "read_runtime", "write_runtime",
+           "bg_runtime", "read_runtime", "write_runtime", "dist_runtime",
+           "dist_fanout", "configure_dist_fanout", "env_int",
            "shutdown_runtimes"]
 
 _lock = threading.Lock()
 _pools = {}
 
-_SIZES = {"bg": 4, "read": 8, "write": 8}
+_SIZES = {"bg": 4, "read": 8, "write": 8, "dist": 16}
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+#: per-query bound on concurrently in-flight datanode RPCs (the pool
+#: above bounds the process; this bounds one statement's share)
+_DIST_FANOUT = [max(1, env_int("GREPTIME_DIST_FANOUT", 8))]
+
+
+def dist_fanout() -> int:
+    return _DIST_FANOUT[0]
+
+
+def configure_dist_fanout(n: int) -> None:
+    """SET dist_fanout — 1 serializes the scatter (the pre-parallel
+    behavior, kept for differential benchmarks and debugging)."""
+    _DIST_FANOUT[0] = max(1, int(n))
 
 
 def _pool(name: str) -> concurrent.futures.ThreadPoolExecutor:
@@ -49,6 +79,10 @@ def write_runtime() -> concurrent.futures.ThreadPoolExecutor:
     return _pool("write")
 
 
+def dist_runtime() -> concurrent.futures.ThreadPoolExecutor:
+    return _pool("dist")
+
+
 def spawn_bg(fn: Callable, *args, **kwargs):
     from .telemetry import propagate
     return bg_runtime().submit(propagate(fn), *args, **kwargs)
@@ -71,32 +105,66 @@ def shutdown_runtimes(wait: bool = True) -> None:
         pool.shutdown(wait=wait)
 
 
-def parallel_map(fn: Callable, items, *, max_workers: int = 8) -> list:
-    """Map fn over items with a transient thread pool; serial for <=1 item.
+def parallel_map(fn: Callable, items, *, max_workers: int = 8,
+                 pool: Optional[concurrent.futures.Executor] = None) -> list:
+    """Map fn over items with a thread pool; serial for <=1 item/worker.
 
     The storage IO fan-outs (SST read/decode, per-bucket SST encode/write)
     share this: parquet + zstd drop the GIL, so concurrent workers overlap
-    IO and (de)compression."""
-    items = list(items)
-    if len(items) <= 1:
-        return [fn(x) for x in items]
-    from concurrent.futures import ThreadPoolExecutor
-    from .telemetry import propagate
-    fn = propagate(fn)       # workers stay parented to the caller's trace
-    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
-        return list(p.map(fn, items))
+    IO and (de)compression. Pass ``pool`` (e.g. ``dist_runtime()``) to run
+    on a shared long-lived executor instead of a transient one —
+    ``max_workers`` then bounds this call's in-flight window, not the
+    pool."""
+    return list(parallel_imap(fn, items, max_workers=max_workers,
+                              pool=pool))
 
 
-def parallel_imap(fn: Callable, items, *, max_workers: int = 8):
+def parallel_imap(fn: Callable, items, *, max_workers: int = 8,
+                  pool: Optional[concurrent.futures.Executor] = None):
     """parallel_map but yielding results in order as they become ready, so
-    the consumer can process-and-drop instead of holding every result."""
+    the consumer can process-and-drop (pipelined gather) instead of
+    barriering on the slowest item."""
     items = list(items)
-    if len(items) <= 1:
+    if len(items) <= 1 or max_workers <= 1:
         for x in items:
             yield fn(x)
         return
-    from concurrent.futures import ThreadPoolExecutor
     from .telemetry import propagate
-    fn = propagate(fn)
+    fn = propagate(fn)       # workers stay parented to the caller's trace
+    if pool is not None:
+        yield from _bounded_ordered(pool, fn, items, max_workers)
+        return
+    from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as p:
         yield from p.map(fn, items)
+
+
+def _bounded_ordered(pool: concurrent.futures.Executor, fn: Callable,
+                     items, window: int) -> Iterator:
+    """Ordered streaming map over a SHARED executor with at most `window`
+    items of this call in flight (a transient pool gets the same bound
+    from its worker count; a shared pool needs it explicitly, or one
+    call could queue its whole fan-out ahead of everyone else's)."""
+    from collections import deque
+    it = iter(items)
+    pending: "deque" = deque()
+    for x in it:
+        pending.append(pool.submit(fn, x))
+        if len(pending) >= window:
+            break
+    try:
+        while pending:
+            res = pending.popleft().result()   # oldest first: ordered
+            # refill only after the oldest completed, so in-flight never
+            # exceeds the window (the others kept running meanwhile)
+            for x in it:
+                pending.append(pool.submit(fn, x))
+                break
+            yield res
+    finally:
+        # abort OR abandoned consumer (GeneratorExit at the yield):
+        # cancel what hasn't started — orphaned work must not occupy the
+        # SHARED pool's slots after the statement failed (already-running
+        # futures finish; their results are dropped)
+        for f in pending:
+            f.cancel()

@@ -139,3 +139,13 @@ class RegionNotFoundError(GreptimeError):
 
 class AuthError(GreptimeError):
     status_code = StatusCode.USER_PASSWORD_MISMATCH
+
+
+class TransientRpcError(GreptimeError):
+    """RPC failure a later identical attempt can plausibly outlive —
+    connection refused/reset, deadline exceeded, server restarting.
+    storage/retry.is_transient recognizes it, so the distributed
+    fan-out's per-RPC retry covers real network hops, not just
+    failpoint-injected faults."""
+
+    status_code = StatusCode.STORAGE_UNAVAILABLE

@@ -11,13 +11,32 @@ Upgrade over v0.2: the scan path pushes *aggregate moments* down to the
 datanodes (client.region_moments — each worker reduces its regions with
 the TPU kernel) and the frontend only folds per-run moment frames; the
 reference ships only projection/filter/limit scans (table.rs:109-156).
+
+The data plane is a PARALLEL, PRUNED scatter-gather executor:
+
+- prune before fan-out — the query's tag/time predicates select regions
+  through `partition_rule.find_regions_by_filters` (reference:
+  src/partition/src/manager.rs:192), and only owning datanodes are
+  contacted, with the surviving region list shipped over the wire so a
+  datanode does not scan its un-pruned sibling regions either;
+- concurrent fan-out with pipelined gather — per-datanode RPCs scatter
+  through the shared `common/runtime` dist pool (bounded per statement
+  by ``SET dist_fanout``) and results fold as they arrive instead of
+  barriering on the slowest node; `_split_write` overlaps per-region
+  WAL+memtable work the same way;
+- robust + observable — each RPC retries transient faults (PR 4's
+  classification; the ``dist_rpc`` failpoint injects them,
+  greptime_dist_rpc_retry_total counts them) and ExecStats reports
+  ``regions pruned a/b, fan-out=k, slowest_node_ms`` per statement.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
@@ -25,6 +44,9 @@ import pandas as pd
 from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
 from ..catalog import MemoryCatalogManager
 from ..client import DatanodeClient
+from ..common import exec_stats
+from ..common.failpoint import register as _fp_register
+from ..common.runtime import env_int
 from ..datatypes.schema import Schema
 from ..errors import (
     GreptimeError, InvalidArgumentsError, TableAlreadyExistsError,
@@ -41,17 +63,73 @@ from ..table.table import Table
 
 logger = logging.getLogger(__name__)
 
+_fp_register("dist_rpc")
+
 
 def _serialize_dist_rule(rule):
     from ..mito.engine import _serialize_rule
     return _serialize_rule(rule)
 
 
+
+
+#: attempts AFTER the first try for one datanode RPC (0 disables retry)
+_DIST_RPC_MAX_RETRIES = [env_int("GREPTIME_DIST_RPC_MAX_RETRIES", 2)]
+#: first backoff; doubles per attempt, capped, ±50% jitter
+_DIST_RPC_BASE_MS = [env_int("GREPTIME_DIST_RPC_RETRY_BASE_MS", 25)]
+_DIST_RPC_MAX_BACKOFF_MS = 1000
+
+
+def configure_dist_rpc_retry(*, max_retries: Optional[int] = None,
+                             base_ms: Optional[int] = None) -> None:
+    """SET dist_rpc_max_retries / dist_rpc_retry_base_ms."""
+    if max_retries is not None:
+        _DIST_RPC_MAX_RETRIES[0] = max(0, int(max_retries))
+    if base_ms is not None:
+        _DIST_RPC_BASE_MS[0] = max(1, int(base_ms))
+
+
+def _dist_rpc(what: str, call):
+    """Run one datanode RPC with transient-fault retry (PR 4's
+    classification — storage/retry.is_transient): exponential backoff +
+    jitter, greptime_dist_rpc_retry{,_giveup}_total counters. The
+    `dist_rpc` failpoint fires inside the loop, so an injected
+    err(transient) exercises the real retry path."""
+    from ..common.failpoint import fail_point
+    from ..common.telemetry import increment_counter
+    from ..storage.retry import is_transient
+    attempt = 0
+    while True:
+        try:
+            fail_point("dist_rpc")
+            return call()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not is_transient(e) or attempt >= _DIST_RPC_MAX_RETRIES[0]:
+                if attempt:
+                    increment_counter("dist_rpc_retry_giveup")
+                raise
+            attempt += 1
+            increment_counter("dist_rpc_retry")
+            delay_ms = min(_DIST_RPC_BASE_MS[0] * (2 ** (attempt - 1)),
+                           _DIST_RPC_MAX_BACKOFF_MS)
+            delay_s = delay_ms / 1e3 * (0.5 + random.random())
+            logger.warning(
+                "dist rpc %s failed transiently (%s); retry %d/%d in "
+                "%.0fms", what, e, attempt, _DIST_RPC_MAX_RETRIES[0],
+                delay_s * 1e3)
+            time.sleep(delay_s)
+
+
 class DistTable(Table):
     """Frontend-side view of a distributed table: route + clients.
 
-    Holds no storage; every data operation fans out to the datanodes that
-    own the regions and merges on the way back."""
+    Holds no storage; every data operation prunes the region set by the
+    statement's predicates, scatters bounded-parallel RPCs to the owning
+    datanodes, and folds results as they arrive."""
+
+    #: query/engine.py threads WHERE conjuncts + LIMIT into scan_batches
+    #: for tables that advertise this
+    supports_filter_pushdown = True
 
     def __init__(self, info: TableInfo, rule, route: TableRoute,
                  clients: Dict[int, DatanodeClient]):
@@ -59,6 +137,7 @@ class DistTable(Table):
         self.partition_rule = rule
         self.route = route
         self.clients = clients
+        self._warned_remote_regions = False
 
     # ---- placement helpers ----
     def _owner(self, region_number: int) -> DatanodeClient:
@@ -80,15 +159,120 @@ class DistTable(Table):
     @property
     def regions(self):
         """Union of the in-process regions across datanodes (promql +
-        metadata endpoints walk these; remote clients would proxy)."""
+        the local frame/scan caches walk these). A remote flight client
+        has no in-process datanode to reach into — and a PARTIAL union
+        would be served as the whole table by cached_table_frame, so any
+        remote client degrades the view to EMPTY with one WARN; callers
+        then fall back to the wire scan path."""
         out = {}
         for client in self._involved_clients():
-            dn_table = client.datanode.catalog.table(
+            datanode = getattr(client, "datanode", None)
+            if datanode is None:
+                if not self._warned_remote_regions:
+                    self._warned_remote_regions = True
+                    logger.warning(
+                        "DistTable %s.regions: datanode %s is remote; "
+                        "in-process region metadata is unavailable — "
+                        "returning no regions (reads go over the wire)",
+                        self.info.name,
+                        getattr(client, "node_id", "?"))
+                return {}
+            dn_table = datanode.catalog.table(
                 self.info.catalog_name, self.info.schema_name,
                 self.info.name)
             if dn_table is not None:
                 out.update(dn_table.regions)
         return out
+
+    # ---- pruning ----
+    def _all_region_numbers(self) -> List[int]:
+        return sorted(rr.region_number for rr in self.route.region_routes)
+
+    def _prune_regions(self, filters=None, time_lo=None, time_hi=None,
+                       time_range=None) -> Tuple[List[int], int]:
+        """(surviving region numbers, total routed regions) for the
+        statement's predicates. Pruning is advisory: any failure falls
+        back to the full region set — it must never fail a query."""
+        all_regions = self._all_region_numbers()
+        rule = self.partition_rule
+        if rule is None:
+            return all_regions, len(all_regions)
+        preds = list(filters or ())
+        tc = self.schema.timestamp_column
+        if tc is not None:
+            los = [time_lo]
+            his = [time_hi]
+            if time_range is not None:
+                if hasattr(time_range, "start"):
+                    los.append(time_range.start)
+                    his.append(time_range.end)
+                else:
+                    lo, hi = time_range
+                    los.append(lo)
+                    his.append(hi)
+            los = [v for v in los if v is not None]
+            his = [v for v in his if v is not None]
+            # time-range overlap joins the rule's predicate pruning when
+            # the table partitions on its time index ([lo, hi) half-open)
+            if los:
+                preds.append(ast.BinaryOp(">=", ast.Column(tc.name),
+                                          ast.Literal(int(max(los)))))
+            if his:
+                preds.append(ast.BinaryOp("<", ast.Column(tc.name),
+                                          ast.Literal(int(min(his)))))
+        try:
+            survivors = rule.find_regions_by_filters(preds)
+        except Exception:  # noqa: BLE001 — pruning is an optimization
+            logger.exception("partition pruning failed; contacting all "
+                             "regions of %s", self.info.name)
+            survivors = rule.region_numbers()
+        routed = set(all_regions)
+        return [r for r in survivors if r in routed], len(all_regions)
+
+    def _owners_for(self, region_numbers: Sequence[int]
+                    ) -> List[Tuple[DatanodeClient, List[int]]]:
+        """Surviving regions grouped by owning datanode, in stable
+        datanode-id order — one scatter target per datanode."""
+        wanted = set(region_numbers)
+        by_node: Dict[int, List[int]] = {}
+        for rr in self.route.region_routes:
+            if rr.region_number in wanted:
+                by_node.setdefault(rr.leader.id, []).append(
+                    rr.region_number)
+        out = []
+        for node_id in sorted(by_node):
+            client = self.clients.get(node_id)
+            if client is None:
+                raise GreptimeError(f"no client for datanode {node_id}")
+            out.append((client, sorted(by_node[node_id])))
+        return out
+
+    # ---- scatter-gather core ----
+    def _scatter(self, targets, call, what: str):
+        """Yield (result, elapsed_ms) per datanode target, in submit
+        order as results complete (pipelined gather on the shared dist
+        pool, in-flight window = SET dist_fanout). Each RPC retries
+        transient faults via _dist_rpc."""
+        from ..common import runtime
+
+        def one(target):
+            client, regs = target
+            t0 = time.perf_counter()
+            res = _dist_rpc(
+                f"{what}[dn{getattr(client, 'node_id', '?')}]",
+                lambda: call(client, regs))
+            return res, (time.perf_counter() - t0) * 1e3
+
+        yield from runtime.parallel_imap(
+            one, targets, max_workers=runtime.dist_fanout(),
+            pool=runtime.dist_runtime())
+
+    def _record_scatter(self, survivors: int, total: int, fan_out: int
+                        ) -> None:
+        exec_stats.record(
+            "dist_scatter",
+            scatter=f"regions pruned {total - survivors}/{total}, "
+                    f"fan-out={fan_out}")
 
     # ---- writes ----
     def insert(self, columns: Dict[str, Sequence]) -> int:
@@ -111,14 +295,31 @@ class DistTable(Table):
                 raise InvalidArgumentsError(f"ragged column {name!r}")
         splits = split_rows(self.partition_rule, columns, num_rows) \
             if self.partition_rule is not None else {self._first_region(): None}
-        written = 0
+        tasks = []
         for rnum, idx in splits.items():
             part = columns if idx is None else \
                 {k: v[idx] if isinstance(v, np.ndarray)
                  else [v[i] for i in idx] for k, v in columns.items()}
-            written += self._owner(rnum).write_region(
-                self.info.catalog_name, self.info.schema_name,
-                self.info.name, rnum, part, op)
+            tasks.append((rnum, part))
+
+        def write_one(task):
+            rnum, part = task
+            return _dist_rpc(
+                f"write_region[{rnum}]",
+                lambda: self._owner(rnum).write_region(
+                    self.info.catalog_name, self.info.schema_name,
+                    self.info.name, rnum, part, op))
+
+        # per-REGION scatter: a multi-region insert/bulk load overlaps
+        # WAL+memtable (or SST encode) work across datanodes instead of
+        # paying the sum of its splits
+        from ..common import runtime
+        written = sum(runtime.parallel_map(
+            write_one, tasks, max_workers=runtime.dist_fanout(),
+            pool=runtime.dist_runtime()))
+        if len(tasks) > 1:
+            exec_stats.record("dist_write", rows=written,
+                              fan_out=len(tasks))
         return written
 
     def _first_region(self) -> int:
@@ -126,29 +327,104 @@ class DistTable(Table):
 
     # ---- reads ----
     def scan_batches(self, projection: Optional[Sequence[str]] = None,
-                     time_range=None) -> list:
-        out = []
-        for client in self._involved_clients():
-            out.extend(client.scan_batches(
-                self.info.catalog_name, self.info.schema_name,
-                self.info.name, projection=projection,
-                time_range=time_range))
+                     time_range=None, limit: Optional[int] = None,
+                     filters: Optional[Sequence] = None) -> list:
+        """Pruned parallel scan. `filters` are the statement's WHERE
+        conjuncts (query/engine.py): they prune regions here, and the
+        pushable tag subset also ships over the wire so datanodes drop
+        dead rows before they ever cross a socket. `limit` travels only
+        when the shipped subset IS the whole predicate — otherwise a
+        frontend-side re-filter could leave fewer than `limit` rows."""
+        from ..mito.engine import pushable_tag_filter
+        filters = list(filters or ())
+        survivors, total = self._prune_regions(filters=filters,
+                                               time_range=time_range)
+        targets = self._owners_for(survivors)
+        tag_names = self.schema.tag_names()
+        ship = [f for f in filters if pushable_tag_filter(f, tag_names)]
+        wire_limit = limit if limit is not None and \
+            len(ship) == len(filters) else None
+        self._record_scatter(len(survivors), total, len(targets))
+        out: list = []
+        rows = 0
+        slowest = 0.0
+        for batches, dt_ms in self._scatter(
+                targets,
+                lambda c, regs: c.scan_batches(
+                    self.info.catalog_name, self.info.schema_name,
+                    self.info.name, projection=projection,
+                    time_range=time_range, limit=wire_limit,
+                    filters=ship or None, regions=regs),
+                what="scan"):
+            out.extend(batches)
+            rows += sum(b.num_rows for b in batches)
+            slowest = max(slowest, dt_ms)
+            if wire_limit is not None and rows >= wire_limit:
+                # enough rows: abandoning the gather cancels queued RPCs
+                # (the shipped filters ARE the predicate when a limit
+                # travels, so any `limit` matching rows answer exactly)
+                break
+        # string value: a statement that scatters twice must not SUM its
+        # slowest-node latencies (numeric details accumulate in ExecStats)
+        exec_stats.record("dist_scatter", rows=rows,
+                          slowest_node_ms=f"{slowest:.2f}")
         return out
 
+    def _plan_scatter(self, plan):
+        """(survivors, total, targets) for an aggregate plan, memoized
+        on the plan object — try_execute asks for the dispatch string
+        (scatter_describe) right before execute_tpu_plan runs the same
+        plan, and the route walk should happen once."""
+        cached = getattr(plan, "_dist_scatter_cache", None)
+        if cached is not None and cached[0] is self:
+            return cached[1]
+        survivors, total = self._prune_regions(
+            filters=plan.tag_predicates, time_lo=plan.time_lo,
+            time_hi=plan.time_hi)
+        targets = self._owners_for(survivors)
+        result = (survivors, total, targets)
+        plan._dist_scatter_cache = (self, result)
+        return result
+
     def execute_tpu_plan(self, plan) -> List[pd.DataFrame]:
-        """Aggregate pushdown: each datanode reduces its regions on device
-        and returns moment frames; the caller folds them."""
+        """Aggregate pushdown: prune regions by the plan's tag/time
+        predicates, then each surviving datanode reduces ONLY its
+        surviving regions on device; moment frames fold as they arrive."""
+        survivors, total, targets = self._plan_scatter(plan)
+        self._record_scatter(len(survivors), total, len(targets))
         frames: List[pd.DataFrame] = []
-        for client in self._involved_clients():
-            frames.extend(client.region_moments(
-                self.info.catalog_name, self.info.schema_name,
-                self.info.name, plan))
+        slowest = 0.0
+        for part, dt_ms in self._scatter(
+                targets,
+                lambda c, regs: c.region_moments(
+                    self.info.catalog_name, self.info.schema_name,
+                    self.info.name, plan, regions=regs),
+                what="region_moments"):
+            frames.extend(part)        # fold-as-they-arrive gather
+            slowest = max(slowest, dt_ms)
+        exec_stats.record("dist_scatter",
+                          slowest_node_ms=f"{slowest:.2f}")
         return frames
 
+    def scatter_describe(self, plan) -> str:
+        """The pruned-scatter dispatch line shared by EXPLAIN and
+        execution (query/tpu_exec.dispatch_decision_for_pushdown)."""
+        survivors, total, targets = self._plan_scatter(plan)
+        return (f"aggregate-pushdown (regions pruned "
+                f"{total - len(survivors)}/{total}, "
+                f"fan-out={len(targets)}; "
+                f"datanodes reduce, frontend folds)")
+
     def flush(self) -> None:
-        for client in self._involved_clients():
-            client.flush_table(self.info.catalog_name,
-                               self.info.schema_name, self.info.name)
+        """Flush every datanode's regions concurrently (the serial loop
+        used to pay the sum of N datanode flushes)."""
+        for _ in self._scatter(
+                self._owners_for(self._all_region_numbers()),
+                lambda c, regs: c.flush_table(
+                    self.info.catalog_name, self.info.schema_name,
+                    self.info.name),
+                what="flush_table"):
+            pass
 
 
 class _RouteHydratingCatalog(MemoryCatalogManager):
@@ -540,6 +816,11 @@ class DistInstance:
         if isinstance(stmt, ast.ShowFlows):
             from .statement import show_flows_output
             return show_flows_output(self.flow_manager, stmt, ctx)
+        if isinstance(stmt, ast.SetVariable):
+            # session/process knobs (SET dist_fanout, failpoint_*, ...)
+            # work on a cluster router too — one shared handler
+            from .statement import apply_set_variable
+            return apply_set_variable(stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):

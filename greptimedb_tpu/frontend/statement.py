@@ -169,6 +169,89 @@ def delete_matching_rows(table, stmt: ast.Delete) -> Output:
     return Output.rows(len(df))
 
 
+def _int_setting(stmt: ast.SetVariable) -> int:
+    try:
+        return int(stmt.value)
+    except (TypeError, ValueError):
+        raise InvalidArgumentsError(
+            f"SET {stmt.name}: expected an integer, got {stmt.value!r}")
+
+
+def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
+    """Shared SET handler: every knob here is session- or process-level
+    state, so the standalone executor and the distributed frontend
+    (DistInstance.execute_stmt) both route through this one function."""
+    name = stmt.name.lower()
+    if name in ("time_zone", "timezone"):
+        ctx.time_zone = str(stmt.value)
+    elif name == "slow_query_threshold_ms":
+        # 0 or negative disables; default comes from the
+        # GREPTIME_SLOW_QUERY_MS env/config (off when unset)
+        from ..common.telemetry import set_slow_query_threshold_ms
+        set_slow_query_threshold_ms(_int_setting(stmt))
+    elif name == "rollup_rewrite":
+        # flow rollup-rewrite kill switch (differential tests and
+        # operators compare against the raw path with it off)
+        from ..flow import rewrite as flow_rewrite
+        try:
+            flow_rewrite.set_enabled(bool(int(stmt.value)))
+        except (TypeError, ValueError):
+            raise InvalidArgumentsError(
+                f"SET {stmt.name}: expected 0 or 1, got {stmt.value!r}")
+    elif name.startswith("failpoint_"):
+        # fault-injection surface: SET failpoint_<point> = 'action'
+        # ('off' or 0 disarms). Same registry as GREPTIME_FAILPOINTS
+        # and /v1/admin/failpoints (common/failpoint.py).
+        from ..common import failpoint
+        point = name[len("failpoint_"):]
+        spec = str(stmt.value)
+        try:
+            failpoint.configure(point, None if spec in ("0", "off")
+                                else spec)
+        except ValueError as e:
+            raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+    elif name in ("objstore_max_retries", "objstore_retry_base_ms"):
+        from ..storage.retry import configure_retry
+        value = _int_setting(stmt)
+        if name == "objstore_max_retries":
+            configure_retry(max_retries=value)
+        else:
+            configure_retry(base_ms=value)
+    elif name == "dist_fanout":
+        # per-statement bound on concurrently in-flight datanode RPCs
+        # in the distributed scatter-gather (1 = serial, the pre-
+        # parallel behavior — the bench differential uses it)
+        from ..common.runtime import configure_dist_fanout
+        configure_dist_fanout(_int_setting(stmt))
+    elif name in ("dist_rpc_max_retries", "dist_rpc_retry_base_ms"):
+        from .distributed import configure_dist_rpc_retry
+        value = _int_setting(stmt)
+        if name == "dist_rpc_max_retries":
+            configure_dist_rpc_retry(max_retries=value)
+        else:
+            configure_dist_rpc_retry(base_ms=value)
+    elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
+        value = _int_setting(stmt)
+        if name == "stream_threshold_rows":
+            # expose the cold-scan streaming knob to SQL so operators
+            # (and the sqlness explain goldens) can pin the dispatch
+            # decision without a config reload
+            from ..query.stream_exec import configure_streaming
+            configure_streaming(threshold_rows=value)
+        else:
+            # static device-dispatch floor (the latency-adaptive
+            # floor never goes below it). Pinning it also resets the
+            # adaptive observation: an operator setting the floor
+            # expects it to take effect now, not to stay shadowed by
+            # the fixed-cost estimate of earlier queries — and the
+            # sqlness EXPLAIN ANALYZE goldens rely on the reset for
+            # deterministic dispatch lines.
+            from ..query import tpu_exec
+            tpu_exec.TPU_DISPATCH_MIN_ROWS = value
+            tpu_exec._observed_min_dt[0] = None
+    return Output.rows(0)
+
+
 class StatementExecutor:
     def __init__(self, catalog: CatalogManager,
                  engines: Dict[str, TableEngine], query_engine,
@@ -368,78 +451,7 @@ class StatementExecutor:
         return Output.rows(0)
 
     def set_variable(self, stmt: ast.SetVariable, ctx: QueryContext) -> Output:
-        name = stmt.name.lower()
-        if name in ("time_zone", "timezone"):
-            ctx.time_zone = str(stmt.value)
-        elif name == "slow_query_threshold_ms":
-            try:
-                value = int(stmt.value)
-            except (TypeError, ValueError):
-                raise InvalidArgumentsError(
-                    f"SET {stmt.name}: expected an integer, "
-                    f"got {stmt.value!r}")
-            # 0 or negative disables; default comes from the
-            # GREPTIME_SLOW_QUERY_MS env/config (off when unset)
-            from ..common.telemetry import set_slow_query_threshold_ms
-            set_slow_query_threshold_ms(value)
-        elif name == "rollup_rewrite":
-            # flow rollup-rewrite kill switch (differential tests and
-            # operators compare against the raw path with it off)
-            from ..flow import rewrite as flow_rewrite
-            try:
-                flow_rewrite.set_enabled(bool(int(stmt.value)))
-            except (TypeError, ValueError):
-                raise InvalidArgumentsError(
-                    f"SET {stmt.name}: expected 0 or 1, got {stmt.value!r}")
-        elif name.startswith("failpoint_"):
-            # fault-injection surface: SET failpoint_<point> = 'action'
-            # ('off' or 0 disarms). Same registry as GREPTIME_FAILPOINTS
-            # and /v1/admin/failpoints (common/failpoint.py).
-            from ..common import failpoint
-            point = name[len("failpoint_"):]
-            spec = str(stmt.value)
-            try:
-                failpoint.configure(point, None if spec in ("0", "off")
-                                    else spec)
-            except ValueError as e:
-                raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
-        elif name in ("objstore_max_retries", "objstore_retry_base_ms"):
-            from ..storage.retry import configure_retry
-            try:
-                value = int(stmt.value)
-            except (TypeError, ValueError):
-                raise InvalidArgumentsError(
-                    f"SET {stmt.name}: expected an integer, "
-                    f"got {stmt.value!r}")
-            if name == "objstore_max_retries":
-                configure_retry(max_retries=value)
-            else:
-                configure_retry(base_ms=value)
-        elif name in ("stream_threshold_rows", "tpu_dispatch_min_rows"):
-            try:
-                value = int(stmt.value)
-            except (TypeError, ValueError):
-                raise InvalidArgumentsError(
-                    f"SET {stmt.name}: expected an integer, "
-                    f"got {stmt.value!r}")
-            if name == "stream_threshold_rows":
-                # expose the cold-scan streaming knob to SQL so operators
-                # (and the sqlness explain goldens) can pin the dispatch
-                # decision without a config reload
-                from ..query.stream_exec import configure_streaming
-                configure_streaming(threshold_rows=value)
-            else:
-                # static device-dispatch floor (the latency-adaptive
-                # floor never goes below it). Pinning it also resets the
-                # adaptive observation: an operator setting the floor
-                # expects it to take effect now, not to stay shadowed by
-                # the fixed-cost estimate of earlier queries — and the
-                # sqlness EXPLAIN ANALYZE goldens rely on the reset for
-                # deterministic dispatch lines.
-                from ..query import tpu_exec
-                tpu_exec.TPU_DISPATCH_MIN_ROWS = value
-                tpu_exec._observed_min_dt[0] = None
-        return Output.rows(0)
+        return apply_set_variable(stmt, ctx)
 
     # ---- COPY ----
     def copy(self, stmt: ast.Copy, ctx: QueryContext) -> Output:

@@ -88,6 +88,28 @@ class FlightMetaServer(flight.FlightServerBase):
                     if body.get("alive_only", True) else self.srv.peers()
                 resp = {"ok": True,
                         "peers": [p.to_dict() for p in peers]}
+            elif kind == "kv_put":
+                # generic kv passthroughs (values base64 — they are
+                # bytes, e.g. flow-spec JSON docs under __flow/); a
+                # wire frontend recovers its flows from these
+                import base64
+                self.srv.kv.put(body["key"],
+                                base64.b64decode(body["value"]))
+                resp = {"ok": True}
+            elif kind == "kv_get":
+                import base64
+                v = self.srv.kv.get(body["key"])
+                resp = {"ok": True,
+                        "value": base64.b64encode(v).decode()
+                        if v is not None else None}
+            elif kind == "kv_range":
+                import base64
+                resp = {"ok": True, "items": [
+                    [k, base64.b64encode(v).decode()]
+                    for k, v in self.srv.kv.range(body["prefix"])]}
+            elif kind == "kv_delete":
+                resp = {"ok": True,
+                        "deleted": bool(self.srv.kv.delete(body["key"]))}
             elif kind == "raft_request_vote" and self.raft_node is not None:
                 resp = {"ok": True,
                         **self.raft_node.handle_request_vote(**body)}
@@ -189,6 +211,30 @@ class FlightMetaClient:
     def list_datanodes(self, alive_only: bool = True) -> List[Peer]:
         resp = self._action("list_datanodes", {"alive_only": alive_only})
         return [Peer.from_dict(p) for p in resp["peers"]]
+
+    # generic kv passthroughs (KvFlowStore persists flow specs under
+    # __flow/ — without these a WIRE frontend crashed at start trying
+    # to recover flows through the proxy's synthesized attribute)
+    def kv_put(self, key: str, value: bytes) -> None:
+        import base64
+        self._action("kv_put", {"key": key,
+                                "value": base64.b64encode(value).decode()})
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        import base64
+        v = self._action("kv_get", {"key": key}).get("value")
+        return base64.b64decode(v) if v is not None else None
+
+    def kv_range(self, prefix: str):
+        # eager, not a generator: the RPC must fire inside this call so
+        # FailoverFlightMetaClient's replica-walking wrapper (and any
+        # caller try block) sees a connection failure, not the iterator
+        import base64
+        return [(k, base64.b64decode(v)) for k, v in
+                self._action("kv_range", {"prefix": prefix})["items"]]
+
+    def kv_delete(self, key: str) -> bool:
+        return bool(self._action("kv_delete", {"key": key})["deleted"])
 
 
 class PeerClientRegistry(dict):

@@ -35,6 +35,7 @@ from ..errors import (
 from ..partition import rule_from_partitions, split_rows
 from ..partition.rule import (
     MAXVALUE,
+    HashPartitionRule,
     PartitionRule,
     RangeColumnsPartitionRule,
     RangePartitionRule,
@@ -88,6 +89,9 @@ def _serialize_rule(rule: Optional[PartitionRule]) -> Optional[dict]:
         return {"kind": "range_columns", "columns": rule.columns,
                 "bounds": [[enc(v) for v in b] for b in rule.bounds],
                 "regions": rule.regions}
+    if isinstance(rule, HashPartitionRule):
+        return {"kind": "hash", "columns": rule.columns,
+                "regions": rule.regions}
     raise InvalidArgumentsError(f"unserializable rule {type(rule)}")
 
 
@@ -98,12 +102,88 @@ def _deserialize_rule(d: Optional[dict]) -> Optional[PartitionRule]:
     def dec(v):
         return MAXVALUE if isinstance(v, dict) and v.get("maxvalue") else v
 
+    if d["kind"] == "hash":
+        return HashPartitionRule(list(d["columns"]), list(d["regions"]))
     if d["kind"] == "range":
         return RangePartitionRule(d["column"], [dec(b) for b in d["bounds"]],
                                   list(d["regions"]))
     return RangeColumnsPartitionRule(
         list(d["columns"]), [tuple(dec(v) for v in b) for b in d["bounds"]],
         list(d["regions"]))
+
+
+#: comparison shapes a datanode can apply exactly on its tag columns —
+#: the frontend only pushes `limit` over the wire when EVERY conjunct is
+#: pushable by this definition, so both sides must share it
+_PUSHABLE_OPS = {"=", "!=", "<", "<=", ">", ">="}
+
+
+def pushable_tag_filter(e, tag_names) -> bool:
+    """True iff `e` is a tag-vs-literal predicate the scan path can apply
+    exactly (shared by DistTable's wire encoder and the datanode)."""
+    from ..sql.ast import BinaryOp, Column, InList, Literal
+    tags = set(tag_names)
+    if isinstance(e, BinaryOp) and e.op in _PUSHABLE_OPS:
+        for col, lit in ((e.left, e.right), (e.right, e.left)):
+            if isinstance(col, Column) and col.name in tags and \
+                    isinstance(lit, Literal) and lit.value is not None:
+                return True
+        return False
+    if isinstance(e, InList) and isinstance(e.expr, Column) and \
+            e.expr.name in tags and e.items:
+        return all(isinstance(i, Literal) and i.value is not None
+                   for i in e.items)
+    return False
+
+
+def _tag_series_keep(series_dict, tag_names, filters) -> np.ndarray:
+    """Per-series keep mask for pushable tag filters: predicates evaluate
+    once per SERIES (via the dictionary), not once per row, then broadcast
+    through series_ids. NULL tags compare UNKNOWN → dropped, matching the
+    engine's `mask.fillna(False)` WHERE semantics."""
+    import operator
+    from ..sql.ast import BinaryOp, Column, InList, Literal
+    ops = {"=": operator.eq, "!=": operator.ne, "<": operator.lt,
+           "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+    S = series_dict.num_series
+    keep = np.ones(S, dtype=bool)
+    ids = np.arange(S, dtype=np.int32)
+    cache: Dict[str, list] = {}
+
+    def col_values(name: str):
+        if name not in cache:
+            cache[name] = series_dict.decode_tag_column(
+                ids, tag_names.index(name))
+        return cache[name]
+
+    for e in filters:
+        if isinstance(e, BinaryOp):
+            op = e.op
+            if isinstance(e.left, Column) and isinstance(e.right, Literal):
+                col, lit = e.left, e.right
+            else:
+                col, lit = e.right, e.left
+                op = flip.get(op, op)
+            vals = col_values(col.name)
+            fn = ops[op]
+            m = np.zeros(S, dtype=bool)
+            for i, v in enumerate(vals):
+                if v is None:
+                    continue
+                try:
+                    m[i] = bool(fn(v, lit.value))
+                except TypeError:
+                    m[i] = False
+            keep &= m
+        elif isinstance(e, InList):
+            items = {i.value for i in e.items}
+            vals = col_values(e.expr.name)
+            m = np.fromiter(
+                ((v is not None) and ((v in items) != e.negated)
+                 for v in vals), dtype=bool, count=S)
+            keep &= m
+    return keep
 
 
 class MitoTable(Table):
@@ -233,14 +313,43 @@ class MitoTable(Table):
 
     def scan_batches(self, projection: Optional[Sequence[str]] = None,
                      time_range: Optional[TimestampRange] = None,
-                     limit: Optional[int] = None) -> List[RecordBatch]:
+                     limit: Optional[int] = None,
+                     filters: Optional[Sequence] = None,
+                     regions: Optional[Sequence[int]] = None
+                     ) -> List[RecordBatch]:
+        """`filters`: pushable tag predicates applied region-side so a
+        pruned distributed scan stops shipping dead rows; `regions`:
+        restrict to this subset of hosted region numbers (the frontend's
+        surviving-region list — without it a datanode would scan its
+        un-pruned sibling regions too)."""
         out: List[RecordBatch] = []
         remaining = limit
         schema = self.schema if projection is None \
             else self.schema.project(self._scan_columns(projection))
-        for region in self.regions.values():
+        tag_names = self.schema.tag_names()
+        usable = [f for f in (filters or ())
+                  if pushable_tag_filter(f, tag_names)]
+        hosted = self.regions if regions is None else \
+            {rn: r for rn, r in self.regions.items() if rn in set(regions)}
+        for region in hosted.values():
             data = region.snapshot().read_merged(
                 projection=projection, time_range=time_range)
+            if usable and data.num_rows:
+                keep = _tag_series_keep(data.series_dict, tag_names,
+                                        usable)
+                if not keep.all():
+                    import dataclasses
+                    sel = keep[data.series_ids]
+                    data = dataclasses.replace(
+                        data,
+                        series_ids=data.series_ids[sel],
+                        ts=data.ts[sel],
+                        seq=data.seq[sel] if data.seq is not None else None,
+                        op_types=data.op_types[sel]
+                        if data.op_types is not None else None,
+                        fields={n: (d[sel],
+                                    vd[sel] if vd is not None else None)
+                                for n, (d, vd) in data.fields.items()})
             rb = self._scan_data_to_batch(data, schema)
             if remaining is not None:
                 rb = rb.slice(0, min(remaining, rb.num_rows))

@@ -10,6 +10,7 @@ region pruning (`find_regions_by_filters`, src/partition/src/manager.rs:192).
 
 from .rule import (
     MAXVALUE,
+    HashPartitionRule,
     PartitionRule,
     RangeColumnsPartitionRule,
     RangePartitionRule,
@@ -19,6 +20,7 @@ from .splitter import split_rows
 
 __all__ = [
     "MAXVALUE",
+    "HashPartitionRule",
     "PartitionRule",
     "RangePartitionRule",
     "RangeColumnsPartitionRule",

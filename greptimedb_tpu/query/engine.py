@@ -124,8 +124,10 @@ class QueryEngine:
                 est = tpu_exec._estimated_table_rows(table)
                 if hasattr(table, "execute_tpu_plan"):
                     lines.append("TpuAggregateExec: " + plan.describe())
-                    lines.append("  Dispatch: aggregate-pushdown "
-                                 "(datanodes reduce, frontend folds)")
+                    lines.append(
+                        "  Dispatch: " +
+                        tpu_exec.dispatch_decision_for_pushdown(table,
+                                                                plan))
                 elif est is not None and \
                         est < tpu_exec.TPU_DISPATCH_MIN_ROWS:
                     lines.append("CpuAggregateExec: " + plan.describe())
@@ -274,7 +276,23 @@ class QueryEngine:
                             refs.add(tc.name)
                     needed = [c for c in table.schema.names()
                               if c in refs]
-                batches = table.scan_batches(projection=needed)
+                if getattr(table, "supports_filter_pushdown", False):
+                    # distributed tables: thread the WHERE conjuncts in
+                    # (region pruning + wire-side tag filtering) and the
+                    # LIMIT when no later stage can change which rows
+                    # qualify (_run_on_frame still re-filters/limits —
+                    # pushdown only sheds rows, never decides)
+                    conj = tpu_exec._conjuncts(query.where)
+                    push_limit = None
+                    if query.limit is not None and not query.order_by \
+                            and not query.distinct and not a.is_aggregate \
+                            and not a.window_calls and not query.offset:
+                        push_limit = query.limit
+                    batches = table.scan_batches(
+                        projection=needed, filters=conj or None,
+                        limit=push_limit)
+                else:
+                    batches = table.scan_batches(projection=needed)
                 df = _batches_to_df(batches)
         exec_stats.record("scan", rows=len(df), cached=cached)
         return self._run_on_frame(df, a, query, table)
@@ -1004,7 +1022,17 @@ def _batches_to_df(batches: Optional[List[RecordBatch]]) -> pd.DataFrame:
         return pd.DataFrame()
     frames = []
     for b in batches:
-        frames.append(pd.DataFrame(b.to_pydict()))
+        df = pd.DataFrame(b.to_pydict())
+        if not len(df):
+            # an empty pylist column defaults to float64, and a later
+            # WHERE re-filter would then compare float64 vs str (pushed
+            # tag filters can legitimately empty every batch) — pin
+            # string/binary columns to object dtype from the schema
+            for cs in b.schema.column_schemas:
+                if (cs.dtype.is_string or cs.dtype.is_binary) and \
+                        cs.name in df.columns:
+                    df[cs.name] = df[cs.name].astype(object)
+        frames.append(df)
     df = pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
     return df
 

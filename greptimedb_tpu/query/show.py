@@ -121,7 +121,12 @@ def show_create_table(engine, stmt, ctx: QueryContext) -> Output:
     lines.append(",\n".join(defs))
     lines.append(")")
     rule = getattr(table, "partition_rule", None)
-    if rule is not None and getattr(rule, "bounds", None):
+    from ..partition.rule import HashPartitionRule
+    if isinstance(rule, HashPartitionRule):
+        cols = ", ".join(rule.partition_columns())
+        lines.append(f"PARTITION BY HASH ({cols}) "
+                     f"PARTITIONS {len(rule.regions)}")
+    elif rule is not None and getattr(rule, "bounds", None):
         # render the partition clause (reference SHOW CREATE TABLE
         # includes it, src/sql/src/statements/create.rs)
         cols = ", ".join(rule.partition_columns())

@@ -799,9 +799,11 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     try:
         if hasattr(table, "execute_tpu_plan"):
             # distributed: aggregate pushdown — datanodes reduce their
-            # regions, the frontend folds moment frames (_finalize)
-            exec_stats.set_dispatch(
-                "aggregate-pushdown (datanodes reduce, frontend folds)")
+            # regions, the frontend folds moment frames (_finalize).
+            # The table names its own scatter (pruning + fan-out) when it
+            # can, so EXPLAIN and execution print the same decision.
+            exec_stats.set_dispatch(dispatch_decision_for_pushdown(
+                table, plan))
             with span("tpu_pushdown", table=table.name), \
                     timer("tpu_pushdown"):
                 frames = [f for f in table.execute_tpu_plan(plan)
@@ -833,14 +835,30 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     return out
 
 
-def local_dispatch_decision(table, cold=None) -> str:
+def dispatch_decision_for_pushdown(table, plan) -> str:
+    """The ONE aggregate-pushdown dispatch string EXPLAIN (query/engine)
+    and execution (try_execute) both print. DistTable exposes
+    scatter_describe (regions pruned a/b, fan-out=k); other pushdown
+    tables get the generic line."""
+    describe = getattr(table, "scatter_describe", None)
+    if describe is not None:
+        try:
+            return describe(plan)
+        except Exception:  # noqa: BLE001 — describing must never fail a query
+            pass
+    return "aggregate-pushdown (datanodes reduce, frontend folds)"
+
+
+def local_dispatch_decision(table, cold=None, regions=None) -> str:
     """The resident / streamed / mixed decision string for a local
     region-backed table — the ONE source both EXPLAIN (query/engine.py)
     and execution (region_moment_frames → ExecStats) print, so the two
     views cannot drift. `cold` lets a caller that already evaluated
-    region_streams_cold per region pass the answers in."""
+    region_streams_cold per region pass the answers in; `regions` the
+    (possibly pruned) region list those answers correspond to."""
     from . import stream_exec
-    regions = list(table.regions.values())
+    if regions is None:
+        regions = list(table.regions.values())
     if cold is None:
         cold = [region_streams_cold(r) for r in regions]
     n_stream = sum(cold)
@@ -869,9 +887,14 @@ def region_streams_cold(region) -> bool:
          SCAN_CACHE.budget_bytes // 2)
 
 
-def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
+def region_moment_frames(table, plan: TpuPlan,
+                         regions: Optional[Sequence[int]] = None
+                         ) -> List[pd.DataFrame]:
     """Per-region moment frames for a table's local regions (shared by the
     single-node fast path and the datanode side of aggregate pushdown).
+    `regions` restricts to a subset of hosted region numbers — the
+    frontend's surviving-region list after partition pruning, so a
+    datanode does not scan its un-pruned siblings.
 
     Regions above the streaming threshold never enter the scan cache:
     their time domain is sliced and streamed through the device instead
@@ -879,9 +902,15 @@ def region_moment_frames(table, plan: TpuPlan) -> List[pd.DataFrame]:
     budget rather than the region size."""
     from ..common import exec_stats
     from . import stream_exec
-    regions = list(table.regions.values())
+    if regions is None:
+        regions = list(table.regions.values())
+    else:
+        want = set(regions)
+        regions = [r for rn, r in table.regions.items() if rn in want]
+    if not regions:
+        return []
     cold = [region_streams_cold(r) for r in regions]
-    exec_stats.set_dispatch(local_dispatch_decision(table, cold))
+    exec_stats.set_dispatch(local_dispatch_decision(table, cold, regions))
     frames = []
     for region, streams in zip(regions, cold):
         if streams:

@@ -194,11 +194,23 @@ class FlightDatanodeServer(flight.FlightServerBase):
         cmd = json.loads(ticket.ticket)
         kind = cmd.get("type")
         if kind == "scan":
+            from ..common.time import TimestampRange
+            from ..query.plan_codec import expr_from_dict
+            filters = [expr_from_dict(f) for f in cmd["filters"]] \
+                if cmd.get("filters") else None
+            # rebuild a real TimestampRange: Region.scan dereferences
+            # .start/.end, so the wire's [lo, hi] pair must not stay a
+            # tuple (ranges ship in ms, the region-native unit)
+            time_range = None
+            if cmd.get("time_range"):
+                lo, hi = cmd["time_range"]
+                time_range = TimestampRange(lo, hi)
             batches = self.local.scan_batches(
                 cmd["catalog"], cmd["schema"], cmd["table"],
                 projection=cmd.get("projection"),
-                time_range=tuple(cmd["time_range"])
-                if cmd.get("time_range") else None)
+                time_range=time_range,
+                limit=cmd.get("limit"), filters=filters,
+                regions=cmd.get("regions"))
             t = self.datanode.catalog.table(
                 cmd["catalog"], cmd["schema"], cmd["table"])
             fallback = None
@@ -210,7 +222,7 @@ class FlightDatanodeServer(flight.FlightServerBase):
             from ..query.plan_codec import plan_from_dict
             frames = self.local.region_moments(
                 cmd["catalog"], cmd["schema"], cmd["table"],
-                plan_from_dict(cmd["plan"]))
+                plan_from_dict(cmd["plan"]), regions=cmd.get("regions"))
             return _frames_stream(frames)
         raise GreptimeError(f"unsupported ticket {kind!r}")
 

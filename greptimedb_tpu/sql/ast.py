@@ -292,6 +292,8 @@ class PartitionEntry:
 class Partitions:
     columns: List[str]
     entries: List[PartitionEntry] = field(default_factory=list)
+    kind: str = "range"             # "range" | "hash"
+    num_partitions: Optional[int] = None   # hash only: bucket count
 
 
 @dataclass

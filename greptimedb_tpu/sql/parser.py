@@ -922,7 +922,27 @@ class Parser:
 
     def _parse_partitions(self, stmt: CreateTable) -> None:
         # PARTITION BY RANGE COLUMNS (a, b) (PARTITION p0 VALUES LESS THAN (...), ...)
+        # PARTITION BY HASH (a, b) PARTITIONS n
         self.expect_kw("BY")
+        if self.match_kw("HASH"):
+            self.expect_op("(")
+            cols = [self.parse_identifier()]
+            while self.match_op(","):
+                cols.append(self.parse_identifier())
+            self.expect_op(")")
+            self.expect_kw("PARTITIONS")
+            t = self.next()
+            try:
+                n = int(t.value)
+            except (TypeError, ValueError):
+                raise ParserError(
+                    f"PARTITIONS expects an integer, got {t.value!r} "
+                    f"at {t.pos}")
+            if n < 1:
+                raise ParserError(f"PARTITIONS must be >= 1, got {n}")
+            stmt.partitions = Partitions(cols, [], kind="hash",
+                                         num_partitions=n)
+            return
         self.expect_kw("RANGE")
         self.expect_kw("COLUMNS")
         self.expect_op("(")

@@ -23,24 +23,16 @@ failures that exhausted the budget and surfaced).
 from __future__ import annotations
 
 import logging
-import os
 import random
 import time
 from typing import List, Optional
 
+from ..common.runtime import env_int as _env_int
 from .object_store import ObjectStore, _SpoolPut
 
 logger = logging.getLogger(__name__)
 
 _MAX_BACKOFF_MS = 5000
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
 
 _max_retries: List[int] = [_env_int("GREPTIME_OBJSTORE_MAX_RETRIES", 3)]
 _base_ms: List[int] = [_env_int("GREPTIME_OBJSTORE_RETRY_BASE_MS", 50)]
@@ -65,6 +57,9 @@ def is_transient(exc: BaseException) -> bool:
     from ..common.failpoint import FailpointError
     if isinstance(exc, FailpointError):
         return exc.transient
+    from ..errors import TransientRpcError
+    if isinstance(exc, TransientRpcError):
+        return True
     from .s3 import S3TransientError
     if isinstance(exc, S3TransientError):
         return True

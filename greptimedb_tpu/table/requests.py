@@ -95,7 +95,10 @@ def create_request_to_dict(req: CreateTableRequest) -> dict:
     if req.partitions is not None:
         parts = {"columns": list(req.partitions.columns),
                  "entries": [{"name": e.name, "values": list(e.values)}
-                             for e in req.partitions.entries]}
+                             for e in req.partitions.entries],
+                 "kind": getattr(req.partitions, "kind", "range"),
+                 "num_partitions": getattr(req.partitions,
+                                           "num_partitions", None)}
     return {
         "table_name": req.table_name,
         "schema": req.schema.to_dict(),
@@ -120,7 +123,9 @@ def create_request_from_dict(d: dict) -> CreateTableRequest:
         parts = Partitions(
             columns=list(p["columns"]),
             entries=[PartitionEntry(e["name"], list(e["values"]))
-                     for e in p["entries"]])
+                     for e in p["entries"]],
+            kind=p.get("kind", "range"),
+            num_partitions=p.get("num_partitions"))
     return CreateTableRequest(
         table_name=d["table_name"],
         schema=Schema.from_dict(d["schema"]),

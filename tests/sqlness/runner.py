@@ -71,6 +71,12 @@ def _strip_comment_lines(stmt: str) -> str:
 #: flow watermark timestamps in SHOW FLOWS / information_schema.flows)
 _VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>"}
 
+#: wall-clock fragments inside EXPLAIN ANALYZE detail strings (the
+#: distributed scatter reports its slowest datanode's latency there)
+import re as _re  # noqa: E402
+
+_VOLATILE_DETAIL = _re.compile(r"slowest_node_ms=[0-9.]+")
+
 
 def _normalize_timings(out):
     """Replace volatile columns with fixed placeholders so goldens
@@ -85,7 +91,8 @@ def _normalize_timings(out):
 
     if not out.is_batches or not out.batches:
         return out
-    if not any(set(b.schema.names()) & set(_VOLATILE_COLUMNS)
+    if not any(set(b.schema.names()) & (set(_VOLATILE_COLUMNS) |
+                                        {"detail"})
                for b in out.batches):
         return out
     batches = []
@@ -97,6 +104,11 @@ def _normalize_timings(out):
                 data[cs.name] = [_VOLATILE_COLUMNS[cs.name]] * b.num_rows
                 cols.append(ColumnSchema(cs.name, dt.STRING))
             else:
+                if cs.name == "detail":
+                    data[cs.name] = [
+                        _VOLATILE_DETAIL.sub("slowest_node_ms=<ms>", v)
+                        if isinstance(v, str) else v
+                        for v in data[cs.name]]
                 cols.append(cs)
         schema = Schema(cols)
         batches.append(RecordBatch.from_pydict(schema, data))

@@ -247,8 +247,8 @@ class TestDistributedData:
         calls = []
         for c in fe.clients.values():
             orig = c.region_moments
-            c.region_moments = (lambda *a, _o=orig: (calls.append(1),
-                                                     _o(*a))[1])
+            c.region_moments = (lambda *a, _o=orig, **kw: (calls.append(1),
+                                                           _o(*a, **kw))[1])
         out = fe.do_query("SELECT count(*) AS c FROM dist")[-1]
         assert out.batches[0].to_pylist()[0]["c"] == 160
         assert len(calls) == 2, "pushdown did not fan out to both clients"
