@@ -391,18 +391,31 @@ def bench_dist_scatter(n_rows: int):
                "GROUP BY hostname")
         fe.do_query(sql, ctx)              # absorb one-time costs
 
-        def timed(cold: bool, iters: int = 2):
+        def timed(cold: bool, iters: int = 2, node_ms_out: dict = None):
             dt = float("inf")
             for _ in range(iters):         # best of N: noisy shared hosts
                 if cold:
                     tpu_exec.SCAN_CACHE._entries.clear()
                 t0 = time.perf_counter()
                 fe.do_query(sql, ctx)
-                dt = min(dt, time.perf_counter() - t0)
+                it = time.perf_counter() - t0
+                if it < dt and node_ms_out is not None:
+                    # snapshot the vector of the BEST iteration, so the
+                    # emitted per-node breakdown profiles the same run
+                    # as the throughput published next to it
+                    node_ms_out.clear()
+                    node_ms_out.update(table.last_scatter_node_ms)
+                dt = min(dt, it)
             return dt
 
         configure_dist_fanout(8)
-        dt_parallel = timed(cold=True)
+        # per-node latency vector of the winning parallel scatter (ISSUE
+        # 6: the per-node timings the old slowest_node_ms max discarded)
+        from greptimedb_tpu.common.exec_stats import node_sort_key
+        best_node_ms: dict = {}
+        dt_parallel = timed(cold=True, node_ms_out=best_node_ms)
+        node_ms = {k: round(best_node_ms[k], 2)
+                   for k in sorted(best_node_ms, key=node_sort_key)}
         configure_dist_fanout(1)           # the pre-PR serial scatter
         dt_serial = timed(cold=True)
 
@@ -424,7 +437,7 @@ def bench_dist_scatter(n_rows: int):
         dispatch = fe.query_engine.last_exec_stats.dispatch
         assert "regions pruned 7/8" in dispatch, dispatch
         return (n / dt_parallel, dt_serial / dt_parallel,
-                dt_ser_net / dt_par_net)
+                dt_ser_net / dt_par_net, node_ms)
     finally:
         configure_dist_fanout(saved_fanout)
         for dn in datanodes.values():
@@ -480,7 +493,8 @@ def main():
     }))
 
     dist_rows = int(os.environ.get("GREPTIME_BENCH_DIST_ROWS", 2_000_000))
-    dist_rps, vs_serial, vs_serial_net = bench_dist_scatter(dist_rows)
+    dist_rps, vs_serial, vs_serial_net, node_ms = \
+        bench_dist_scatter(dist_rows)
     print(json.dumps({
         "metric": "dist_scatter_gather_throughput",
         "value": round(dist_rps / 1e6, 2),
@@ -489,6 +503,7 @@ def main():
         "vs_serial_warm_10ms_rpc": round(vs_serial_net, 2),
         "rows": dist_rows,
         "datanodes": 4,
+        "scatter_node_ms": node_ms,
     }))
 
     fp_rows = int(os.environ.get("GREPTIME_BENCH_FAILPOINT_ROWS",

@@ -59,6 +59,18 @@ _FAILPOINTS_SCHEMA = Schema([
     ColumnSchema("fires", dt.INT64),
 ])
 
+_CLUSTER_INFO_SCHEMA = Schema([
+    ColumnSchema("peer_id", dt.INT64),
+    ColumnSchema("peer_type", dt.STRING),
+    ColumnSchema("peer_addr", dt.STRING),
+    ColumnSchema("lease_state", dt.STRING),
+    ColumnSchema("last_seen_ms", dt.INT64, nullable=True),
+    ColumnSchema("region_count", dt.INT64),
+    ColumnSchema("approximate_rows", dt.INT64),
+    ColumnSchema("ingest_rate_rps", dt.FLOAT64),
+    ColumnSchema("region_stats", dt.STRING),
+])
+
 _FLOWS_SCHEMA = Schema([
     ColumnSchema("flow_name", dt.STRING),
     ColumnSchema("source_table", dt.STRING),
@@ -134,21 +146,80 @@ def _engine_gauges(catalog_manager, catalog_name: str):
     return rows
 
 
-def _prometheus_samples():
-    """Every sample the /metrics endpoint would render, via the same
-    default registry prometheus_client.generate_latest reads."""
+def _collect_families():
+    """One walk of the default Prometheus registry, shared by the raw
+    sample rows and the pXX summaries (the registry grows with statement
+    kinds × protocols × routes — don't materialize it twice per query)."""
     try:
         from prometheus_client import REGISTRY
     except ImportError:  # pragma: no cover — prometheus is baked in
         return []
+    return list(REGISTRY.collect())
+
+
+def _prometheus_samples(families=None):
+    """Every sample the /metrics endpoint would render, via the same
+    default registry prometheus_client.generate_latest reads."""
+    if families is None:
+        families = _collect_families()
     rows = []
-    for family in REGISTRY.collect():
+    for family in families:
         for s in family.samples:
             labels = "{" + ", ".join(
                 f'{k}="{v}"' for k, v in sorted(s.labels.items())) + "}" \
                 if s.labels else ""
             rows.append((s.name, labels, float(s.value), family.type))
     return rows
+
+
+def _latency_summary_rows(families=None):
+    """p50/p95/p99 gauge rows interpolated from every histogram in the
+    registry (telemetry.latency_summaries) — the summarized view of the
+    log-bucketed latency distributions next to their raw samples."""
+    from ..common.telemetry import latency_summaries
+    return [(name, labels, float(value), "summary")
+            for name, labels, value in latency_summaries(
+                families=families)]
+
+
+def _cluster_nodes(catalog_manager, catalog_name: str):
+    """cluster_info rows: from the meta service when this frontend is
+    clustered (DistInstance pins `meta_client` on its catalog), else a
+    single synthesized row for the standalone process so the view exists
+    on every topology."""
+    meta = getattr(catalog_manager, "meta_client", None)
+    if meta is not None and hasattr(meta, "cluster_info"):
+        try:
+            # advisory() bounds a failover client to one quick pass over
+            # the replicas: the health view must degrade immediately
+            # when meta is down, not stall behind the write-path's
+            # multi-round retry budget
+            if hasattr(meta, "advisory"):
+                meta = meta.advisory()
+            return meta.cluster_info()
+        except Exception:  # noqa: BLE001 — health view over a flaky
+            import logging                 # meta must degrade, not 500
+            logging.getLogger(__name__).exception(
+                "cluster_info: meta unreachable")
+            return []
+    import json as _json
+    import time as _time
+    from ..query.stream_exec import region_stat_entries
+    regions = []
+    for schema_name in catalog_manager.schema_names(catalog_name):
+        for tname in catalog_manager.table_names(catalog_name,
+                                                 schema_name):
+            t = catalog_manager.table(catalog_name, schema_name, tname)
+            regions.extend((getattr(t, "regions", None) or {}).values())
+    region_stats, total_rows, _ = region_stat_entries(regions)
+    return [{
+        "peer_id": 0, "peer_type": "standalone", "peer_addr": "",
+        "lease_state": "alive", "last_seen_ms": int(_time.time() * 1000),
+        "region_count": len(region_stats),
+        "approximate_rows": total_rows, "ingest_rate_rps": 0.0,
+        "region_stats": _json.dumps(region_stats,
+                                    separators=(",", ":")),
+    }]
 
 
 class _VirtualTable(Table):
@@ -254,10 +325,21 @@ def information_schema_table(catalog_manager, catalog_name: str,
             }
         return _VirtualTable("failpoints", _FAILPOINTS_SCHEMA,
                              build_failpoints)
+    if name == "cluster_info":
+        def build_cluster_info():
+            rows = {k: [] for k in _CLUSTER_INFO_SCHEMA.names()}
+            for node in _cluster_nodes(catalog_manager, catalog_name):
+                for k in rows:
+                    rows[k].append(node.get(k))
+            return rows
+        return _VirtualTable("cluster_info", _CLUSTER_INFO_SCHEMA,
+                             build_cluster_info)
     if name == "runtime_metrics":
         def build_metrics():
-            samples = _prometheus_samples() + \
-                _engine_gauges(catalog_manager, catalog_name)
+            families = _collect_families()
+            samples = _prometheus_samples(families) + \
+                _engine_gauges(catalog_manager, catalog_name) + \
+                _latency_summary_rows(families)
             samples.sort(key=lambda r: (r[0], r[1]))
             return {
                 "metric_name": [r[0] for r in samples],

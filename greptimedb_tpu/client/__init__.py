@@ -124,9 +124,15 @@ class LocalDatanodeClient(DatanodeClient):
                      time_range=None, limit: Optional[int] = None,
                      filters: Optional[Sequence] = None,
                      regions: Optional[Sequence[int]] = None) -> list:
-        return self._table(catalog, schema, table).scan_batches(
-            projection=projection, time_range=time_range, limit=limit,
-            filters=filters, regions=regions)
+        from ..common import exec_stats
+        with exec_stats.stage("scan"):
+            batches = self._table(catalog, schema, table).scan_batches(
+                projection=projection, time_range=time_range, limit=limit,
+                filters=filters, regions=regions)
+        # same stage name the Flight datanode server records, so the
+        # per-node EXPLAIN ANALYZE tree is identical on both transports
+        exec_stats.record("scan", rows=sum(b.num_rows for b in batches))
+        return batches
 
     def flush_table(self, catalog: str, schema: str, table: str) -> None:
         self._table(catalog, schema, table).flush()

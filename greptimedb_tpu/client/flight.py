@@ -20,11 +20,32 @@ import pandas as pd
 import pyarrow as pa
 import pyarrow.flight as flight
 
+from ..common import exec_stats
+from ..common.telemetry import current_traceparent
 from ..datatypes.record_batch import RecordBatch
 from ..errors import GreptimeError, TableNotFoundError
 from ..table.metadata import TableInfo
 from ..table.requests import CreateTableRequest
 from . import DatanodeClient
+
+
+def _traced(body: dict) -> dict:
+    """Attach the caller's W3C trace context so the server joins this
+    trace (servers pop the key before dispatching)."""
+    tp = current_traceparent()
+    return {**body, "traceparent": tp} if tp is not None else body
+
+
+def _absorb_stream_stats(schema: pa.Schema) -> None:
+    """Replay datanode-side ExecStats riding the stream schema into the
+    active collector (the per-RPC node sub-collector during a scatter)."""
+    raw = (schema.metadata or {}).get(exec_stats.EXEC_STATS_WIRE_KEY)
+    if not raw:
+        return
+    try:
+        exec_stats.absorb_remote(json.loads(raw))
+    except (ValueError, TypeError, KeyError):
+        pass                 # stats are advisory; never fail a read
 
 
 def _columns_to_arrow(columns: Dict[str, Sequence]) -> pa.Table:
@@ -65,7 +86,7 @@ class _FlightBase:
     def _action(self, kind: str, body: dict) -> dict:
         try:
             results = list(self.conn.do_action(
-                flight.Action(kind, json.dumps(body).encode())))
+                flight.Action(kind, json.dumps(_traced(body)).encode())))
             resp = json.loads(results[0].body.to_pybytes())
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
@@ -78,7 +99,7 @@ class _FlightBase:
 
     def _put(self, command: dict, data: pa.Table) -> int:
         descriptor = flight.FlightDescriptor.for_command(
-            json.dumps(command).encode())
+            json.dumps(_traced(command)).encode())
         try:
             writer, reader = self.conn.do_put(descriptor, data.schema)
             with writer:
@@ -88,6 +109,11 @@ class _FlightBase:
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
         meta = json.loads(buf.to_pybytes()) if buf is not None else {}
+        if meta.get("exec_stats"):
+            try:
+                exec_stats.absorb_remote(meta["exec_stats"])
+            except (ValueError, TypeError, KeyError):
+                pass         # advisory: a write that landed must not fail
         return int(meta.get("affected_rows", 0))
 
 
@@ -124,12 +150,12 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
     def region_moments(self, catalog: str, schema: str, table: str,
                        plan, regions=None) -> List[pd.DataFrame]:
         from ..query.plan_codec import plan_to_dict
-        ticket = flight.Ticket(json.dumps(
+        ticket = flight.Ticket(json.dumps(_traced(
             {"type": "region_moments", "catalog": catalog,
              "schema": schema, "table": table,
              "plan": plan_to_dict(plan),
              "regions": list(regions) if regions is not None
-             else None}).encode())
+             else None})).encode())
         frames = []
         try:
             reader = self.conn.do_get(ticket)
@@ -140,6 +166,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
                     break
                 if chunk.data is not None:
                     frames.append(chunk.data.to_pandas())
+            _absorb_stream_stats(reader.schema)
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
         return [f for f in frames if len(f)]
@@ -152,7 +179,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
         from ..query.plan_codec import expr_to_dict
         if time_range is not None and hasattr(time_range, "start"):
             time_range = (time_range.start, time_range.end)
-        ticket = flight.Ticket(json.dumps(
+        ticket = flight.Ticket(json.dumps(_traced(
             {"type": "scan", "catalog": catalog, "schema": schema,
              "table": table, "projection": list(projection)
              if projection is not None else None,
@@ -162,7 +189,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
              "filters": [expr_to_dict(f) for f in filters]
              if filters else None,
              "regions": list(regions)
-             if regions is not None else None}).encode())
+             if regions is not None else None})).encode())
         out = []
         try:
             reader = self.conn.do_get(ticket)
@@ -173,6 +200,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
                     break
                 if chunk.data is not None:
                     out.append(RecordBatch.from_arrow(chunk.data))
+            _absorb_stream_stats(reader.schema)
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
         return out
@@ -200,8 +228,8 @@ class Database(_FlightBase):
     def sql(self, sql: str):
         """Run SQL; returns list[RecordBatch] for queries, int affected
         rows for DML/DDL."""
-        ticket = flight.Ticket(json.dumps(
-            {"type": "sql", "sql": sql}).encode())
+        ticket = flight.Ticket(json.dumps(_traced(
+            {"type": "sql", "sql": sql})).encode())
         try:
             reader = self.conn.do_get(ticket)
             table = reader.read_all()

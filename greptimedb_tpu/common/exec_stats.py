@@ -23,11 +23,22 @@ The collector is installed per top-level query (`collect()`), is
 thread-safe (streamed slices report from pool workers), and a missing
 collector makes every record call a no-op, so hot paths pay only a
 thread-local read when nobody is watching.
+
+Cluster-wide (ISSUE 6): datanode-side stats cross the RPC boundary —
+the Flight datanode server runs each scan/moments/write under its own
+collector and ships `to_dict()` back in the response; the frontend's
+per-RPC sub-collector `absorb()`s it, and `record_node()` hangs the
+whole sub-collector off the statement's collector. `rows_table()` then
+renders a per-node, per-stage tree under the dist_scatter line — each
+node row naming its actual dispatch plus node-elapsed vs network time —
+so a distributed EXPLAIN ANALYZE no longer collapses everything behind
+the wire into one number.
 """
 
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -35,6 +46,11 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 _tls = threading.local()
+
+#: wire key for datanode-side ExecStats riding a Flight response (stream
+#: schema metadata on do_get, the JSON ack on do_put) — one definition
+#: shared by both sides of the protocol so they cannot drift
+EXEC_STATS_WIRE_KEY = b"gdb.exec_stats"
 
 
 @dataclass
@@ -57,6 +73,12 @@ class ExecStats:
         self.stages: "OrderedDict[str, StageStat]" = OrderedDict()
         self.dispatch: Optional[str] = None
         self.total_s: float = 0.0
+        #: node label -> {"stats": ExecStats, "wall_ms": float} — one
+        #: sub-collector per datanode RPC (DistTable._scatter)
+        self.nodes: "OrderedDict[str, dict]" = OrderedDict()
+        #: sum of remote-reported totals absorbed into THIS collector
+        #: (wall - remote_total = wire/serialization cost)
+        self.remote_total_ms: float = 0.0
 
     # ---- recording ----
     def record(self, stage: str, *, rows: int = 0, files: int = 0,
@@ -94,6 +116,59 @@ class ExecStats:
             if self.dispatch is None:
                 self.dispatch = decision
 
+    def record_node(self, label: str, stats: "ExecStats",
+                    wall_ms: float) -> None:
+        """Attach one datanode RPC's sub-collector. `wall_ms` is the
+        frontend-observed round trip; the node's own total (remote or
+        summed stage time) subtracts out to the network share. A second
+        scatter in the same statement reusing a label gets `#n`."""
+        with self._lock:
+            base, n = label, 1
+            while label in self.nodes:
+                n += 1
+                label = f"{base}#{n}"
+            self.nodes[label] = {"stats": stats, "wall_ms": float(wall_ms)}
+
+    # ---- wire codec ----
+    def to_dict(self) -> Dict:
+        """JSON-safe snapshot for shipping over an RPC response."""
+        with self._lock:
+            return {
+                "dispatch": self.dispatch,
+                "total_ms": round(self.total_s * 1e3, 3),
+                "stages": [{
+                    "stage": st.stage, "rows": st.rows, "files": st.files,
+                    "elapsed_ms": round(st.elapsed_s * 1e3, 3),
+                    "detail": {k: _json_safe(v)
+                               for k, v in st.detail.items()},
+                } for st in self.stages.values()],
+            }
+
+    def absorb(self, d: Dict) -> None:
+        """Replay a remote collector's to_dict() into this one (the
+        frontend-side twin of the datanode's recording)."""
+        if d.get("dispatch"):
+            self.set_dispatch(d["dispatch"])
+        for st in d.get("stages", ()):
+            self.record(st.get("stage", "?"), rows=st.get("rows", 0),
+                        files=st.get("files", 0),
+                        elapsed_s=float(st.get("elapsed_ms", 0.0)) / 1e3,
+                        **(st.get("detail") or {}))
+        with self._lock:
+            self.remote_total_ms += float(d.get("total_ms", 0.0))
+
+    def node_elapsed_ms(self, wall_ms: float = 0.0) -> float:
+        """The node-side share of a sub-collector: the remote-reported
+        total when the stats crossed a wire; for an in-process RPC the
+        round trip IS node work (no network), so the wall time itself.
+        (Summing stage timings would double-count — a wrapper stage like
+        'scan' overlaps the 'decode'/'prune' stages recorded inside its
+        window.)"""
+        with self._lock:
+            if self.remote_total_ms > 0:
+                return self.remote_total_ms
+        return wall_ms
+
     # ---- rendering ----
     def summary(self) -> str:
         """One-line digest for the slow-query log."""
@@ -104,6 +179,12 @@ class ExecStats:
                 if st.rows:
                     bit += f"/{st.rows}r"
                 parts.append(bit)
+            if self.nodes:
+                parts.append("nodes=" + ",".join(
+                    f"{k}:{v['wall_ms']:.1f}ms"
+                    for k, v in sorted(self.nodes.items(),
+                                       key=lambda kv: node_sort_key(
+                                           kv[0]))))
             parts.append(f"total={self.total_s * 1e3:.1f}ms")
         return " ".join(parts)
 
@@ -121,11 +202,65 @@ class ExecStats:
 
         with self._lock:
             add("dispatch", 0, 0, 0.0, self.dispatch or "n/a")
+            # node blocks sorted by label: gather completion order is
+            # nondeterministic, golden files must not be
+            node_items = sorted(self.nodes.items(),
+                                key=lambda kv: node_sort_key(kv[0]))
+            nodes_emitted = False
             for st in self.stages.values():
                 add(st.stage, st.rows, st.files, st.elapsed_s * 1e3,
                     st.detail_str())
+                if st.stage == "dist_scatter" and not nodes_emitted:
+                    nodes_emitted = True
+                    _add_node_rows(add, node_items)
+            if node_items and not nodes_emitted:
+                _add_node_rows(add, node_items)
             add("total", 0, 0, self.total_s * 1e3, "")
         return cols
+
+
+def node_sort_key(label: str):
+    """Natural order for node labels: dn2 before dn10 (a lexicographic
+    sort misorders clusters with 10+ datanodes). Shared by the ANALYZE
+    tree, the slow-query nodes= digest, and the node_ms vector."""
+    return [int(part) if part.isdigit() else part
+            for part in re.split(r"(\d+)", label)]
+
+
+def _json_safe(v):
+    """Detail values may be numpy scalars (row counts summed by storage
+    code); coerce to plain JSON types for the wire."""
+    if isinstance(v, (str, bool, int, float)) or v is None:
+        return v
+    item = getattr(v, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+    return str(v)
+
+
+def _add_node_rows(add, node_items) -> None:
+    """Per-node blocks of the EXPLAIN ANALYZE tree: a header row naming
+    the node's actual dispatch + node-vs-network split, then its stage
+    rows indented underneath."""
+    for label, entry in node_items:
+        ns: "ExecStats" = entry["stats"]
+        wall_ms = entry["wall_ms"]
+        node_ms = ns.node_elapsed_ms(wall_ms)
+        net_ms = max(0.0, wall_ms - node_ms)
+        with ns._lock:
+            stages = list(ns.stages.values())
+            dispatch = ns.dispatch
+        rows = max((st.rows for st in stages), default=0)
+        files = sum(st.files for st in stages)
+        add(f"  {label}", rows, files, wall_ms,
+            f"dispatch={dispatch or 'n/a'}; node_ms={node_ms:.2f} "
+            f"network_ms={net_ms:.2f}")
+        for st in stages:
+            add(f"    {st.stage}", st.rows, st.files, st.elapsed_s * 1e3,
+                st.detail_str())
 
 
 # ---------------------------------------------------------------------------
@@ -167,6 +302,14 @@ def record(stage: str, **kwargs) -> None:
     s = current()
     if s is not None:
         s.record(stage, **kwargs)
+
+
+def absorb_remote(d) -> None:
+    """Replay a remote to_dict() into the active collector, if any —
+    what a wire client calls after parsing the response's stats."""
+    s = current()
+    if s is not None and d:
+        s.absorb(d)
 
 
 def set_dispatch(decision: str) -> None:

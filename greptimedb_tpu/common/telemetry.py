@@ -9,6 +9,12 @@ Python twin:
 - `span(name, **attrs)` — nested tracing spans carried in a thread-local
   (trace_id/span_id/parent), logged on exit with duration; the active
   trace context rides log records via a logging.Filter.
+- `current_traceparent()` / `remote_context(header)` — W3C-traceparent
+  wire propagation: every cross-process RPC (Flight scan/moments/write,
+  SQL-over-Flight, meta actions, HTTP `traceparent` header) carries the
+  caller's trace context, and the receiving process installs it so its
+  spans JOIN the caller's trace instead of minting a fresh one. One
+  statement = one trace id across frontend, datanodes and meta.
 - `propagate(fn)` — capture the caller's span stack at submit time and
   re-install it around `fn` in whatever worker thread runs it, so spans
   opened on the `common/runtime` pools stay parented to the trace.
@@ -100,10 +106,12 @@ def span(name: str, **attrs) -> Iterator[Dict]:
     if stack is None:
         stack = _tls.spans = []
     parent = stack[-1] if stack else None
+    # full 16-byte trace / 8-byte span ids: they travel verbatim in W3C
+    # traceparent headers, so both processes log the SAME hex string
     s = {
         "name": name,
-        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex[:16],
-        "span_id": uuid.uuid4().hex[:8],
+        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
         "parent_id": parent["span_id"] if parent else None,
         "attrs": attrs,
         "start": time.perf_counter(),
@@ -158,6 +166,82 @@ def propagate(fn):
             finally:
                 _tls.spans = prev if prev is not None else []
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# wire trace propagation (W3C traceparent: 00-<trace>-<span>-<flags>)
+# ---------------------------------------------------------------------------
+
+def current_traceparent() -> Optional[str]:
+    """W3C traceparent header for the active span, or None outside a
+    trace. Attach this to every outbound RPC (Flight ticket / action
+    body / do_put command, HTTP header) so the receiving process joins
+    this trace."""
+    s = current_span()
+    if s is None:
+        return None
+    trace = s["trace_id"][:32].ljust(32, "0")
+    span_id = s["span_id"][:16].ljust(16, "0")
+    return f"00-{trace}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple]:
+    """(trace_id, parent_span_id) from a traceparent header; None when
+    absent or malformed (propagation is advisory — a bad header must
+    never fail a request)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or len(trace) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(version, 16), int(trace, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    # W3C: version 0xff is forbidden; version 00 has exactly 4 fields
+    # (higher versions may append more — parse their known prefix);
+    # all-zero trace/parent ids are invalid and must be treated as absent
+    if version.lower() == "ff" or (version == "00" and len(parts) != 4) \
+            or int(trace, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return trace, span_id
+
+
+@contextlib.contextmanager
+def remote_context(traceparent: Optional[str]) -> Iterator[Optional[Dict]]:
+    """Install a remote caller's trace context on this thread for the
+    duration: spans opened underneath inherit the remote trace_id and
+    parent onto the caller's span, and log records carry the shared
+    trace id. A missing/malformed header is a no-op (fresh trace)."""
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        yield None
+        return
+    trace_id, span_id = parsed
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    frame = {
+        "name": "remote",
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": None,
+        "attrs": {"remote": True},
+        "start": time.perf_counter(),
+        "start_unix_ns": time.time_ns(),
+    }
+    stack.append(frame)
+    try:
+        yield frame
+    finally:
+        if stack and stack[-1] is frame:
+            stack.pop()
+        elif frame in stack:          # defensive: unbalanced nesting
+            stack.remove(frame)
 
 
 # ---------------------------------------------------------------------------
@@ -362,3 +446,124 @@ def timer(name: str) -> Iterator[None]:
         yield
     finally:
         _observe(name, time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# latency histograms (log-bucketed; reference: the HISTOGRAM_* statics in
+# src/servers/src/metrics.rs — per-protocol request latency distributions
+# exported in Prometheus histogram text format)
+# ---------------------------------------------------------------------------
+
+#: geometric (×2) bucket bounds, 100µs … ~52s: log-spaced so one layout
+#: resolves both a 300µs cache hit and a 30s cold scan with bounded
+#: relative error; exported as cumulative `le` buckets on /metrics.
+LATENCY_BUCKETS = tuple(1e-4 * (2.0 ** k) for k in range(20))
+
+#: sanitized key → (Histogram, labelnames) for observe_latency metrics
+_latency_hists: Dict[str, tuple] = {}
+
+#: (key, labelnames) pairs already warned about — mismatches log once
+_latency_label_mismatches: set = set()
+
+
+def observe_latency(name: str, seconds: float, **labels) -> None:
+    """Record one observation on the log-bucketed latency histogram
+    `greptime_<name>_seconds{**labels}`. Label NAMES must be stable per
+    metric (prometheus fixes them at creation); a mismatched call is
+    dropped with an error instead of raising on a hot path."""
+    try:
+        from prometheus_client import Histogram
+    except ImportError:  # pragma: no cover
+        return
+    key = _sanitize(name)
+    labelnames = tuple(sorted(labels))
+    with _metrics_lock:
+        entry = _latency_hists.get(key)
+        if entry is None:
+            try:
+                h = Histogram(f"greptime_{key}_seconds", f"latency {name}",
+                              labelnames=labelnames,
+                              buckets=LATENCY_BUCKETS)
+            except ValueError:
+                # name already registered (e.g. a timer() minted
+                # greptime_<key>_seconds first): drop observations
+                # instead of raising on the request hot path, and cache
+                # the verdict so only the first call pays the logging
+                logger.error(
+                    "latency metric %r collides with an existing "
+                    "greptime_%s_seconds series; observations dropped",
+                    name, key)
+                h = None
+            entry = _latency_hists[key] = (h, labelnames)
+    h, created_names = entry
+    if h is None:
+        return
+    if created_names != labelnames:
+        # log once per (metric, label-set) pair, not once per statement:
+        # a mismatched hot-path call site would otherwise flood the log
+        # at request rate
+        warn_key = (key, labelnames)
+        with _metrics_lock:
+            seen = warn_key in _latency_label_mismatches
+            _latency_label_mismatches.add(warn_key)
+        if not seen:
+            logger.error("latency metric %r called with labels %r but "
+                         "created with %r; observations dropped", name,
+                         labelnames, created_names)
+        return
+    (h.labels(**labels) if labelnames else h).observe(float(seconds))
+
+
+def latency_summaries(quantiles=(0.5, 0.95, 0.99), families=None):
+    """(name_pNN, labels_str, value_seconds) estimates for every
+    histogram in the registry, interpolated from its cumulative buckets —
+    the p50/p95/p99 rows information_schema.runtime_metrics serves next
+    to the raw counters. Pass `families` (pre-collected metric families)
+    to reuse one registry walk for both the raw samples and these
+    summaries."""
+    if families is None:
+        try:
+            from prometheus_client import REGISTRY
+        except ImportError:  # pragma: no cover
+            return []
+        families = REGISTRY.collect()
+    out = []
+    for family in families:
+        if family.type != "histogram":
+            continue
+        groups: Dict[tuple, list] = {}
+        for s in family.samples:
+            if not s.name.endswith("_bucket"):
+                continue
+            key = tuple(sorted((k, v) for k, v in s.labels.items()
+                               if k != "le"))
+            groups.setdefault(key, []).append(
+                (float(s.labels["le"]), float(s.value)))
+        for key, buckets in groups.items():
+            buckets.sort()
+            total = buckets[-1][1]
+            if total <= 0:
+                continue
+            labels = "{" + ", ".join(f'{k}="{v}"' for k, v in key) + "}" \
+                if key else ""
+            for q in quantiles:
+                target = q * total
+                prev_le, prev_c = 0.0, 0.0
+                value = buckets[-1][0]
+                for le, c in buckets:
+                    if c >= target:
+                        if le == float("inf"):
+                            # open-ended tail: clamp at the last finite
+                            # bound instead of inventing a magnitude
+                            value = prev_le
+                        else:
+                            frac = (target - prev_c) / max(c - prev_c,
+                                                           1e-12)
+                            value = prev_le + (le - prev_le) * frac
+                        break
+                    prev_le, prev_c = le, c
+                out.append((f"{family.name}_seconds_p{int(q * 100)}"
+                            if not family.name.endswith("_seconds")
+                            else f"{family.name}_p{int(q * 100)}",
+                            labels, value))
+    return out

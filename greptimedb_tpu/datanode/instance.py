@@ -107,17 +107,46 @@ class DatanodeInstance:
                 NumbersTable())
         self._started = True
 
-    def start_heartbeat(self, meta_client, interval_s: float = 5.0) -> None:
+    def start_heartbeat(self, meta_client, interval_s: float = 5.0,
+                        stats_every: int = 4) -> None:
         """Report liveness + region stats to the meta service (reference:
         src/datanode/src/heartbeat.rs:27-141; stats feed the load-based
-        selector and the phi failure detector)."""
+        selector and the phi failure detector). Liveness beats every
+        `interval_s`; the per-region stat walk (O(regions × files) over
+        memtable/SST metadata) and its linearly-growing payload ride only
+        every `stats_every`-th beat — meta's ingest-rate derivation
+        divides row deltas by the actual elapsed time between stat
+        beats, so the lower cadence doesn't distort the rate."""
+        from ..common.telemetry import span
         from ..meta import DatanodeStat
         from ..storage.scheduler import RepeatedTask
+        counter = [0]
 
         def beat():
+            # per-region rows/size travel with stat-bearing heartbeats:
+            # meta keeps them (DatanodeStat.region_stats) as the
+            # region-heat signal behind information_schema.cluster_info
+            # and the ingest-rate column; the heartbeat span carries a
+            # trace id over the meta RPC (wire propagation) so the hop
+            # is attributable
             regions = self.storage.list_regions()
-            stat = DatanodeStat(region_count=len(regions))
-            resp = meta_client.heartbeat(self.opts.node_id, stat)
+            if counter[0] % max(1, stats_every) == 0:
+                from ..query.stream_exec import region_stat_entries
+                region_stats, total_rows, total_bytes = \
+                    region_stat_entries(regions.values())
+                stat = DatanodeStat(region_count=len(regions),
+                                    approximate_rows=total_rows,
+                                    approximate_bytes=total_bytes,
+                                    region_stats=region_stats)
+            else:
+                # light beat: region_count is a len() — the load_based
+                # selector reads it fresh every beat; the O(regions ×
+                # files) per-region walk waits for the next full beat
+                stat = DatanodeStat(region_count=len(regions),
+                                    full=False)
+            counter[0] += 1
+            with span("heartbeat", node=self.opts.node_id):
+                resp = meta_client.heartbeat(self.opts.node_id, stat)
             for msg in resp.mailbox:
                 self._handle_mailbox(msg)
 

@@ -138,6 +138,9 @@ class DistTable(Table):
         self.route = route
         self.clients = clients
         self._warned_remote_regions = False
+        #: per-node wall latency of the most recent scatter on this
+        #: frontend ({label: ms}; bench.py's scatter profile reads it)
+        self.last_scatter_node_ms: Dict[str, float] = {}
 
     # ---- placement helpers ----
     def _owner(self, region_number: int) -> DatanodeClient:
@@ -248,24 +251,62 @@ class DistTable(Table):
         return out
 
     # ---- scatter-gather core ----
-    def _scatter(self, targets, call, what: str):
+    def _scatter(self, targets, call, what: str, node_ms=None):
         """Yield (result, elapsed_ms) per datanode target, in submit
         order as results complete (pipelined gather on the shared dist
         pool, in-flight window = SET dist_fanout). Each RPC retries
-        transient faults via _dist_rpc."""
+        transient faults via _dist_rpc.
+
+        Observability: each RPC runs under its OWN ExecStats
+        sub-collector — datanode-side stages (recorded in-process by
+        LocalDatanodeClient, or absorbed from the wire response by
+        FlightDatanodeClient) land there instead of flat on the
+        statement. The sub-collector attaches to the statement's
+        collector as a per-node block for the EXPLAIN ANALYZE tree on
+        the CONSUMER side of the gather, so a straggler RPC finishing
+        after the caller abandoned the gather (limit break) records
+        nothing — node blocks are exactly the results the statement
+        consumed, deterministically. The per-hop wall time feeds the
+        dist_rpc latency histogram, and `node_ms` (when a list is
+        passed) collects the per-node latency vector the
+        scatter_describe line used to discard."""
         from ..common import runtime
+        from ..common.telemetry import observe_latency
+        parent = exec_stats.current()
 
         def one(target):
             client, regs = target
-            t0 = time.perf_counter()
-            res = _dist_rpc(
-                f"{what}[dn{getattr(client, 'node_id', '?')}]",
-                lambda: call(client, regs))
-            return res, (time.perf_counter() - t0) * 1e3
+            label = f"dn{getattr(client, 'node_id', '?')}"
+            holder = {"stats": None, "t0": 0.0}
 
-        yield from runtime.parallel_imap(
-            one, targets, max_workers=runtime.dist_fanout(),
-            pool=runtime.dist_runtime())
+            def attempt():
+                # fresh sub-collector per attempt: a transient failure
+                # mid-scan must not leave its half-recorded stages to
+                # double-count under the retry (the per-node rows would
+                # stop summing to the standalone differential). The
+                # clock restarts per attempt too — a retried RPC's
+                # failed attempt + backoff sleep is NOT network time,
+                # and the node-vs-network split exists to be trusted
+                holder["t0"] = time.perf_counter()
+                ns = exec_stats.ExecStats() if parent is not None \
+                    else None
+                holder["stats"] = ns
+                with exec_stats.collect_into(ns):
+                    return call(client, regs)
+
+            res = _dist_rpc(f"{what}[{label}]", attempt)
+            wall_ms = (time.perf_counter() - holder["t0"]) * 1e3
+            observe_latency("dist_rpc_hop", wall_ms / 1e3, what=what)
+            return res, wall_ms, label, holder["stats"]
+
+        for res, wall_ms, label, stats in runtime.parallel_imap(
+                one, targets, max_workers=runtime.dist_fanout(),
+                pool=runtime.dist_runtime()):
+            if parent is not None and stats is not None:
+                parent.record_node(label, stats, wall_ms)
+            if node_ms is not None:
+                node_ms.append((label, wall_ms))
+            yield res, wall_ms
 
     def _record_scatter(self, survivors: int, total: int, fan_out: int
                         ) -> None:
@@ -347,7 +388,7 @@ class DistTable(Table):
         self._record_scatter(len(survivors), total, len(targets))
         out: list = []
         rows = 0
-        slowest = 0.0
+        node_ms: list = []
         for batches, dt_ms in self._scatter(
                 targets,
                 lambda c, regs: c.scan_batches(
@@ -355,20 +396,32 @@ class DistTable(Table):
                     self.info.name, projection=projection,
                     time_range=time_range, limit=wire_limit,
                     filters=ship or None, regions=regs),
-                what="scan"):
+                what="scan", node_ms=node_ms):
             out.extend(batches)
             rows += sum(b.num_rows for b in batches)
-            slowest = max(slowest, dt_ms)
             if wire_limit is not None and rows >= wire_limit:
                 # enough rows: abandoning the gather cancels queued RPCs
                 # (the shipped filters ARE the predicate when a limit
                 # travels, so any `limit` matching rows answer exactly)
                 break
-        # string value: a statement that scatters twice must not SUM its
-        # slowest-node latencies (numeric details accumulate in ExecStats)
-        exec_stats.record("dist_scatter", rows=rows,
-                          slowest_node_ms=f"{slowest:.2f}")
+        self._record_node_vector(rows, node_ms)
         return out
+
+    def _record_node_vector(self, rows: int, node_ms: list) -> None:
+        """The per-node latency vector (not just its max) — rendered in
+        the dist_scatter detail, kept on the table for bench.py's
+        scatter profile JSON line. String values: a statement that
+        scatters twice must not SUM its latencies (numeric details
+        accumulate in ExecStats)."""
+        slowest = max((ms for _, ms in node_ms), default=0.0)
+        vector = "/".join(
+            f"{label}:{ms:.1f}" for label, ms in sorted(
+                node_ms, key=lambda kv: exec_stats.node_sort_key(kv[0]))
+        ) or "-"
+        self.last_scatter_node_ms = {label: ms for label, ms in node_ms}
+        exec_stats.record("dist_scatter", rows=rows,
+                          slowest_node_ms=f"{slowest:.2f}",
+                          node_ms=vector)
 
     def _plan_scatter(self, plan):
         """(survivors, total, targets) for an aggregate plan, memoized
@@ -393,17 +446,15 @@ class DistTable(Table):
         survivors, total, targets = self._plan_scatter(plan)
         self._record_scatter(len(survivors), total, len(targets))
         frames: List[pd.DataFrame] = []
-        slowest = 0.0
+        node_ms: list = []
         for part, dt_ms in self._scatter(
                 targets,
                 lambda c, regs: c.region_moments(
                     self.info.catalog_name, self.info.schema_name,
                     self.info.name, plan, regions=regs),
-                what="region_moments"):
+                what="region_moments", node_ms=node_ms):
             frames.extend(part)        # fold-as-they-arrive gather
-            slowest = max(slowest, dt_ms)
-        exec_stats.record("dist_scatter",
-                          slowest_node_ms=f"{slowest:.2f}")
+        self._record_node_vector(0, node_ms)
         return frames
 
     def scatter_describe(self, plan) -> str:
@@ -466,6 +517,9 @@ class DistInstance:
         self.meta = meta
         self.clients = clients
         self.catalog = _RouteHydratingCatalog(self)
+        # information_schema.cluster_info resolves through the meta
+        # client hanging off the catalog (both frontends serve the view)
+        self.catalog.meta_client = meta
         self.query_engine = QueryEngine(self.catalog)
         # continuous rollup flows: specs live in the meta kv so every
         # frontend (and a restarted one) sees the same flows; folds run
@@ -765,7 +819,8 @@ class DistInstance:
         import time as _time
 
         from ..common.telemetry import (
-            increment_counter, slow_query_threshold_ms, span, timer)
+            increment_counter, observe_latency, slow_query_threshold_ms,
+            span, timer)
         from ..sql import parse_statements
         ctx = ctx or QueryContext()
         outs = []
@@ -773,9 +828,17 @@ class DistInstance:
             t0 = _time.perf_counter()
             prev_stats = getattr(self.query_engine, "last_exec_stats",
                                  None)
-            with span("execute_stmt", stmt=type(stmt).__name__,
-                      distributed=True) as sp, timer("stmt_execute"):
-                outs.append(self.execute_stmt(stmt, ctx))
+            try:
+                with span("execute_stmt", stmt=type(stmt).__name__,
+                          distributed=True) as sp, timer("stmt_execute"):
+                    outs.append(self.execute_stmt(stmt, ctx))
+            finally:
+                # finally: failing statements must count in the
+                # latency distribution too
+                observe_latency(
+                    "stmt_latency", _time.perf_counter() - t0,
+                    stmt=type(stmt).__name__,
+                    protocol=ctx.channel.value)
             increment_counter(f"stmt_{type(stmt).__name__.lower()}")
             elapsed_ms = (_time.perf_counter() - t0) * 1e3
             thr = slow_query_threshold_ms()

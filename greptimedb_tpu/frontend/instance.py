@@ -71,7 +71,8 @@ class FrontendInstance:
         import time as _time
 
         from ..common.telemetry import (
-            increment_counter, slow_query_threshold_ms, span, timer)
+            increment_counter, observe_latency, slow_query_threshold_ms,
+            span, timer)
         outputs = []
         for s in stmts:
             if interceptor is not None:
@@ -79,10 +80,21 @@ class FrontendInstance:
             t0 = _time.perf_counter()
             prev_stats = getattr(self.query_engine, "last_exec_stats",
                                  None)
-            with span("execute_stmt", stmt=type(s).__name__,
-                      channel=ctx.channel.value) as sp, \
-                    timer("stmt_execute"):
-                out = self.execute_stmt(s, ctx)
+            try:
+                with span("execute_stmt", stmt=type(s).__name__,
+                          channel=ctx.channel.value) as sp, \
+                        timer("stmt_execute"):
+                    out = self.execute_stmt(s, ctx)
+            finally:
+                # log-bucketed latency distribution per statement kind ×
+                # protocol: the p50/p95/p99 rows in runtime_metrics and
+                # the _bucket series on /metrics. Recorded in a finally —
+                # statements that stall then RAISE are the ones an
+                # operator most needs in the distribution
+                observe_latency(
+                    "stmt_latency",
+                    _time.perf_counter() - t0,
+                    stmt=type(s).__name__, protocol=ctx.channel.value)
             increment_counter(f"stmt_{type(s).__name__.lower()}")
             elapsed_ms = (_time.perf_counter() - t0) * 1e3
             thr = slow_query_threshold_ms()

@@ -45,6 +45,13 @@ class FlightMetaServer(flight.FlightServerBase):
     def do_action(self, context, action):
         body = json.loads(action.body.to_pybytes() or b"{}")
         kind = action.type
+        # popped (not just read): raft_* handlers splat **body, and the
+        # trace key must not reach them as an unexpected argument
+        from ..common.telemetry import remote_context
+        with remote_context(body.pop("traceparent", None)):
+            yield from self._do_action_inner(kind, body)
+
+    def _do_action_inner(self, kind, body):
         try:
             if kind == "register":
                 self.srv.register_datanode(Peer.from_dict(body["peer"]))
@@ -83,6 +90,19 @@ class FlightMetaServer(flight.FlightServerBase):
                 resp = {"ok": True,
                         "deleted": self.srv.delete_table_info(
                             body["name"])}
+            elif kind == "cluster_info":
+                # heartbeat state (_last_seen/_stats/detectors) is
+                # leader-local memory: a follower would report a healthy
+                # cluster as all-unknown. Redirect the caller — the
+                # failover client retries the next replica on this.
+                if self.raft_node is not None \
+                        and not self.raft_node.is_leader:
+                    from .replication import NotLeaderError
+                    raise NotLeaderError(self.raft_node.leader_id)
+                resp = {"ok": True, "nodes": self.srv.cluster_info(
+                    metasrv_addr=self.address,
+                    metasrv_state=self.raft_node.role
+                    if self.raft_node is not None else None)}
             elif kind == "list_datanodes":
                 peers = self.srv.alive_datanodes() \
                     if body.get("alive_only", True) else self.srv.peers()
@@ -148,10 +168,10 @@ class FlightMetaClient:
             self._conn = None
 
     def _action(self, kind: str, body: dict) -> dict:
-        from ..client.flight import _to_greptime_error
+        from ..client.flight import _to_greptime_error, _traced
         try:
             results = list(self.conn.do_action(
-                flight.Action(kind, json.dumps(body).encode())))
+                flight.Action(kind, json.dumps(_traced(body)).encode())))
             resp = json.loads(results[0].body.to_pybytes())
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
@@ -197,6 +217,9 @@ class FlightMetaClient:
 
     def allocate_table_id(self) -> int:
         return int(self._action("allocate_table_id", {})["id"])
+
+    def cluster_info(self) -> List[dict]:
+        return self._action("cluster_info", {})["nodes"]
 
     def put_table_info(self, full_name: str, info: dict) -> None:
         self._action("put_table_info", {"name": full_name, "info": info})
@@ -278,13 +301,36 @@ class FailoverFlightMetaClient:
     def __init__(self, addresses: List[str], *, retry_delay: float = 0.2,
                  max_rounds: int = 25):
         self.clients = [FlightMetaClient(a) for a in addresses]
-        self._cur = 0
+        # the leader pin lives in a shared cell so advisory() copies
+        # write the leader they discover back to the parent client
+        self._pin = [0]
         self._delay = retry_delay
         self._rounds = max_rounds
 
     @property
+    def _cur(self) -> int:
+        return self._pin[0]
+
+    @_cur.setter
+    def _cur(self, value: int) -> None:
+        self._pin[0] = value
+
+    @property
     def address(self) -> str:
         return self.clients[self._cur % len(self.clients)].address
+
+    def advisory(self) -> "FailoverFlightMetaClient":
+        """A view of this client that tries each replica once with no
+        inter-round sleep — for advisory reads (the cluster_info health
+        view) that must degrade immediately when meta is down instead of
+        stalling behind the write-path's full retry budget. Connections
+        AND the leader pin are shared (`_pin` is a mutable cell), so a
+        leader the quick pass discovers sticks for every later call."""
+        import copy
+        quick = copy.copy(self)
+        quick._rounds = 1
+        quick._delay = 0.0
+        return quick
 
     def close(self) -> None:
         for c in self.clients:

@@ -80,8 +80,20 @@ class TableRoute:
 
 @dataclass
 class DatanodeStat:
+    """Per-heartbeat datanode report (reference: the Stat/RegionStat pair
+    in meta-srv's heartbeat handler). `region_stats` carries one
+    {"region", "rows", "size_bytes"} dict per hosted region — the
+    region-heat input the elastic-region control loop (ROADMAP item 1)
+    will read, surfaced via information_schema.cluster_info."""
     region_count: int = 0
     approximate_rows: int = 0
+    approximate_bytes: int = 0
+    region_stats: List[dict] = field(default_factory=list)
+    #: False for a light liveness beat that refreshes region_count only
+    #: (the load_based selector needs it fresh every beat) while the
+    #: expensive per-region walk rides every stats_every-th beat; meta
+    #: must not derive an ingest rate from a light beat's zero rows
+    full: bool = True
 
 
 @dataclass
@@ -110,6 +122,10 @@ class MetaSrv:
         self.datanode_lease_secs = datanode_lease_secs
         self.selector = selector
         self._stats: Dict[int, DatanodeStat] = {}
+        #: (approximate_rows, t) of the previous stat-bearing heartbeat,
+        #: so consecutive reports yield a per-node ingest rate
+        self._prev_ingest: Dict[int, tuple] = {}
+        self._ingest_rate: Dict[int, float] = {}
         self._last_seen: Dict[int, float] = {}
         self._detectors: Dict[int, PhiAccrualFailureDetector] = {}
         self._phi_threshold = phi_threshold
@@ -170,8 +186,22 @@ class MetaSrv:
         det = self._detectors.setdefault(
             node_id, PhiAccrualFailureDetector(threshold=self._phi_threshold))
         det.heartbeat(now * 1000.0)
-        if stat is not None:
+        if stat is not None and stat.full:
+            prev = self._prev_ingest.get(node_id)
+            if prev is not None and now > prev[1]:
+                self._ingest_rate[node_id] = max(
+                    0.0, (stat.approximate_rows - prev[0]) /
+                    (now - prev[1]))
+            self._prev_ingest[node_id] = (stat.approximate_rows, now)
             self._stats[node_id] = stat
+        elif stat is not None:
+            # light beat: region_count only (selector freshness); keep
+            # the last full stat's rows/region heat intact
+            kept = self._stats.get(node_id)
+            if kept is not None:
+                kept.region_count = stat.region_count
+            else:
+                self._stats[node_id] = stat
         msgs = self._mailboxes.pop(node_id, [])
         return HeartbeatResponse(mailbox=msgs)
 
@@ -257,6 +287,69 @@ class MetaSrv:
     def delete_table_info(self, full_table_name: str) -> bool:
         return self.kv.delete(f"{TINFO_PREFIX}{full_table_name}")
 
+    # ---- cluster health view (backs information_schema.cluster_info;
+    # reference: the CLUSTER_INFO memory table fed from meta's
+    # heartbeat-collected NodeInfo) ----
+    def cluster_info(self, now: Optional[float] = None,
+                     metasrv_addr: str = "",
+                     metasrv_state: Optional[str] = None) -> List[dict]:
+        """One row per cluster member: the metasrv itself plus every
+        registered datanode with its lease state (alive / suspect /
+        expired / unknown), last-seen time, route-derived region count
+        and heartbeat-reported size/ingest-rate stats. Region counts
+        come from the routes — the authoritative placement — so the view
+        is live even before a node's next stat-bearing heartbeat.
+        `metasrv_state` is the serving metasrv's raft role when it is
+        replicated (a follower answering a stale read must not claim
+        leadership); a lone metasrv is trivially the leader."""
+        now = time.time() if now is None else now
+        # peer_id -1: datanode ids are >= 0 (DatanodeOptions defaults to
+        # 0), so the metasrv row must not collide with one — and sorts
+        # first under ORDER BY peer_id
+        rows = [{
+            "peer_id": -1, "peer_type": "metasrv",
+            "peer_addr": metasrv_addr,
+            "lease_state": metasrv_state or "leader",
+            "last_seen_ms": int(now * 1000), "region_count": 0,
+            "approximate_rows": 0, "ingest_rate_rps": 0.0,
+            "region_stats": "[]",
+        }]
+        placed: Dict[int, int] = {}
+        for route in self.all_table_routes():
+            for rr in route.region_routes:
+                placed[rr.leader.id] = placed.get(rr.leader.id, 0) + 1
+        for p in self.peers():
+            seen = self._last_seen.get(p.id)
+            if seen is None:
+                state = "unknown"
+            elif now - seen <= self.datanode_lease_secs:
+                state = "alive"
+                det = self._detectors.get(p.id)
+                if det is not None and det.sample_count > 0 and \
+                        not det.is_available(now * 1000.0):
+                    state = "suspect"
+            else:
+                state = "expired"
+            stat = self._stats.get(p.id, DatanodeStat())
+            rows.append({
+                "peer_id": p.id, "peer_type": "datanode",
+                "peer_addr": p.addr, "lease_state": state,
+                "last_seen_ms": int(seen * 1000)
+                if seen is not None else None,
+                "region_count": placed.get(p.id, 0),
+                "approximate_rows": int(stat.approximate_rows),
+                # rate is a derivative: a node that stopped heartbeating
+                # isn't ingesting, so don't let its last-known rate read
+                # as the hottest ingester forever (approximate_rows is
+                # cumulative and stays as the last-known fact)
+                "ingest_rate_rps": round(
+                    self._ingest_rate.get(p.id, 0.0), 3)
+                if state == "alive" else 0.0,
+                "region_stats": json.dumps(stat.region_stats,
+                                           separators=(",", ":")),
+            })
+        return rows
+
     # ---- region failover (the action the reference leaves TODO,
     # meta-srv/src/handler/failure_handler/runner.rs:132; design per
     # docs/rfcs/2023-03-08-region-fault-tolerance.md: region data lives
@@ -340,6 +433,9 @@ class MetaClient:
 
     def allocate_table_id(self) -> int:
         return self._srv.allocate_table_id()
+
+    def cluster_info(self) -> List[dict]:
+        return self._srv.cluster_info()
 
     def put_table_info(self, full_name: str, info: dict) -> None:
         self._srv.put_table_info(full_name, info)

@@ -107,6 +107,22 @@ def region_estimated_bytes(region) -> int:
     return region_estimated_rows(region) * width
 
 
+def region_stat_entries(regions) -> tuple:
+    """(per-region stat dicts, total_rows, total_bytes) for an iterable
+    of Region objects — the ONE builder behind both the datanode
+    heartbeat's DatanodeStat.region_stats and the standalone
+    cluster_info row, so the two views of region heat cannot diverge."""
+    entries, total_rows, total_bytes = [], 0, 0
+    for region in sorted(regions, key=lambda r: r.name):
+        rows = int(region_estimated_rows(region))
+        size = int(region_estimated_bytes(region))
+        total_rows += rows
+        total_bytes += size
+        entries.append({"region": region.name, "rows": rows,
+                        "size_bytes": size})
+    return entries, total_rows, total_bytes
+
+
 def _plan_slices(stats: List[Tuple[int, int, int]], budget: int,
                  clip_lo: Optional[int], clip_hi: Optional[int]
                  ) -> List[Tuple[int, int]]:

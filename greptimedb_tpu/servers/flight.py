@@ -22,12 +22,17 @@ Tickets, descriptors and action bodies are JSON; data rides Arrow IPC.
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
 from typing import Dict, Optional
 
 import pyarrow as pa
 import pyarrow.flight as flight
 
+from ..common import exec_stats
+from ..common.telemetry import (
+    remote_context, slow_query_threshold_ms, span)
 from ..datatypes.record_batch import RecordBatch
 from ..datatypes.schema import Schema
 from ..errors import GreptimeError
@@ -35,6 +40,14 @@ from ..table.requests import (
     CreateTableRequest, create_request_from_dict, create_request_to_dict)
 
 _EMPTY_SCHEMA = pa.schema([])
+
+#: wire key for the datanode-side ExecStats riding a response (stream
+#: schema metadata on do_get, the JSON ack on do_put)
+EXEC_STATS_KEY = exec_stats.EXEC_STATS_WIRE_KEY
+
+#: same logger as the frontends' slow-query log, so one `grep trace=`
+#: finds a slow distributed statement on every process it touched
+_slow_logger = logging.getLogger("greptimedb_tpu.slow_query")
 
 
 def _advertised_address(location: str, port: int) -> str:
@@ -56,13 +69,26 @@ def _arrow_to_columns(table: pa.Table) -> Dict[str, list]:
             for i, name in enumerate(table.schema.names)}
 
 
-def _frames_stream(frames) -> flight.GeneratorStream:
+def _with_metadata(schema: pa.Schema,
+                   metadata: Optional[Dict[bytes, bytes]]) -> pa.Schema:
+    if not metadata:
+        return schema
+    merged = dict(schema.metadata or {})
+    merged.update(metadata)
+    return schema.with_metadata(merged)
+
+
+def _frames_stream(frames, metadata: Optional[Dict[bytes, bytes]] = None
+                   ) -> flight.GeneratorStream:
     """One moment frame = one IPC batch, so per-region frame boundaries
     survive the wire and the frontend fold sees the same units as the
-    in-process path."""
+    in-process path. `metadata` rides the stream schema (the datanode's
+    ExecStats travel there)."""
     if not frames:
-        return flight.GeneratorStream(_EMPTY_SCHEMA, iter(()))
-    schema0 = pa.Schema.from_pandas(frames[0], preserve_index=False)
+        return flight.GeneratorStream(
+            _with_metadata(_EMPTY_SCHEMA, metadata), iter(()))
+    schema0 = _with_metadata(
+        pa.Schema.from_pandas(frames[0], preserve_index=False), metadata)
 
     def gen():
         for f in frames:
@@ -73,13 +99,15 @@ def _frames_stream(frames) -> flight.GeneratorStream:
     return flight.GeneratorStream(schema0, gen())
 
 
-def _batches_stream(batches, fallback_schema: Optional[Schema] = None
+def _batches_stream(batches, fallback_schema: Optional[Schema] = None,
+                    metadata: Optional[Dict[bytes, bytes]] = None
                     ) -> flight.GeneratorStream:
     if not batches:
         schema = fallback_schema.to_arrow() if fallback_schema is not None \
             else _EMPTY_SCHEMA
-        return flight.GeneratorStream(schema, iter(()))
-    schema = batches[0].schema.to_arrow()
+        return flight.GeneratorStream(_with_metadata(schema, metadata),
+                                      iter(()))
+    schema = _with_metadata(batches[0].schema.to_arrow(), metadata)
     return flight.GeneratorStream(
         schema, (b.to_arrow() for b in batches))
 
@@ -131,6 +159,13 @@ class FlightDatanodeServer(flight.FlightServerBase):
     def do_action(self, context, action):
         body = json.loads(action.body.to_pybytes() or b"{}")
         kind = action.type
+        # join the caller's trace before any handler work so DDL/flush
+        # spans and logs carry the frontend's trace id
+        with remote_context(body.pop("traceparent", None)), \
+                span(f"dn_{kind}", node=self.datanode.opts.node_id):
+            yield from self._do_action_inner(kind, body)
+
+    def _do_action_inner(self, kind, body):
         try:
             if kind == "ddl_create_table":
                 self.local.ddl_create_table(
@@ -171,60 +206,105 @@ class FlightDatanodeServer(flight.FlightServerBase):
         cmd = json.loads(descriptor.command)
         if cmd.get("type") != "write_region":
             raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
-        tbl = reader.read_all()
-        op = cmd.get("op", "put")
-        target = self.datanode.catalog.table(
-            cmd["catalog"], cmd["schema"], cmd["table"]) \
-            if op == "bulk" else None
-        if target is not None:
-            # bulk path: typed ndarray columns feed bulk_ingest's raw
-            # fast path instead of a per-value pylist round trip
-            from ..datatypes.record_batch import arrow_to_ingest_columns
-            columns = arrow_to_ingest_columns(tbl, target.schema)
-        else:
-            columns = _arrow_to_columns(tbl)
-        n = self.local.write_region(
-            cmd["catalog"], cmd["schema"], cmd["table"],
-            cmd["region_number"], columns, op=op)
-        writer.write(pa.py_buffer(
-            json.dumps({"affected_rows": n}).encode()))
+        stats = exec_stats.ExecStats()
+        t0 = time.perf_counter()
+        with remote_context(cmd.get("traceparent")), \
+                span("dn_write_region", node=self.datanode.opts.node_id,
+                     table=cmd.get("table")) as sp, \
+                exec_stats.collect(stats):
+            tbl = reader.read_all()
+            op = cmd.get("op", "put")
+            target = self.datanode.catalog.table(
+                cmd["catalog"], cmd["schema"], cmd["table"]) \
+                if op == "bulk" else None
+            if target is not None:
+                # bulk path: typed ndarray columns feed bulk_ingest's raw
+                # fast path instead of a per-value pylist round trip
+                from ..datatypes.record_batch import arrow_to_ingest_columns
+                columns = arrow_to_ingest_columns(tbl, target.schema)
+            else:
+                columns = _arrow_to_columns(tbl)
+            n = self.local.write_region(
+                cmd["catalog"], cmd["schema"], cmd["table"],
+                cmd["region_number"], columns, op=op)
+        self._log_slow(sp, "write_region", cmd,
+                       (time.perf_counter() - t0) * 1e3, stats)
+        writer.write(pa.py_buffer(json.dumps(
+            {"affected_rows": n,
+             "exec_stats": stats.to_dict()}).encode()))
+
+    def _log_slow(self, sp, what: str, cmd: dict, elapsed_ms: float,
+                  stats: exec_stats.ExecStats) -> None:
+        """Datanode-side slow-op log: after wire trace propagation this
+        reports the SAME trace id as the frontend's slow-query entry for
+        the statement that caused the RPC."""
+        thr = slow_query_threshold_ms()
+        if thr is None or elapsed_ms < thr:
+            return
+        _slow_logger.warning(
+            "slow datanode op: %s %.1fms (threshold %dms) trace=%s "
+            "node=%d table=%s stats=[%s]", what, elapsed_ms, thr,
+            sp["trace_id"], self.datanode.opts.node_id,
+            cmd.get("table"), stats.summary())
 
     # ---- read plane ----
     def do_get(self, context, ticket):
         cmd = json.loads(ticket.ticket)
         kind = cmd.get("type")
+        if kind not in ("scan", "region_moments"):
+            raise GreptimeError(f"unsupported ticket {kind!r}")
+        # the scan executes eagerly under a local collector; its stats
+        # ride the stream schema back so the frontend can render this
+        # node's stage rows in its EXPLAIN ANALYZE tree
+        stats = exec_stats.ExecStats()
+        t0 = time.perf_counter()
+        with remote_context(cmd.get("traceparent")), \
+                span(f"dn_{kind}", node=self.datanode.opts.node_id,
+                     table=cmd.get("table")) as sp, \
+                exec_stats.collect(stats):
+            if kind == "scan":
+                batches, fallback = self._do_scan(cmd)
+            else:
+                frames = self._do_region_moments(cmd)
+        self._log_slow(sp, kind, cmd, (time.perf_counter() - t0) * 1e3,
+                       stats)
+        metadata = {EXEC_STATS_KEY: json.dumps(stats.to_dict()).encode()}
         if kind == "scan":
-            from ..common.time import TimestampRange
-            from ..query.plan_codec import expr_from_dict
-            filters = [expr_from_dict(f) for f in cmd["filters"]] \
-                if cmd.get("filters") else None
-            # rebuild a real TimestampRange: Region.scan dereferences
-            # .start/.end, so the wire's [lo, hi] pair must not stay a
-            # tuple (ranges ship in ms, the region-native unit)
-            time_range = None
-            if cmd.get("time_range"):
-                lo, hi = cmd["time_range"]
-                time_range = TimestampRange(lo, hi)
-            batches = self.local.scan_batches(
-                cmd["catalog"], cmd["schema"], cmd["table"],
-                projection=cmd.get("projection"),
-                time_range=time_range,
-                limit=cmd.get("limit"), filters=filters,
-                regions=cmd.get("regions"))
-            t = self.datanode.catalog.table(
-                cmd["catalog"], cmd["schema"], cmd["table"])
-            fallback = None
-            if t is not None:
-                fallback = t.schema if cmd.get("projection") is None \
-                    else t.schema.project(cmd["projection"])
-            return _batches_stream(batches, fallback)
-        if kind == "region_moments":
-            from ..query.plan_codec import plan_from_dict
-            frames = self.local.region_moments(
-                cmd["catalog"], cmd["schema"], cmd["table"],
-                plan_from_dict(cmd["plan"]), regions=cmd.get("regions"))
-            return _frames_stream(frames)
-        raise GreptimeError(f"unsupported ticket {kind!r}")
+            return _batches_stream(batches, fallback, metadata=metadata)
+        return _frames_stream(frames, metadata=metadata)
+
+    def _do_scan(self, cmd):
+        from ..common.time import TimestampRange
+        from ..query.plan_codec import expr_from_dict
+        filters = [expr_from_dict(f) for f in cmd["filters"]] \
+            if cmd.get("filters") else None
+        # rebuild a real TimestampRange: Region.scan dereferences
+        # .start/.end, so the wire's [lo, hi] pair must not stay a
+        # tuple (ranges ship in ms, the region-native unit)
+        time_range = None
+        if cmd.get("time_range"):
+            lo, hi = cmd["time_range"]
+            time_range = TimestampRange(lo, hi)
+        # self.local (a LocalDatanodeClient) records the "scan" stage
+        batches = self.local.scan_batches(
+            cmd["catalog"], cmd["schema"], cmd["table"],
+            projection=cmd.get("projection"),
+            time_range=time_range,
+            limit=cmd.get("limit"), filters=filters,
+            regions=cmd.get("regions"))
+        t = self.datanode.catalog.table(
+            cmd["catalog"], cmd["schema"], cmd["table"])
+        fallback = None
+        if t is not None:
+            fallback = t.schema if cmd.get("projection") is None \
+                else t.schema.project(cmd["projection"])
+        return batches, fallback
+
+    def _do_region_moments(self, cmd):
+        from ..query.plan_codec import plan_from_dict
+        return self.local.region_moments(
+            cmd["catalog"], cmd["schema"], cmd["table"],
+            plan_from_dict(cmd["plan"]), regions=cmd.get("regions"))
 
 
 # ---------------------------------------------------------------------------
@@ -260,7 +340,8 @@ class FlightFrontendServer(flight.FlightServerBase):
             return self._do_get_proto(raw)
         if cmd.get("type") != "sql":
             raise GreptimeError(f"unsupported ticket {cmd.get('type')!r}")
-        outputs = self.frontend.do_query(cmd["sql"])
+        with remote_context(cmd.get("traceparent")):
+            outputs = self.frontend.do_query(cmd["sql"])
         last = outputs[-1]
         if last.is_batches:
             return _batches_stream(last.batches)

@@ -130,8 +130,7 @@ class HttpServer:
     async def _error_middleware(self, request, handler):
         start = time.perf_counter()
         try:
-            resp = await handler(request)
-            return resp
+            return await self._observed(request, handler, start)
         except AuthError as e:
             return web.json_response(
                 {"code": int(StatusCode.USER_PASSWORD_MISMATCH),
@@ -149,12 +148,41 @@ class HttpServer:
                 {"code": int(StatusCode.INTERNAL), "error": str(e)},
                 status=500)
 
+    @staticmethod
+    async def _observed(request, handler, start: float):
+        """Per-route latency histogram (canonical route template, not
+        the raw path, so /api/v1/label/{name}/values stays ONE series).
+        Recorded in a finally so error responses — the requests an
+        operator most needs in the distribution — count too."""
+        try:
+            return await handler(request)
+        finally:
+            resource = getattr(request.match_info.route, "resource", None)
+            if resource is not None:
+                from ..common.telemetry import observe_latency
+                observe_latency("http_request",
+                                time.perf_counter() - start,
+                                route=resource.canonical)
+
     def _ctx(self, request) -> QueryContext:
         self.user_provider.auth_http_basic(
             request.headers.get("Authorization"))
         db = request.query.get("db") or request.headers.get("x-greptime-db")
         catalog, schema = parse_db_param(db)
         return QueryContext(catalog, schema, Channel.HTTP)
+
+    def _traced_call(self, request, fn):
+        """Run `fn` (on the executor thread) under the request's W3C
+        `traceparent` header, so external clients can stitch the whole
+        statement — frontend span, datanode RPCs, slow-query log lines —
+        onto their own trace."""
+        tp = request.headers.get("traceparent")
+
+        def run():
+            from ..common.telemetry import remote_context
+            with remote_context(tp):
+                return fn()
+        return run
 
     async def _param(self, request, name: str) -> Optional[str]:
         if name in request.query:
@@ -184,7 +212,9 @@ class HttpServer:
                  "error": "missing 'sql' parameter"}, status=400)
         loop = asyncio.get_running_loop()
         outputs = await loop.run_in_executor(
-            None, lambda: self.frontend.do_query(sql, ctx))
+            None,
+            self._traced_call(request,
+                              lambda: self.frontend.do_query(sql, ctx)))
         return web.json_response({
             "code": 0,
             "output": [output_to_json(o) for o in outputs],
@@ -205,8 +235,9 @@ class HttpServer:
         from ..sql.ast import Tql
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(
-            None, lambda: self.frontend.execute_tql(
-                Tql("eval", start, end, step, None, query), ctx))
+            None, self._traced_call(
+                request, lambda: self.frontend.execute_tql(
+                    Tql("eval", start, end, step, None, query), ctx)))
         return web.json_response({
             "code": 0,
             "output": [output_to_json(out)],
@@ -282,7 +313,7 @@ class HttpServer:
                     timestamp_column=influx_mod.GREPTIME_TIMESTAMP, ctx=ctx)
             return n
 
-        await loop.run_in_executor(None, work)
+        await loop.run_in_executor(None, self._traced_call(request, work))
         return web.Response(status=204)
 
     def _ctx_influx(self, request) -> QueryContext:
@@ -335,7 +366,7 @@ class HttpServer:
                     table, cols, tag_columns=tag_cols[table],
                     timestamp_column=prom_mod.GREPTIME_TIMESTAMP, ctx=ctx)
 
-        await loop.run_in_executor(None, work)
+        await loop.run_in_executor(None, self._traced_call(request, work))
         return web.Response(status=204)
 
     async def handle_prom_read(self, request):

@@ -1,0 +1,37 @@
+-- Per-node EXPLAIN ANALYZE tree (ISSUE 6): datanode-side ExecStats
+-- cross the RPC boundary and merge under the dist_scatter line — one
+-- block per node naming its actual dispatch, rows/files per stage, and
+-- the node-elapsed vs network split. elapsed_ms / node_ms / network_ms
+-- are wall clock and normalized by the runner.
+
+CREATE TABLE dist_analyze (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    cpu DOUBLE,
+    mem DOUBLE,
+    PRIMARY KEY(host)
+)
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h2'),
+  PARTITION r1 VALUES LESS THAN ('h4'),
+  PARTITION r2 VALUES LESS THAN ('h6'),
+  PARTITION r3 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO dist_analyze VALUES
+    ('h0', 1000, 10.0, 1.0),
+    ('h1', 2000, 20.0, 2.0),
+    ('h2', 1000, 30.0, 3.0),
+    ('h3', 3000, 40.0, 4.0),
+    ('h5', 4000, 50.0, 5.0),
+    ('h7', 5000, 60.0, 6.0);
+
+-- cold full fan-out: all 4 regions survive, both datanodes of the
+-- 2-node sqlness cluster answer — each gets its own stage block with
+-- per-node row counts that sum to the 6 rows inserted
+EXPLAIN ANALYZE SELECT host, avg(cpu), max(mem) FROM dist_analyze GROUP BY host;
+
+-- range rule prunes to one region -> a single node block remains, and
+-- its scan rows are exactly that region's share
+EXPLAIN ANALYZE SELECT host, count(*) AS c FROM dist_analyze WHERE host >= 'h6' GROUP BY host;
+
+DROP TABLE dist_analyze;

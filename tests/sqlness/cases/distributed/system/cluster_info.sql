@@ -1,0 +1,23 @@
+-- information_schema.cluster_info (ISSUE 6): the meta service's
+-- heartbeat-collected health view as a queryable table — node id, role,
+-- address, lease state, last-seen, route-derived region counts, and the
+-- heartbeat-reported ingest stats. peer_addr / last_seen_ms are
+-- normalized by the runner.
+
+SELECT peer_id, peer_type, peer_addr, lease_state, last_seen_ms, region_count
+FROM information_schema.cluster_info ORDER BY peer_id;
+
+-- region placement shows up in the view as soon as the route exists
+-- (counts come from meta's routes, not from the next heartbeat)
+CREATE TABLE ci_demo (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    cpu DOUBLE,
+    PRIMARY KEY(host)
+)
+PARTITION BY HASH (host) PARTITIONS 8;
+
+SELECT peer_id, peer_type, lease_state, region_count, approximate_rows
+FROM information_schema.cluster_info ORDER BY peer_id;
+
+DROP TABLE ci_demo;

@@ -1,0 +1,15 @@
+-- information_schema.cluster_info on a standalone frontend: no meta
+-- service, so the view synthesizes one row for the local process with
+-- live region facts (last_seen_ms is normalized by the runner).
+
+CREATE TABLE ci_local (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE,
+                       PRIMARY KEY(host));
+
+INSERT INTO ci_local VALUES ('a', 1000, 1.0), ('b', 2000, 2.0),
+                            ('c', 3000, 3.0);
+
+SELECT peer_id, peer_type, lease_state, region_count, approximate_rows,
+       region_stats
+FROM information_schema.cluster_info;
+
+DROP TABLE ci_local;

@@ -68,14 +68,27 @@ def _strip_comment_lines(stmt: str) -> str:
 
 #: column name -> placeholder: wall-clock / wall-advancing columns whose
 #: values cannot byte-compare across runs (elapsed_ms in EXPLAIN ANALYZE;
-#: flow watermark timestamps in SHOW FLOWS / information_schema.flows)
-_VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>"}
+#: flow watermark timestamps in SHOW FLOWS / information_schema.flows;
+#: last-seen heartbeat times and dialed addresses in cluster_info)
+_VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>",
+                     "last_seen_ms": "<last_seen>", "peer_addr": "<addr>"}
 
-#: wall-clock fragments inside EXPLAIN ANALYZE detail strings (the
-#: distributed scatter reports its slowest datanode's latency there)
+#: wall-clock fragments inside EXPLAIN ANALYZE detail strings: the
+#: scatter's slowest-node latency, the per-node latency vector, and the
+#: node rows' node-vs-network split
 import re as _re  # noqa: E402
 
-_VOLATILE_DETAIL = _re.compile(r"slowest_node_ms=[0-9.]+")
+_VOLATILE_DETAIL = [
+    (_re.compile(r"slowest_node_ms=[0-9.]+"), "slowest_node_ms=<ms>"),
+    (_re.compile(r"node_ms=[0-9A-Za-z:./#-]+"), "node_ms=<ms>"),
+    (_re.compile(r"network_ms=[0-9.]+"), "network_ms=<ms>"),
+]
+
+
+def _scrub_detail(v: str) -> str:
+    for pattern, repl in _VOLATILE_DETAIL:
+        v = pattern.sub(repl, v)
+    return v
 
 
 def _normalize_timings(out):
@@ -106,8 +119,7 @@ def _normalize_timings(out):
             else:
                 if cs.name == "detail":
                     data[cs.name] = [
-                        _VOLATILE_DETAIL.sub("slowest_node_ms=<ms>", v)
-                        if isinstance(v, str) else v
+                        _scrub_detail(v) if isinstance(v, str) else v
                         for v in data[cs.name]]
                 cols.append(cs)
         schema = Schema(cols)

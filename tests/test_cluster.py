@@ -118,6 +118,187 @@ class TestWireMetaCluster:
         assert next(out.batches[0].rows())[0] == 1.0
 
 
+HASH_DDL = """
+CREATE TABLE obs (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE,
+                  PRIMARY KEY(host))
+PARTITION BY HASH (host) PARTITIONS 8
+"""
+
+
+class TestClusterObservability:
+    """ISSUE 6: one trace id per statement across processes, per-node
+    EXPLAIN ANALYZE over the wire, and the cluster_info health view."""
+
+    @pytest.fixture()
+    def wire_cluster(self, tmp_path):
+        meta_srv = MetaSrv(MemKv())
+        meta_server = FlightMetaServer(meta_srv)
+        meta_server.serve_in_background()
+        _wait_port(meta_server)
+        meta = FlightMetaClient(meta_server.address)
+        datanodes, servers = {}, {}
+        for i in (1, 2):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=str(tmp_path / f"dn{i}"), node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            srv = FlightDatanodeServer(dn)
+            srv.serve_in_background()
+            _wait_port(srv)
+            meta.register(Peer(i, srv.address))
+            dn.start_heartbeat(meta, interval_s=3600)
+            datanodes[i] = dn
+            servers[i] = srv
+        fe = DistInstance(meta, PeerClientRegistry(meta))
+        fe.do_query(HASH_DDL)
+        rows = ", ".join(f"('h{i % 4}', {1000 + i}, {float(i)})"
+                         for i in range(24))
+        fe.do_query(f"INSERT INTO obs VALUES {rows}")
+        yield fe, meta_srv, datanodes
+        for s in servers.values():
+            s.shutdown()
+        for dn in datanodes.values():
+            dn.shutdown()
+        meta.close()
+        meta_server.shutdown()
+
+    def test_one_trace_id_across_frontend_and_datanodes(
+            self, wire_cluster, caplog):
+        """Satellite 1: after wire propagation, a slow distributed
+        statement logs the SAME trace id on the frontend and on every
+        datanode it touched (datanodes used to mint their own)."""
+        import logging
+
+        from greptimedb_tpu.common.telemetry import (
+            set_slow_query_threshold_ms)
+        fe, _, _ = wire_cluster
+        set_slow_query_threshold_ms(1)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.slow_query"):
+                fe.do_query(
+                    "SELECT host, count(*) AS c FROM obs GROUP BY host")
+        finally:
+            set_slow_query_threshold_ms(None)
+        import re
+
+        def traces(needle):
+            return {re.search(r"trace=(\S+)", r.getMessage()).group(1)
+                    for r in caplog.records
+                    if needle in r.getMessage()}
+        fe_traces = traces("slow query:")
+        dn_traces = traces("slow datanode op:")
+        assert len(fe_traces) == 1, caplog.text
+        assert dn_traces, "datanode side must log the slow op too"
+        assert dn_traces == fe_traces, \
+            f"trace ids diverged: fe={fe_traces} dn={dn_traces}"
+        # a bare 32-hex trace id, not a whole traceparent header
+        assert "-" not in next(iter(fe_traces))
+
+    def _analyze_rows(self, fe, sql):
+        out = fe.do_query("EXPLAIN ANALYZE " + sql)[-1]
+        return [r for b in out.batches for r in b.to_pylist()]
+
+    def test_per_node_tree_sums_to_standalone(self, wire_cluster,
+                                              tmp_path):
+        """Satellite 3 (wire-level differential): the per-node stage
+        rows of a distributed EXPLAIN ANALYZE sum — rows scanned across
+        nodes — to the standalone run of the same query on the same
+        data."""
+        from greptimedb_tpu.frontend.instance import FrontendInstance
+        fe, _, _ = wire_cluster
+        sql = "SELECT host, count(*) AS c FROM obs GROUP BY host"
+        rows = self._analyze_rows(fe, sql)
+        node_rows = [r for r in rows
+                     if r["stage"].startswith("  dn")
+                     and not r["stage"].startswith("    ")]
+        assert len(node_rows) == 2, [r["stage"] for r in rows]
+        for r in node_rows:
+            assert "dispatch=" in r["detail"]
+            assert "network_ms=" in r["detail"]
+        scan_rows = [r for r in rows if r["stage"] == "    scan_prep"]
+        assert scan_rows, "per-node scan stages must cross the wire"
+        dist_scanned = sum(r["rows"] for r in scan_rows)
+
+        # standalone twin on identical data
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "solo"),
+            register_numbers_table=False))
+        dn.start()
+        solo = FrontendInstance(dn)
+        solo.start()
+        try:
+            solo.do_query(
+                "CREATE TABLE obs (host STRING, ts TIMESTAMP TIME INDEX,"
+                " cpu DOUBLE, PRIMARY KEY(host))")
+            vals = ", ".join(f"('h{i % 4}', {1000 + i}, {float(i)})"
+                             for i in range(24))
+            solo.do_query(f"INSERT INTO obs VALUES {vals}")
+            solo_rows = self._analyze_rows(solo, sql)
+        finally:
+            solo.shutdown()
+        solo_scanned = next(
+            r["rows"] for r in solo_rows
+            if r["stage"] in ("scan_prep", "scan", "decode_reduce"))
+        assert dist_scanned == solo_scanned == 24
+
+    def test_cluster_info_lease_flip_on_dead_datanode(self, wire_cluster):
+        """Acceptance: all nodes alive with region counts; a datanode
+        that stops heartbeating flips to expired within the lease
+        window (probed with an explicit `now` — no wall-clock sleeps)."""
+        fe, meta_srv, _ = wire_cluster
+        out = fe.do_query(
+            "SELECT peer_type, lease_state, region_count FROM "
+            "information_schema.cluster_info ORDER BY peer_id")[-1]
+        got = [tuple(r) for b in out.batches for r in b.rows()]
+        assert got[0][:2] == ("metasrv", "leader")
+        assert [g[:2] for g in got[1:]] == [("datanode", "alive")] * 2
+        assert sum(g[2] for g in got[1:]) == 8     # all routed regions
+        # dn2 ingests hot right up to its death...
+        import time as _time
+        from greptimedb_tpu.meta import DatanodeStat
+        t0 = _time.time()
+        meta_srv.handle_heartbeat(
+            2, DatanodeStat(approximate_rows=1000), now=t0)
+        meta_srv.handle_heartbeat(
+            2, DatanodeStat(approximate_rows=3000), now=t0 + 2)
+        hot = {n["peer_id"]: n for n in meta_srv.cluster_info(now=t0 + 2)}
+        assert hot[2]["ingest_rate_rps"] > 0
+        # ...then goes silent: one lease window later the view says
+        # expired
+        later = t0 + 2 + meta_srv.datanode_lease_secs + 1
+        meta_srv.handle_heartbeat(1, now=later)    # dn1 keeps beating
+        info = {n["peer_id"]: n for n in meta_srv.cluster_info(now=later)}
+        assert info[1]["lease_state"] == "alive"
+        assert info[2]["lease_state"] == "expired"
+        assert info[2]["region_count"] == 4        # placement unchanged
+        # a dead node is not ingesting: its last-known rate must not
+        # read as cluster heat forever (rows stay — they are cumulative)
+        assert info[2]["ingest_rate_rps"] == 0.0
+        assert info[2]["approximate_rows"] == 3000
+
+    def test_heartbeat_stats_feed_cluster_info(self, wire_cluster):
+        """A stat-bearing heartbeat surfaces rows + per-region stats in
+        the view, and consecutive reports yield an ingest rate."""
+        fe, meta_srv, datanodes = wire_cluster
+        import json as _json
+        import time as _time
+        from greptimedb_tpu.meta import DatanodeStat
+        t0 = _time.time()
+        meta_srv.handle_heartbeat(1, DatanodeStat(
+            region_count=4, approximate_rows=1000,
+            region_stats=[{"region": "r", "rows": 1000}]), now=t0)
+        meta_srv.handle_heartbeat(1, DatanodeStat(
+            region_count=4, approximate_rows=3000,
+            region_stats=[{"region": "r", "rows": 3000}]), now=t0 + 2)
+        info = {n["peer_id"]: n
+                for n in meta_srv.cluster_info(now=t0 + 2)}
+        assert info[1]["approximate_rows"] == 3000
+        assert info[1]["ingest_rate_rps"] == pytest.approx(1000.0)
+        assert _json.loads(info[1]["region_stats"]) == [
+            {"region": "r", "rows": 3000}]
+
+
 @pytest.mark.slow
 class TestMultiProcessCluster:
     def _spawn(self, *argv, env):

@@ -451,6 +451,70 @@ class TestFlightTransport:
             for s in servers:
                 s.shutdown()
 
+    def test_cluster_info_redirects_to_leader(self):
+        """Heartbeat state is leader-local memory: a follower answering
+        cluster_info would report a healthy cluster as all-unknown, so
+        it must raise NotLeaderError instead — and the failover client
+        must ride that redirect to the leader's live view."""
+        from greptimedb_tpu.meta.flight import (
+            FailoverFlightMetaClient, FlightMetaClient, FlightMetaServer)
+        ids = [1, 2, 3]
+        nodes = [RaftNode(i, ids, **FAST) for i in ids]
+        servers = [FlightMetaServer(MetaSrv(ReplicatedKv(nd)),
+                                    raft_node=nd) for nd in nodes]
+        try:
+            for s in servers:
+                s.serve_in_background()
+            for a, sa in zip(nodes, servers):
+                for b, sb in zip(nodes, servers):
+                    if a is not b:
+                        a.transports[b.node_id] = FlightTransport(sb.address)
+            for nd in nodes:
+                nd.start()
+            leader = wait_for(
+                lambda: next((nd for nd in nodes if nd.is_leader), None),
+                what="wire leader election")
+            leader_srv = servers[ids.index(leader.node_id)]
+            leader_srv.srv.handle_heartbeat(7)     # registers datanode 7
+            # a follower must redirect rather than answer from its empty
+            # heartbeat memory. Leadership can churn under load with the
+            # FAST election timeouts, so retry until we catch a node
+            # answering while it is actually a follower.
+            deadline = time.monotonic() + 8.0
+            while True:
+                follower_i = next((i for i, nd in enumerate(nodes)
+                                   if not nd.is_leader), None)
+                if follower_i is not None:
+                    direct = FlightMetaClient(servers[follower_i].address)
+                    try:
+                        direct.cluster_info()
+                    except NotLeaderError:
+                        break                      # the expected redirect
+                    finally:
+                        direct.close()
+                    if not nodes[follower_i].is_leader:
+                        raise AssertionError(
+                            "follower served cluster_info without "
+                            "redirecting")
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        "never caught a stable follower to probe")
+                time.sleep(0.05)
+            ha = FailoverFlightMetaClient([s.address for s in servers])
+            try:
+                ha.cluster_info()                  # rides redirect → leader
+                ha.heartbeat(7)                    # lands on that leader
+                info = {n["peer_id"]: n for n in ha.cluster_info()}
+                assert info[7]["lease_state"] == "alive"
+                assert info[-1]["lease_state"] == "leader"
+            finally:
+                ha.close()
+        finally:
+            for nd in nodes:
+                nd.stop()
+            for s in servers:
+                s.shutdown()
+
 
 class TestConcurrentProposals:
     def test_parallel_writers_all_committed(self):

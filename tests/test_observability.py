@@ -272,3 +272,119 @@ class TestExecStats:
         assert st is not None
         assert st.dispatch is not None
         assert "scan" in st.stages
+
+
+class TestExecStatsWire:
+    """ISSUE 6: the collector's wire codec (to_dict/absorb) and the
+    per-node tree rendering behind distributed EXPLAIN ANALYZE."""
+
+    def test_to_dict_absorb_roundtrip(self):
+        src = exec_stats.ExecStats()
+        src.record("scan_prep", rows=np.int64(7), files=1,
+                   elapsed_s=0.004, cache="hit", pruned=np.int32(3))
+        src.set_dispatch("streamed-cold (est_rows=9)")
+        src.total_s = 0.01
+        import json
+        d = json.loads(json.dumps(src.to_dict()))   # must be JSON-safe
+        dst = exec_stats.ExecStats()
+        dst.absorb(d)
+        st = dst.stages["scan_prep"]
+        assert st.rows == 7 and st.files == 1
+        assert st.detail["cache"] == "hit" and st.detail["pruned"] == 3
+        assert dst.dispatch == "streamed-cold (est_rows=9)"
+        assert dst.remote_total_ms == pytest.approx(10.0)
+        assert dst.node_elapsed_ms() == pytest.approx(10.0)
+
+    def test_absorb_into_active_collector(self):
+        with exec_stats.collect() as st:
+            exec_stats.absorb_remote(
+                {"dispatch": "d", "total_ms": 2.0,
+                 "stages": [{"stage": "scan", "rows": 4}]})
+        assert st.stages["scan"].rows == 4
+        assert st.dispatch == "d"
+
+    def test_record_node_renders_tree(self):
+        parent = exec_stats.ExecStats()
+        parent.record("dist_scatter", scatter="regions pruned 0/2")
+        n2 = exec_stats.ExecStats()
+        n2.record("scan_prep", rows=5, elapsed_s=0.002, cache="full")
+        n2.record("reduce", rows=5, elapsed_s=0.003)
+        n2.set_dispatch("device-resident (scan cache)")
+        n1 = exec_stats.ExecStats()
+        n1.record("scan_prep", rows=3, elapsed_s=0.001)
+        # completion order dn2-then-dn1; rendering must sort by label
+        parent.record_node("dn2", n2, wall_ms=9.0)
+        parent.record_node("dn1", n1, wall_ms=4.0)
+        tab = parent.rows_table()
+        stages = tab["stage"]
+        i = stages.index("dist_scatter")
+        assert stages[i + 1] == "  dn1"
+        assert stages[i + 2] == "    scan_prep"
+        assert stages[i + 3] == "  dn2"
+        assert stages[i + 4] == "    scan_prep"
+        assert stages[i + 5] == "    reduce"
+        hdr = tab["detail"][i + 3]
+        assert "dispatch=device-resident (scan cache)" in hdr
+        # in-process sub-collector (no remote total): the round trip IS
+        # node work, so node_ms = wall and network_ms = 0
+        assert "node_ms=9.00" in hdr and "network_ms=0.00" in hdr
+        assert tab["rows"][i + 3] == 5          # node header carries rows
+        assert tab["elapsed_ms"][i + 3] == pytest.approx(9.0)
+        assert "nodes=dn1:4.0ms,dn2:9.0ms" in parent.summary()
+
+    def test_record_node_label_collision(self):
+        parent = exec_stats.ExecStats()
+        parent.record_node("dn1", exec_stats.ExecStats(), 1.0)
+        parent.record_node("dn1", exec_stats.ExecStats(), 2.0)
+        assert list(parent.nodes) == ["dn1", "dn1#2"]
+
+    def test_nodes_render_without_scatter_stage(self):
+        parent = exec_stats.ExecStats()
+        parent.record_node("dn1", exec_stats.ExecStats(), 1.0)
+        stages = parent.rows_table()["stage"]
+        assert "  dn1" in stages
+        assert stages.index("  dn1") < stages.index("total")
+
+
+class TestTraceparent:
+    def test_roundtrip_inside_span(self):
+        from greptimedb_tpu.common import telemetry
+        assert telemetry.current_traceparent() is None
+        with telemetry.span("outer") as sp:
+            header = telemetry.current_traceparent()
+            assert header is not None
+            trace_id, span_id = telemetry.parse_traceparent(header)
+            assert trace_id == sp["trace_id"]
+            assert span_id == sp["span_id"]
+
+    def test_remote_context_joins_trace(self):
+        from greptimedb_tpu.common import telemetry
+        header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        with telemetry.remote_context(header):
+            with telemetry.span("child") as sp:
+                assert sp["trace_id"] == "ab" * 16
+                assert sp["parent_id"] == "cd" * 8
+        assert telemetry.current_span() is None
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-span-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",       # all-zero trace
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",      # non-hex
+    ])
+    def test_malformed_headers_are_noops(self, bad):
+        from greptimedb_tpu.common import telemetry
+        assert telemetry.parse_traceparent(bad) is None
+        with telemetry.remote_context(bad):
+            with telemetry.span("child") as sp:
+                assert sp["parent_id"] is None    # fresh trace
+
+    def test_propagate_carries_wire_context_into_workers(self):
+        from greptimedb_tpu.common import telemetry
+        from greptimedb_tpu.common.runtime import parallel_map
+        header = "00-" + "12" * 16 + "-" + "34" * 8 + "-01"
+        seen = []
+        with telemetry.remote_context(header):
+            parallel_map(
+                lambda i: seen.append(
+                    telemetry.current_span()["trace_id"]), [1, 2, 3])
+        assert seen == ["12" * 16] * 3

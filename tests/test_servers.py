@@ -197,6 +197,130 @@ class TestHttpSql:
         assert "t" in names
 
 
+class TestLatencyHistograms:
+    """ISSUE 6: log-bucketed latency histograms on /metrics (proper
+    Prometheus histogram text format) and their p50/p95/p99 summaries in
+    information_schema.runtime_metrics."""
+
+    def _histogram_series(self, text, family):
+        """{labelkey: [(le, count)...]}, plus _sum/_count presence."""
+        import re
+        buckets = {}
+        saw_sum = saw_count = False
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            if line.startswith(f"{family}_sum"):
+                saw_sum = True
+            if line.startswith(f"{family}_count"):
+                saw_count = True
+            m = re.match(rf"{family}_bucket\{{(.*)\}} (\S+)", line)
+            if not m:
+                continue
+            labels, value = m.group(1), float(m.group(2))
+            le = re.search(r'le="([^"]+)"', labels).group(1)
+            key = re.sub(r'le="[^"]+",?', "", labels).strip(",")
+            buckets.setdefault(key, []).append((float(le), value))
+        return buckets, saw_sum, saw_count
+
+    def test_prometheus_text_format_compliance(self, server):
+        """_bucket/_sum/_count with le labels; cumulative buckets are
+        monotone non-decreasing and end at le=+Inf == _count."""
+        sql(server, "SELECT 1")       # at least one stmt observation
+        status, body = req(server, "/metrics")
+        assert status == 200
+        text = body.decode()
+        family = "greptime_stmt_latency_seconds"
+        assert f"# TYPE {family} histogram" in text
+        buckets, saw_sum, saw_count = self._histogram_series(text, family)
+        assert saw_sum and saw_count and buckets
+        import re
+        counts_by_labels = {}
+        for line in text.splitlines():
+            m = re.match(rf"{family}_count\{{(.*)\}} (\S+)", line)
+            if m:
+                counts_by_labels[m.group(1)] = float(m.group(2))
+        for key, series in buckets.items():
+            les = [le for le, _ in series]
+            assert les == sorted(les)
+            assert les[-1] == float("inf"), "le=+Inf bucket required"
+            values = [v for _, v in series]
+            assert values == sorted(values), \
+                f"buckets must be cumulative monotone: {series}"
+            assert values[-1] == counts_by_labels[key], \
+                "+Inf bucket must equal _count"
+
+    def test_log_bucket_layout(self, server):
+        """The primitive is log-bucketed: consecutive finite bounds keep
+        a constant ratio (×2), not the prometheus linear default."""
+        sql(server, "SELECT 1")
+        _, body = req(server, "/metrics")
+        buckets, _, _ = self._histogram_series(
+            body.decode(), "greptime_stmt_latency_seconds")
+        series = next(iter(buckets.values()))
+        finite = [le for le, _ in series if le != float("inf")]
+        ratios = {round(b / a, 6) for a, b in zip(finite, finite[1:])}
+        assert ratios == {2.0}, finite
+
+    def test_runtime_metrics_serves_quantiles(self, server):
+        sql(server, "SELECT 1")
+        out = sql(server,
+                  "SELECT metric_name, value, kind FROM "
+                  "information_schema.runtime_metrics WHERE metric_name "
+                  "LIKE 'greptime_stmt_latency_seconds_p%'")
+        rows = out["output"][0]["records"]["rows"]
+        names = {r[0] for r in rows}
+        assert {"greptime_stmt_latency_seconds_p50",
+                "greptime_stmt_latency_seconds_p95",
+                "greptime_stmt_latency_seconds_p99"} <= names
+        for name, value, kind in rows:
+            assert kind == "summary"
+            assert 0.0 <= value < 60.0
+
+    def test_http_route_latency_recorded(self, server):
+        sql(server, "SELECT 1")
+        _, body = req(server, "/metrics")
+        text = body.decode()
+        assert "greptime_http_request_seconds_bucket" in text
+        assert 'route="/v1/sql"' in text
+
+
+class TestTraceparentHeader:
+    def test_sql_joins_external_trace(self, server, caplog):
+        """A client-supplied W3C traceparent header threads through the
+        executor: the slow-query log reports the client's trace id."""
+        import logging
+        from greptimedb_tpu.common.telemetry import (
+            set_slow_query_threshold_ms)
+        trace = "beadfeedbeadfeedbeadfeedbeadfeed"
+        set_slow_query_threshold_ms(1)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.slow_query"):
+                status, _ = req(
+                    server, "/v1/sql", "POST",
+                    urllib.parse.urlencode(
+                        {"sql": "SELECT count(*) AS c FROM numbers a "
+                                "CROSS JOIN numbers b"}).encode(),
+                    {"Content-Type": "application/x-www-form-urlencoded",
+                     "traceparent":
+                         f"00-{trace}-00f067aa0ba902b7-01"})
+        finally:
+            set_slow_query_threshold_ms(None)
+        assert status == 200
+        slow = [r.getMessage() for r in caplog.records
+                if "slow query" in r.getMessage()]
+        assert slow and f"trace={trace}" in slow[-1]
+
+    def test_malformed_traceparent_ignored(self, server):
+        status, _ = req(
+            server, "/v1/sql", "POST",
+            urllib.parse.urlencode({"sql": "SELECT 1"}).encode(),
+            {"Content-Type": "application/x-www-form-urlencoded",
+             "traceparent": "garbage-header"})
+        assert status == 200
+
+
 class TestInfluxIngest:
     def test_line_protocol_write(self, server):
         body = (b"weather,location=us-midwest temperature=82.5 "
