@@ -316,6 +316,58 @@ def bench_ingest_failpoint_overhead(n_rows: int):
     return len(ts) / dt_instrumented, ratio, per_call_ns
 
 
+def bench_lock_overhead():
+    """Sixth driver metric (ISSUE 7): the lock-order detector's
+    inactive-mode cost, same methodology as the failpoint ~190ns/call
+    assertion. The TrackedLock factory must hand back a PLAIN
+    threading.Lock when the detector is off — production acquires pay
+    literally zero extra — so the differential against threading.Lock
+    is asserted structurally (identical type) AND by wall clock."""
+    import threading
+    import timeit
+
+    from greptimedb_tpu.common import locks
+
+    # bench.py never imports pytest, so auto-detection leaves the
+    # detector off unless the operator forced it via env
+    assert not locks.enabled(), (
+        "detector unexpectedly ON in bench (GREPTIME_LOCK_CHECK set, or "
+        "pytest leaked into the process) — inactive-mode numbers would "
+        "be meaningless")
+    tracked = locks.TrackedLock("bench.lock")
+    raw = threading.Lock()
+    assert type(tracked) is type(raw), (
+        "inactive TrackedLock must BE threading.Lock, not a wrapper")
+
+    n = 1_000_000
+
+    def cycle(lk):
+        def run():
+            lk.acquire()
+            lk.release()
+        return run
+
+    # interleave best-of-3 so shared-box drift lands on both sides
+    t_tracked = t_raw = float("inf")
+    for _ in range(3):
+        t_tracked = min(t_tracked, timeit.timeit(cycle(tracked), number=n))
+        t_raw = min(t_raw, timeit.timeit(cycle(raw), number=n))
+    ns_tracked = t_tracked / n * 1e9
+    ns_raw = t_raw / n * 1e9
+    ratio = t_raw / t_tracked            # 1.0 = zero overhead
+    # same objects, same type: anything past noise means the factory
+    # started wrapping inactive locks
+    assert ratio >= 0.7, (
+        f"inactive TrackedLock cost {1/ratio:.2f}x a raw threading.Lock "
+        f"({ns_tracked:.1f}ns vs {ns_raw:.1f}ns per acquire/release)")
+
+    # active-mode cost, for the record (what tests pay, never production)
+    forced = locks.TrackedLock("bench.lock_active", force=True)
+    t_active = timeit.timeit(cycle(forced), number=n // 10)
+    ns_active = t_active / (n // 10) * 1e9
+    return ns_tracked, ns_raw, ratio, ns_active
+
+
 def bench_dist_scatter(n_rows: int):
     """Fifth driver metric (ISSUE 5): multi-datanode group-by through the
     distributed frontend. 4 in-process datanodes host an 8-region
@@ -516,6 +568,16 @@ def main():
         "rows": fp_rows,
         "failpoint_inactive_ratio": round(fp_ratio, 3),
         "failpoint_inactive_ns_per_call": round(fp_ns, 1),
+    }))
+
+    lk_ns, lk_raw_ns, lk_ratio, lk_active_ns = bench_lock_overhead()
+    print(json.dumps({
+        "metric": "tracked_lock_inactive_overhead",
+        "value": round(lk_ns, 1),
+        "unit": "ns/acquire-release",
+        "raw_lock_ns": round(lk_raw_ns, 1),
+        "inactive_ratio": round(lk_ratio, 3),
+        "active_mode_ns": round(lk_active_ns, 1),
     }))
 
 

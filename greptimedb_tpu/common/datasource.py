@@ -45,7 +45,7 @@ def file_codec(path: str, explicit: Optional[str] = None) -> Optional[str]:
     return None
 
 
-def open_compressed_in(path: str, codec: Optional[str]):
+def open_compressed_in(path: str, codec: Optional[str]) -> "pa.NativeFile":
     """Readable stream over a possibly-compressed local file."""
     raw = pa.OSFile(path, "rb")
     if codec is None:
@@ -53,7 +53,7 @@ def open_compressed_in(path: str, codec: Optional[str]):
     return pa.CompressedInputStream(raw, codec)
 
 
-def open_compressed_out(path: str, codec: Optional[str]):
+def open_compressed_out(path: str, codec: Optional[str]) -> "pa.NativeFile":
     """Writable stream producing a possibly-compressed local file."""
     raw = pa.OSFile(path, "wb")
     if codec is None:

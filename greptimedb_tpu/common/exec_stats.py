@@ -43,7 +43,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 _tls = threading.local()
 
@@ -193,7 +193,8 @@ class ExecStats:
         cols: Dict[str, List] = {"stage": [], "rows": [], "files": [],
                                  "elapsed_ms": [], "detail": []}
 
-        def add(stage, rows, files, elapsed_ms, detail):
+        def add(stage: str, rows: int, files: int, elapsed_ms: float,
+                detail: object) -> None:
             cols["stage"].append(stage)
             cols["rows"].append(int(rows))
             cols["files"].append(int(files))
@@ -219,7 +220,7 @@ class ExecStats:
         return cols
 
 
-def node_sort_key(label: str):
+def node_sort_key(label: str) -> List[object]:
     """Natural order for node labels: dn2 before dn10 (a lexicographic
     sort misorders clusters with 10+ datanodes). Shared by the ANALYZE
     tree, the slow-query nodes= digest, and the node_ms vector."""
@@ -227,7 +228,7 @@ def node_sort_key(label: str):
             for part in re.split(r"(\d+)", label)]
 
 
-def _json_safe(v):
+def _json_safe(v: object) -> object:
     """Detail values may be numpy scalars (row counts summed by storage
     code); coerce to plain JSON types for the wire."""
     if isinstance(v, (str, bool, int, float)) or v is None:
@@ -236,12 +237,12 @@ def _json_safe(v):
     if callable(item):
         try:
             return item()
-        except Exception:  # noqa: BLE001 — best effort
-            pass
+        except Exception:  # noqa: BLE001 — non-scalar .item(): fall back
+            return str(v)
     return str(v)
 
 
-def _add_node_rows(add, node_items) -> None:
+def _add_node_rows(add: "Callable", node_items: "list") -> None:
     """Per-node blocks of the EXPLAIN ANALYZE tree: a header row naming
     the node's actual dispatch + node-vs-network split, then its stage
     rows indented underneath."""

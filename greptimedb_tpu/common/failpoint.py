@@ -43,7 +43,7 @@ import os
 import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..errors import GreptimeError
 
@@ -75,6 +75,17 @@ _lock = threading.Lock()
 _points: "Dict[str, _Point]" = {}
 #: module-level fast-path guard: False ⇔ no failpoint is armed anywhere
 _ACTIVE = False
+#: optional observer invoked with the site name on EVERY evaluation
+#: (armed or not) — common/locks.py installs its blocking-I/O-under-lock
+#: check here when the lock-order detector is enabled. None in
+#: production: the inactive fast path stays one extra is-None branch.
+_IO_HOOK = None
+
+
+def set_io_site_hook(hook: "Optional[Callable[[str], None]]") -> None:
+    """Install (or with None remove) the per-evaluation site observer."""
+    global _IO_HOOK
+    _IO_HOOK = hook
 
 
 class _Point:
@@ -93,7 +104,7 @@ class _Point:
         self._count = 0                   # rolling NxM window position
 
 
-def parse_action(spec: str):
+def parse_action(spec: str) -> "Tuple[str, Optional[str], int, int]":
     """Parse an action spec; returns (kind, arg, fire_n, window_m).
     Raises ValueError on malformed input (the SET/HTTP surfaces turn
     that into a user error instead of arming garbage)."""
@@ -227,6 +238,8 @@ def fires(name: str) -> bool:
     bespoke fault (e.g. the WAL writing a deliberately torn record before
     crashing) instead of the standard raise/delay behaviors. The armed
     action's kind is ignored; the call only consumes one firing slot."""
+    if _IO_HOOK is not None:
+        _IO_HOOK(name)
     if not _ACTIVE:
         return False
     return _should_fire(name) is not None
@@ -234,6 +247,8 @@ def fires(name: str) -> bool:
 
 def fail_point(name: str) -> None:
     """Evaluate a failpoint: no-op unless armed, else run its action."""
+    if _IO_HOOK is not None:
+        _IO_HOOK(name)
     if not _ACTIVE:
         return
     p = _should_fire(name)
@@ -252,7 +267,7 @@ def fail_point(name: str) -> None:
 
 
 @contextlib.contextmanager
-def cfg(name: str, spec: str):
+def cfg(name: str, spec: str) -> "Iterator[None]":
     """Arm a failpoint for a with-block (tests), disarming on exit."""
     configure(name, spec)
     try:

@@ -19,14 +19,15 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import threading
-from typing import Callable, Iterator, Optional
+from typing import Callable, Iterable, Iterator, Optional
 
 from ..storage.scheduler import RepeatedTask  # canonical impl, re-export
 
 __all__ = ["RepeatedTask", "spawn_bg", "spawn_read", "spawn_write",
            "bg_runtime", "read_runtime", "write_runtime", "dist_runtime",
            "dist_fanout", "configure_dist_fanout", "env_int",
-           "shutdown_runtimes"]
+           "shutdown_runtimes", "new_thread", "transient_executor",
+           "spawn_on"]
 
 _lock = threading.Lock()
 _pools = {}
@@ -53,7 +54,8 @@ def dist_fanout() -> int:
 def configure_dist_fanout(n: int) -> None:
     """SET dist_fanout — 1 serializes the scatter (the pre-parallel
     behavior, kept for differential benchmarks and debugging)."""
-    _DIST_FANOUT[0] = max(1, int(n))
+    with _lock:
+        _DIST_FANOUT[0] = max(1, int(n))
 
 
 def _pool(name: str) -> concurrent.futures.ThreadPoolExecutor:
@@ -83,19 +85,57 @@ def dist_runtime() -> concurrent.futures.ThreadPoolExecutor:
     return _pool("dist")
 
 
-def spawn_bg(fn: Callable, *args, **kwargs):
+def spawn_bg(fn: Callable, *args: object,
+             **kwargs: object) -> "concurrent.futures.Future":
     from .telemetry import propagate
     return bg_runtime().submit(propagate(fn), *args, **kwargs)
 
 
-def spawn_read(fn: Callable, *args, **kwargs):
+def spawn_read(fn: Callable, *args: object,
+               **kwargs: object) -> "concurrent.futures.Future":
     from .telemetry import propagate
     return read_runtime().submit(propagate(fn), *args, **kwargs)
 
 
-def spawn_write(fn: Callable, *args, **kwargs):
+def spawn_write(fn: Callable, *args: object,
+                **kwargs: object) -> "concurrent.futures.Future":
     from .telemetry import propagate
     return write_runtime().submit(propagate(fn), *args, **kwargs)
+
+
+def new_thread(target: Callable, *, name: Optional[str] = None,
+               daemon: bool = True, args: tuple = (),
+               propagate_context: bool = True) -> threading.Thread:
+    """The one sanctioned way to start a dedicated thread (greptlint
+    GL06): the target is wrapped in ``telemetry.propagate()`` so the
+    worker inherits the creating thread's span + ExecStats context
+    instead of silently detaching from its query. Long-lived accept
+    loops pass ``propagate_context=False`` — they outlive any request
+    and must NOT pin the creator's trace."""
+    if propagate_context:
+        from .telemetry import propagate
+        target = propagate(target)
+    return threading.Thread(target=target, name=name, daemon=daemon,
+                            args=args)
+
+
+def transient_executor(max_workers: int,
+                       name: str = "transient"
+                       ) -> concurrent.futures.ThreadPoolExecutor:
+    """A short-lived PLAIN pool: its ``.submit()`` does NOT carry trace
+    context — submit through :func:`spawn_on`, or pre-wrap the callable
+    in ``telemetry.propagate()`` (what query/stream_exec does). Prefer
+    the named shared runtimes for steady-state work (a transient pool
+    per call churns threads)."""
+    return concurrent.futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix=f"gdb-{name}")
+
+
+def spawn_on(pool: concurrent.futures.Executor, fn: Callable,
+             *args: object, **kwargs: object) -> "concurrent.futures.Future":
+    """submit() with telemetry context carried onto the worker."""
+    from .telemetry import propagate
+    return pool.submit(propagate(fn), *args, **kwargs)
 
 
 def shutdown_runtimes(wait: bool = True) -> None:
@@ -105,7 +145,7 @@ def shutdown_runtimes(wait: bool = True) -> None:
         pool.shutdown(wait=wait)
 
 
-def parallel_map(fn: Callable, items, *, max_workers: int = 8,
+def parallel_map(fn: Callable, items: "Iterable", *, max_workers: int = 8,
                  pool: Optional[concurrent.futures.Executor] = None) -> list:
     """Map fn over items with a thread pool; serial for <=1 item/worker.
 
@@ -119,8 +159,10 @@ def parallel_map(fn: Callable, items, *, max_workers: int = 8,
                               pool=pool))
 
 
-def parallel_imap(fn: Callable, items, *, max_workers: int = 8,
-                  pool: Optional[concurrent.futures.Executor] = None):
+def parallel_imap(fn: Callable, items: "Iterable", *,
+                  max_workers: int = 8,
+                  pool: Optional[concurrent.futures.Executor] = None
+                  ) -> Iterator:
     """parallel_map but yielding results in order as they become ready, so
     the consumer can process-and-drop (pipelined gather) instead of
     barriering on the slowest item."""

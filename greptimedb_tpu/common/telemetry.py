@@ -35,7 +35,8 @@ import sys
 import threading
 import time
 import uuid
-from typing import Dict, Iterator, Optional
+from typing import (Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 logger = logging.getLogger(__name__)
 
@@ -81,7 +82,7 @@ def install_panic_hook() -> None:
     """Log uncaught exceptions before dying (reference: panic_hook.rs)."""
     prev = sys.excepthook
 
-    def hook(exc_type, exc, tb):
+    def hook(exc_type: type, exc: BaseException, tb: object) -> None:
         logging.getLogger("panic").critical(
             "uncaught exception", exc_info=(exc_type, exc, tb))
         prev(exc_type, exc, tb)
@@ -99,7 +100,7 @@ def current_span() -> Optional[Dict]:
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs) -> Iterator[Dict]:
+def span(name: str, **attrs: object) -> Iterator[Dict]:
     """Nested span: inherits trace_id from the parent, logs duration on
     exit at DEBUG, and (when configured) ships to an OTLP collector."""
     stack = getattr(_tls, "spans", None)
@@ -131,7 +132,7 @@ def span(name: str, **attrs) -> Iterator[Dict]:
             exporter.enqueue(s, int(elapsed_ms * 1e6))
 
 
-def propagate(fn):
+def propagate(fn: Callable) -> Callable:
     """Capture the calling thread's span stack NOW and return a callable
     that re-installs it around `fn` wherever it runs.
 
@@ -157,7 +158,7 @@ def propagate(fn):
     import functools
 
     @functools.wraps(fn)
-    def wrapped(*args, **kwargs):
+    def wrapped(*args, **kwargs):  # type: ignore[no-untyped-def]
         prev = getattr(_tls, "spans", None)
         _tls.spans = list(captured)
         with _es.collect_into(stats):
@@ -274,7 +275,8 @@ def set_slow_query_threshold_ms(value: Optional[int]) -> None:
     """SET slow_query_threshold_ms — 0 or negative disables."""
     if value is not None and value <= 0:
         value = None
-    _SLOW_QUERY_MS[0] = value
+    with _metrics_lock:
+        _SLOW_QUERY_MS[0] = value
 
 
 # ---------------------------------------------------------------------------
@@ -370,14 +372,17 @@ def configure_otlp(endpoint: Optional[str],
                    service_name: str = "greptimedb",
                    flush_interval: float = 2.0) -> Optional[OtlpExporter]:
     """Enable (or, with endpoint=None, disable) OTLP span export."""
-    old = _OTLP[0]
+    with _metrics_lock:
+        old, _OTLP[0] = _OTLP[0], None
     if old is not None:
-        old.shutdown()
-        _OTLP[0] = None
+        old.shutdown()        # flushes over the network: outside the lock
+    exporter = None
     if endpoint:
-        _OTLP[0] = OtlpExporter(endpoint, service_name=service_name,
+        exporter = OtlpExporter(endpoint, service_name=service_name,
                                 flush_interval=flush_interval)
-    return _OTLP[0]
+        with _metrics_lock:
+            _OTLP[0] = exporter
+    return exporter
 
 
 # ---------------------------------------------------------------------------
@@ -395,14 +400,22 @@ _sanitized_owners: Dict[str, str] = {}
 
 
 def _sanitize(name: str) -> str:
+    # takes _metrics_lock itself (callers call it BEFORE their own
+    # acquire): two threads first-time-sanitizing colliding names must
+    # agree on one owner, and the collision remap below is check-then-set
     key = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
-    owner = _sanitized_owners.setdefault(key, name)
-    if owner != name:
+    with _metrics_lock:
+        owner = _sanitized_owners.setdefault(key, name)
+        collided = owner != name
+    if collided:
         import zlib
         crc = zlib.crc32(name.encode()) & 0xFFFF
         key2 = f"{key}_x{crc:04x}"
-        if key2 not in _sanitized_owners:
-            _sanitized_owners[key2] = name
+        with _metrics_lock:
+            first_remap = key2 not in _sanitized_owners
+            if first_remap:
+                _sanitized_owners[key2] = name
+        if first_remap:
             logger.error(
                 "metric name collision: %r and %r both sanitize to %r; "
                 "recording %r as %r instead", owner, name, key, name, key2)
@@ -466,7 +479,8 @@ _latency_hists: Dict[str, tuple] = {}
 _latency_label_mismatches: set = set()
 
 
-def observe_latency(name: str, seconds: float, **labels) -> None:
+def observe_latency(name: str, seconds: float,
+                    **labels: object) -> None:
     """Record one observation on the log-bucketed latency histogram
     `greptime_<name>_seconds{**labels}`. Label NAMES must be stable per
     metric (prometheus fixes them at creation); a mismatched call is
@@ -514,7 +528,9 @@ def observe_latency(name: str, seconds: float, **labels) -> None:
     (h.labels(**labels) if labelnames else h).observe(float(seconds))
 
 
-def latency_summaries(quantiles=(0.5, 0.95, 0.99), families=None):
+def latency_summaries(quantiles: Sequence[float] = (0.5, 0.95, 0.99),
+                      families: Optional[list] = None
+                      ) -> List[Tuple[str, str, float]]:
     """(name_pNN, labels_str, value_seconds) estimates for every
     histogram in the registry, interpolated from its cumulative buckets —
     the p50/p95/p99 rows information_schema.runtime_metrics serves next

@@ -107,10 +107,10 @@ class Timestamp:
         raise ValueError(f"invalid timestamp literal: {s!r}")
 
     # ordering/equality/hash all compare the actual instant, across units
-    def _cmp_key(self):
+    def _cmp_key(self) -> int:
         return self.convert_to(TimeUnit.NANOSECOND).value
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         if not isinstance(other, Timestamp):
             return NotImplemented
         return self._cmp_key() == other._cmp_key()

@@ -1,0 +1,266 @@
+"""greptlint driver: file collection, AST indexing, suppressions, baseline.
+
+The analyzer is rule-based over the stdlib ``ast`` module (no external
+dependencies). Each scanned file is parsed **once** into a
+:class:`ModuleInfo` carrying the tree, a parent map, and a by-node-type
+index; rules (see ``rules.py``) query the index instead of re-walking,
+so adding a rule costs one dict lookup per node type, not a fresh pass.
+
+Suppressions are comment-driven and reviewable in diffs:
+
+- ``# greptlint: disable=GL01`` (trailing or own-line) silences the
+  named rule(s) on that line;
+- ``# greptlint: disable-file=GL03`` anywhere in the file silences the
+  rule(s) for the whole file. ``all`` matches every rule.
+
+The baseline file grandfathers pre-existing findings: keys are
+``RULE:relpath:crc32(stripped source line)`` (line-number independent,
+so unrelated edits don't churn it) with an occurrence count. Findings
+beyond the baselined count fail the run; fixing findings never does.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import logging
+import os
+import re
+import zlib
+from collections import Counter, defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+SUPPRESS_RE = re.compile(
+    r"#\s*greptlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+#: directories never collected when walking (explicit file args still scan)
+SKIP_DIRS = frozenset({"__pycache__", "selftest", ".git"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # absolute path
+    rel: str           # path relative to the scan root (stable key part)
+    line: int
+    col: int
+    msg: str
+    source_line: str = ""
+
+    def baseline_key(self) -> str:
+        crc = zlib.crc32(self.source_line.strip().encode()) & 0xFFFFFFFF
+        return f"{self.rule}:{self.rel}:{crc:08x}"
+
+    def render(self) -> str:
+        return f"{self.rel}:{self.line}:{self.col}: {self.rule} {self.msg}"
+
+
+class ModuleInfo:
+    """One parsed file: tree + parent map + node index + suppressions."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.by_type: Dict[type, List[ast.AST]] = defaultdict(list)
+        for node in ast.walk(self.tree):
+            self.by_type[type(node)].append(node)
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.line_suppressed: Dict[int, Set[str]] = {}
+        self.file_suppressed: Set[str] = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(2).split(",")}
+            if m.group(1) == "disable-file":
+                self.file_suppressed |= rules
+            else:
+                self.line_suppressed.setdefault(i, set()).update(rules)
+
+    def nodes(self, *types: type) -> Iterator[ast.AST]:
+        for t in types:
+            yield from self.by_type.get(t, ())
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        if "ALL" in self.file_suppressed or rule in self.file_suppressed:
+            return True
+        on_line = self.line_suppressed.get(lineno, ())
+        return rule in on_line or "ALL" in on_line
+
+    def finding(self, rule: str, node: ast.AST, msg: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, rel=self.rel,
+                       line=lineno, col=col, msg=msg,
+                       source_line=self.line_text(lineno))
+
+
+@dataclass
+class ProjectContext:
+    """Cross-file facts collected in a pre-pass before rules run."""
+    root: str
+    #: failpoint names registered anywhere (static `register("x")` calls
+    #: across the scanned tree, unioned with the live registry when the
+    #: package is importable) — GL04 checks call sites against this
+    failpoint_names: Set[str] = field(default_factory=set)
+    errors: List[str] = field(default_factory=list)
+    #: abs path -> source read by build_context's pre-pass, consumed by
+    #: run_files so each file hits the disk once, not twice
+    sources: Dict[str, str] = field(default_factory=dict)
+
+
+def _package_rel(path: str) -> str:
+    """rel for an explicitly-passed file, matching what a directory scan
+    of its containing package would produce: climb while ``__init__.py``
+    marks a package, then relativize from the package root's parent.
+    Path-scoped rules (GL05/GL07) and baseline keys would otherwise see
+    a bare basename on single-file scans and silently not apply."""
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return os.path.relpath(path, d)
+
+
+def collect_files(paths: Iterable[str]) -> List[Tuple[str, str]]:
+    """Expand files/directories into (abs_path, rel_path) pairs.
+
+    Directory walks skip SKIP_DIRS (fixtures with seeded violations live
+    under ``selftest/``); a path given explicitly is always scanned."""
+    out: List[Tuple[str, str]] = []
+    seen: Set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            rel = _package_rel(p)
+            if p not in seen:
+                seen.add(p)
+                out.append((p, rel))
+            continue
+        base = os.path.dirname(p.rstrip(os.sep))
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                ap = os.path.join(dirpath, fn)
+                if ap in seen:
+                    continue
+                seen.add(ap)
+                out.append((ap, os.path.relpath(ap, base)))
+    return out
+
+
+# matches plain `register("x")` and aliased imports like
+# `from ..common.failpoint import register as _fp_register` — any
+# identifier ENDING in `register` counts (over-matching only shrinks
+# GL04's reach, never produces a false positive)
+_REGISTER_RE = re.compile(r"""\b\w*register\(\s*["']([a-z][a-z0-9_]*)["']""")
+
+
+def build_context(files: List[Tuple[str, str]], root: str) -> ProjectContext:
+    ctx = ProjectContext(root=root)
+    for path, _rel in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            ctx.errors.append(f"{path}: unreadable: {e}")
+            continue
+        ctx.sources[path] = src
+        ctx.failpoint_names.update(_REGISTER_RE.findall(src))
+    # union the live registry: names registered by modules outside the
+    # scanned set (the analyzer may be pointed at one subpackage)
+    try:
+        from ...common import failpoint
+        ctx.failpoint_names.update(p["name"] for p in failpoint.list_points())
+    except Exception as e:  # noqa: BLE001 — linting must not require a
+        # fully importable package (e.g. scanning a broken tree); the
+        # static register() sweep above already covers the common case,
+        # so degrade to it with a note rather than failing the run
+        logger.warning("greptlint: live failpoint registry unavailable "
+                       "(%s); GL04 uses the static register() sweep only",
+                       e)
+    return ctx
+
+
+def run_files(files: List[Tuple[str, str]], rules: "Iterable",
+              ctx: ProjectContext) -> Tuple[List[Finding], List[str]]:
+    """Parse each file once and run every rule; returns (findings, errors).
+    Suppression comments are honored here so every rule gets them free."""
+    findings: List[Finding] = []
+    errors: List[str] = list(ctx.errors)
+    for path, rel in files:
+        try:
+            source = ctx.sources.pop(path, None)
+            if source is None:           # ctx built by a different caller
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            mod = ModuleInfo(path, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: cannot parse: {e}")
+            continue
+        for rule in rules:
+            for fnd in rule.check(mod, ctx):
+                if not mod.suppressed(fnd.rule, fnd.line):
+                    findings.append(fnd)
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return findings, errors
+
+
+# ---- baseline ------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != 1:
+        raise ValueError(f"unsupported baseline format in {path}")
+    return {str(k): int(v) for k, v in doc.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: List[Finding]) -> int:
+    counts = Counter(f.baseline_key() for f in findings)
+    doc = {"version": 1, "findings": dict(sorted(counts.items()))}
+    from ...utils import atomic_write
+    atomic_write(path, json.dumps(doc, indent=1) + "\n", fsync=False)
+    return sum(counts.values())
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, int]) -> List[Finding]:
+    """Drop findings covered by the baseline; the overflow (more
+    occurrences of a key than grandfathered) stays reported."""
+    budget = Counter(baseline)
+    fresh: List[Finding] = []
+    for f in findings:
+        key = f.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,434 @@
+"""greptlint rules GL01-GL08: the project's load-bearing conventions.
+
+Each rule is grounded in a real past bug class (see README "Static
+analysis & invariants"); together they turn six PRs of reviewer folklore
+into a build gate. Rules are small classes over the shared
+:class:`~..core.ModuleInfo` index; to add one, subclass :class:`Rule`,
+give it an ``id``/``title``, implement ``check``, append it to
+:data:`ALL_RULES`, and drop a seeded-violation fixture into
+``selftest/`` (tests/test_greptlint.py picks it up automatically).
+
+Path scoping note: scoped rules (GL05 storage/client/meta, GL07
+servers/) also match ``selftest/`` so each rule's fixture can live with
+the analyzer instead of being planted into production packages.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo, ProjectContext
+
+
+def _segments(rel: str) -> List[str]:
+    return rel.replace("\\", "/").split("/")
+
+
+def _in_dirs(rel: str, dirs: Sequence[str]) -> bool:
+    segs = _segments(rel)[:-1]
+    return any(d in segs for d in dirs)
+
+
+def _is_module(rel: str, names: Sequence[str]) -> bool:
+    norm = rel.replace("\\", "/")
+    return any(norm.endswith(n) for n in names)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'os.path.join' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _walk_shallow(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    bodies (their control flow doesn't handle THIS except block)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Rule:
+    id: str = "GL00"
+    title: str = ""
+
+    def check(self, mod: ModuleInfo,
+              ctx: ProjectContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _catches(handler: ast.ExceptHandler, names: Set[str]) -> bool:
+    t = handler.type
+    types = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+    for e in types:
+        d = _dotted(e)
+        if d.split(".")[-1] in names:
+            return True
+    return False
+
+
+#: attribute names whose call inside a handler counts as "dealt with it":
+#: logging, metric counters, error recording / waiter hand-off
+_HANDLED_CALL_ATTRS = frozenset({
+    "exception", "error", "warning", "warn", "critical", "info", "debug",
+    "log", "inc", "observe", "observe_latency", "increment_counter",
+    "record", "_finish", "put_nowait", "submit_later", "add_error",
+    "set_exception",
+})
+_HANDLED_CALL_NAMES = frozenset({
+    "increment_counter", "observe_latency", "logged", "record_error",
+    "print",                                # CLI/REPL error reporting
+})
+
+
+def _handler_deals_with_it(handler: ast.ExceptHandler) -> bool:
+    for node in _walk_shallow(handler.body):
+        if isinstance(node, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(node, ast.AugAssign):
+            return True                      # counter bump (x += 1)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HANDLED_CALL_ATTRS:
+                return True
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _HANDLED_CALL_NAMES:
+                return True
+    return False
+
+
+class SwallowedException(Rule):
+    id = "GL01"
+    title = ("`except Exception`/bare `except` must log, re-raise, count, "
+             "or return a degraded value — silent swallows hide real bugs")
+
+    def check(self, mod, ctx):
+        for h in mod.nodes(ast.ExceptHandler):
+            bare = h.type is None
+            if not bare and not _catches(h, {"Exception"}):
+                continue
+            if _handler_deals_with_it(h):
+                continue
+            what = "bare `except:`" if bare else "`except Exception`"
+            yield mod.finding(
+                self.id, h,
+                f"{what} swallows the error: the handler neither logs, "
+                f"re-raises, counts, nor returns a degraded value")
+
+
+class BaseExceptionCaught(Rule):
+    id = "GL02"
+    title = ("catching BaseException/SimulatedCrash without re-raising "
+             "defeats crash-injection (SimulatedCrash must behave like "
+             "SIGKILL outside tests/torture.py)")
+
+    EXEMPT = ("tests/torture.py",)
+
+    def check(self, mod, ctx):
+        if _is_module(mod.rel, self.EXEMPT):
+            return
+        for h in mod.nodes(ast.ExceptHandler):
+            bare = h.type is None
+            broad = _catches(h, {"BaseException", "SimulatedCrash"})
+            if not (bare or broad):
+                continue
+            if any(isinstance(n, ast.Raise)
+                   for n in _walk_shallow(h.body)):
+                continue
+            what = ("bare `except:`" if bare else
+                    "`except BaseException`/`except SimulatedCrash`")
+            yield mod.finding(
+                self.id, h,
+                f"{what} without re-raise can swallow SimulatedCrash — "
+                f"crash-injection recovery paths must not survive a "
+                f"simulated kill; re-raise or narrow the catch")
+
+
+class BareRename(Rule):
+    id = "GL03"
+    title = ("os.rename/os.replace outside utils.atomic_write: durable "
+             "renames must go through the one fsync-then-rename helper")
+
+    EXEMPT = ("utils/__init__.py",)
+
+    def check(self, mod, ctx):
+        if _is_module(mod.rel, self.EXEMPT):
+            return
+        for call in mod.nodes(ast.Call):
+            d = _dotted(call.func)
+            if d in ("os.rename", "os.replace"):
+                yield mod.finding(
+                    self.id, call,
+                    f"direct {d}() — route durable write-then-rename "
+                    f"through utils.atomic_write (temp file, fsync, "
+                    f"rename, crash-safe cleanup)")
+
+
+class UnknownFailpoint(Rule):
+    id = "GL04"
+    title = ("failpoint.fail_point/fires(name) literals must name a "
+             "registered point — typos otherwise only WARN at runtime")
+
+    def check(self, mod, ctx):
+        for call in mod.nodes(ast.Call):
+            fn = call.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else "")
+            if name not in ("fail_point", "fires"):
+                continue
+            if not call.args:
+                continue
+            arg = call.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            if arg.value not in ctx.failpoint_names:
+                yield mod.finding(
+                    self.id, call,
+                    f"failpoint {arg.value!r} is not registered anywhere "
+                    f"(known: {len(ctx.failpoint_names)} names) — typo'd "
+                    f"sites never fire")
+
+
+class UntypedRaise(Rule):
+    id = "GL05"
+    title = ("raising bare Exception/RuntimeError in storage/client/meta "
+             "bypasses the errors.* taxonomy the retry layer classifies")
+
+    SCOPE = ("storage", "client", "meta", "selftest")
+    BAD = {"Exception", "RuntimeError"}
+
+    def check(self, mod, ctx):
+        if not _in_dirs(mod.rel, self.SCOPE):
+            return
+        for node in mod.nodes(ast.Raise):
+            exc = node.exc
+            target = exc.func if isinstance(exc, ast.Call) else exc
+            d = _dotted(target) if target is not None else ""
+            if d in self.BAD:
+                yield mod.finding(
+                    self.id, node,
+                    f"raise {d} in a retry-classified layer — raise a "
+                    f"GreptimeError subclass (errors.py) so "
+                    f"is_transient()/status codes stay meaningful")
+
+
+class RawThreadConstruction(Rule):
+    id = "GL06"
+    title = ("ThreadPoolExecutor/threading.Thread construction outside "
+             "common/runtime.py: bespoke pools bypass telemetry."
+             "propagate() and detach spans/ExecStats from their query")
+
+    EXEMPT = ("common/runtime.py", "common/telemetry.py",
+              "storage/scheduler.py")
+
+    def check(self, mod, ctx):
+        if _is_module(mod.rel, self.EXEMPT):
+            return
+        for call in mod.nodes(ast.Call):
+            d = _dotted(call.func)
+            leaf = d.split(".")[-1]
+            if leaf not in ("Thread", "ThreadPoolExecutor", "Timer"):
+                continue
+            if d not in ("Thread", "threading.Thread", "threading.Timer",
+                         "Timer", "ThreadPoolExecutor",
+                         "concurrent.futures.ThreadPoolExecutor",
+                         "futures.ThreadPoolExecutor"):
+                continue
+            yield mod.finding(
+                self.id, call,
+                f"direct {d}() — use common.runtime (new_thread / "
+                f"transient_executor / the shared runtimes) so workers "
+                f"inherit the caller's trace + ExecStats context")
+
+
+class UntracedHandler(Rule):
+    id = "GL07"
+    title = ("servers/ RPC handlers must join the caller's trace: Flight "
+             "do_get/do_put/do_action need remote_context, HTTP handlers "
+             "moving work off-thread need _traced_call")
+
+    SCOPE = ("servers", "selftest")
+    FLIGHT_METHODS = ("do_get", "do_put", "do_action", "do_exchange")
+    TRACE_NAMES = frozenset({"remote_context", "current_traceparent",
+                             "parse_traceparent"})
+
+    def _refs(self, fn: ast.AST, names: Set[str],
+              attrs: Set[str]) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in names:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in (names
+                                                                 | attrs):
+                return True
+        return False
+
+    def check(self, mod, ctx):
+        if not _in_dirs(mod.rel, self.SCOPE):
+            return
+        for cls in mod.nodes(ast.ClassDef):
+            for stmt in cls.body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name in self.FLIGHT_METHODS:
+                    if not self._refs(stmt, set(self.TRACE_NAMES), set()):
+                        yield mod.finding(
+                            self.id, stmt,
+                            f"Flight handler {cls.name}.{stmt.name} never "
+                            f"touches remote_context/traceparent — wire "
+                            f"RPCs would drop the caller's trace")
+                elif stmt.name.startswith("handle_"):
+                    uses_executor = any(
+                        isinstance(n, ast.Attribute)
+                        and n.attr == "run_in_executor"
+                        for n in ast.walk(stmt))
+                    if uses_executor and not self._refs(
+                            stmt, set(self.TRACE_NAMES),
+                            {"_traced_call", "_traced"}):
+                        yield mod.finding(
+                            self.id, stmt,
+                            f"HTTP handler {cls.name}.{stmt.name} ships "
+                            f"work to an executor without _traced_call — "
+                            f"the worker detaches from the request trace")
+
+
+class UnlockedModuleMutation(Rule):
+    id = "GL08"
+    title = ("in modules that declare a module-level lock, module-level "
+             "dict/list state must only be mutated under `with <lock>:`")
+
+    MUTATORS = frozenset({
+        "append", "extend", "insert", "pop", "popitem", "clear", "update",
+        "setdefault", "remove", "discard", "add", "move_to_end",
+    })
+    _CONTAINER_CALLS = frozenset({
+        "dict", "list", "set", "OrderedDict", "defaultdict", "deque",
+        "Counter",
+    })
+
+    def _module_locks(self, mod: ModuleInfo) -> Set[str]:
+        locks: Set[str] = set()
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            v = stmt.value
+            if not isinstance(v, ast.Call):
+                continue
+            d = _dotted(v.func).split(".")[-1]
+            if d in ("Lock", "RLock", "TrackedLock", "TrackedRLock"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks.add(t.id)
+        return locks
+
+    def _module_containers(self, mod: ModuleInfo) -> Set[str]:
+        names: Set[str] = set()
+        for stmt in mod.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            is_container = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                              ast.DictComp, ast.ListComp,
+                                              ast.SetComp))
+            if isinstance(value, ast.Call) and \
+                    _dotted(value.func).split(".")[-1] in \
+                    self._CONTAINER_CALLS:
+                is_container = True
+            if not is_container:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    def _under_lock(self, mod: ModuleInfo, node: ast.AST,
+                    locks: Set[str]) -> bool:
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Name) and e.id in locks:
+                        return True
+                    # lock attribute/call forms: `with _lock:` only —
+                    # other shapes don't guard MODULE state by convention
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # keep walking: an enclosing function may hold the lock
+                # around a nested helper? No — a nested def runs later.
+                return False
+        return False
+
+    def check(self, mod, ctx):
+        locks = self._module_locks(mod)
+        if not locks:
+            return
+        containers = self._module_containers(mod)
+        if not containers:
+            return
+
+        def container_of(node: ast.expr) -> Optional[str]:
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in containers:
+                return node.value.id
+            return None
+
+        candidates: List[Tuple[ast.AST, str, str]] = []
+        for node in mod.nodes(ast.Assign):
+            for t in node.targets:
+                name = container_of(t)
+                if name:
+                    candidates.append((node, name, "item assignment"))
+        for node in mod.nodes(ast.AugAssign):
+            name = container_of(node.target)
+            if name:
+                candidates.append((node, name, "augmented assignment"))
+        for node in mod.nodes(ast.Delete):
+            for t in node.targets:
+                name = container_of(t)
+                if name:
+                    candidates.append((node, name, "deletion"))
+        for node in mod.nodes(ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in self.MUTATORS and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id in containers:
+                candidates.append((node, fn.value.id,
+                                   f".{fn.attr}() call"))
+        for node, name, how in candidates:
+            # module-level statements run at import, single-threaded
+            if not any(isinstance(a, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                       for a in mod.ancestors(node)):
+                continue
+            if self._under_lock(mod, node, locks):
+                continue
+            yield mod.finding(
+                self.id, node,
+                f"module-level container {name!r} mutated ({how}) outside "
+                f"`with {'/'.join(sorted(locks))}:` although this module "
+                f"declares a module lock for its shared state")
+
+
+ALL_RULES: List[Rule] = [
+    SwallowedException(), BaseExceptionCaught(), BareRename(),
+    UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
+    UntracedHandler(), UnlockedModuleMutation(),
+]

@@ -133,6 +133,13 @@ class StorageError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class SchedulerStoppedError(StorageError, RuntimeError):
+    """Background scheduler rejected a submit because it is shutting
+    down. Inherits RuntimeError so pre-taxonomy `except RuntimeError`
+    shutdown paths keep degrading gracefully (skip the job; WAL/retry
+    machinery covers the data)."""
+
+
 class RegionNotFoundError(GreptimeError):
     status_code = StatusCode.REGION_NOT_FOUND
 

@@ -37,8 +37,9 @@ class FlightMetaServer(flight.FlightServerBase):
         return _advertised_address(self._location, self.port)
 
     def serve_in_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve, daemon=True,
-                             name="flight-metasrv")
+        from ..common.runtime import new_thread
+        t = new_thread(self.serve, daemon=True, name="flight-metasrv",
+                       propagate_context=False)
         t.start()
         return t
 

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common.telemetry import increment_counter
 from ..errors import GreptimeError
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -101,9 +102,11 @@ class RaftNode:
 
     # ---- lifecycle ----
     def start(self) -> None:
+        from ..common.runtime import new_thread
         self._stop.clear()
-        t = threading.Thread(target=self._ticker, daemon=True,
-                             name=f"raft-{self.node_id}")
+        t = new_thread(self._ticker, daemon=True,
+                       name=f"raft-{self.node_id}",
+                       propagate_context=False)
         t.start()
         self._threads = [t]
 
@@ -251,7 +254,9 @@ class RaftNode:
                 resp = tr.request_vote(term=term, candidate=self.node_id,
                                        last_idx=last_idx,
                                        last_term=last_term)
-            except Exception:
+            except Exception:  # noqa: BLE001 — unreachable peer ≠ lost
+                # election; the quorum math below absorbs it
+                increment_counter("raft_rpc_errors")
                 continue
             with self._lock:
                 if resp["term"] > self.term:
@@ -432,7 +437,9 @@ class RaftNode:
                             term=term, leader=self.node_id,
                             prev_idx=prev_idx, prev_term=prev_term,
                             entries=entries, commit_idx=commit)
-                except Exception:
+                except Exception:  # noqa: BLE001 — follower unreachable:
+                    # end this round, the next tick retries from next_idx
+                    increment_counter("raft_rpc_errors")
                     break
                 with self._lock:
                     if resp["term"] > self.term:

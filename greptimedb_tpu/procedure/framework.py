@@ -139,8 +139,9 @@ class ProcedureManager:
         pid = procedure_id or uuid.uuid4().hex
         watcher = Watcher()
         if self.run_async:
-            t = threading.Thread(target=self._run, name=f"procedure-{pid}",
-                                 args=(proc, pid, watcher), daemon=True)
+            from ..common.runtime import new_thread
+            t = new_thread(self._run, name=f"procedure-{pid}",
+                           args=(proc, pid, watcher), daemon=True)
             t.start()
         else:
             self._run(proc, pid, watcher)
@@ -178,7 +179,9 @@ class ProcedureManager:
                 if status.persist:
                     self._persist(pid, step, proc)
                     step += 1
-        except BaseException as e:  # noqa: BLE001
+        # a SimulatedCrash lands in watcher.wait(), which re-raises it in
+        # the submitter — delivery, not survival
+        except BaseException as e:  # greptlint: disable=GL02
             logger.exception("procedure %s (%s) failed", pid,
                              proc.type_name)
             try:

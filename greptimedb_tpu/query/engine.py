@@ -258,7 +258,10 @@ class QueryEngine:
             df = None
             try:
                 df = tpu_exec.cached_table_frame(table)
-            except Exception:  # noqa: BLE001 — cache is an optimization
+            except Exception:  # noqa: BLE001 — cache is an optimization;
+                # df=None takes the uncached scan below
+                from ..common.telemetry import increment_counter
+                increment_counter("scan_cache_errors")
                 df = None
             if df is None:
                 cached = False

@@ -905,7 +905,10 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
             pm = np.zeros(target, np.bool_)
             pm[:n] = True
             scan.device["__pad_mask"] = jax.device_put(pm)
-    except Exception:  # noqa: BLE001 — staging is an optimization
+    except Exception:  # noqa: BLE001 — staging is an optimization; the
+        # host arrays still serve the scan
+        from ..common.telemetry import increment_counter
+        increment_counter("stream_device_stage_errors")
         scan.device.clear()
     return ("scan", scan, info)
 
@@ -969,10 +972,10 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     depth = 2
     _t_stream = _time.perf_counter()
     load = propagate(_load_slice)
+    from ..common.runtime import transient_executor
     with span("stream_scan", region=region.name, slices=len(jobs),
               mode=mode), \
-            ThreadPoolExecutor(max_workers=depth,
-                               thread_name_prefix="stream-scan") as pool:
+            transient_executor(depth, "stream-scan") as pool:
         futs = [pool.submit(load, snap, dim, lo, hi, unit, needed,
                             sd, _ROW_BUCKET_MIN, clip, plan, mode,
                             sid_keys)
@@ -1024,14 +1027,18 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     for ln in launched:
         flat.append(ln.counts)
         flat.extend(ln.results)
+    from ..common.telemetry import increment_counter
     for arr in flat:
         if hasattr(arr, "copy_to_host_async"):
             try:
                 arr.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — async staging is optional
+            except Exception:  # noqa: BLE001 — async staging is optional;
+                # the blocking np.asarray below fetches regardless
+                increment_counter("stream_async_fetch_errors")
                 break
-    with ThreadPoolExecutor(max_workers=min(8, len(flat))) as fpool:
-        flat_np = list(fpool.map(np.asarray, flat))
+    from ..common.runtime import parallel_map
+    flat_np = parallel_map(np.asarray, flat,
+                           max_workers=min(8, len(flat)))
     fetched = []
     pos = 0
     for ln in launched:

@@ -844,8 +844,10 @@ def dispatch_decision_for_pushdown(table, plan) -> str:
     if describe is not None:
         try:
             return describe(plan)
-        except Exception:  # noqa: BLE001 — describing must never fail a query
-            pass
+        except Exception:  # noqa: BLE001 — describing must never fail a
+            # query; fall through to the generic dispatch line
+            from ..common.telemetry import increment_counter
+            increment_counter("explain_describe_errors")
     return "aggregate-pushdown (datanodes reduce, frontend folds)"
 
 

@@ -150,8 +150,10 @@ class FlightDatanodeServer(flight.FlightServerBase):
         return _advertised_address(self._location, self.port)
 
     def serve_in_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve, daemon=True,
-                             name=f"flight-dn{self.datanode.opts.node_id}")
+        from ..common.runtime import new_thread
+        t = new_thread(self.serve, daemon=True,
+                       name=f"flight-dn{self.datanode.opts.node_id}",
+                       propagate_context=False)
         t.start()
         return t
 
@@ -323,8 +325,9 @@ class FlightFrontendServer(flight.FlightServerBase):
         return _advertised_address(self._location, self.port)
 
     def serve_in_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve, daemon=True,
-                             name="flight-frontend")
+        from ..common.runtime import new_thread
+        t = new_thread(self.serve, daemon=True, name="flight-frontend",
+                       propagate_context=False)
         t.start()
         return t
 
@@ -421,6 +424,13 @@ class FlightFrontendServer(flight.FlightServerBase):
     def do_put(self, context, descriptor, reader, writer):
         cmd = json.loads(descriptor.command)
         kind = cmd.get("type")
+        # same contract as do_get's ticket: the descriptor command may
+        # carry the writer's W3C traceparent, so bulk writes stitch onto
+        # the client's trace like queries do
+        with remote_context(cmd.get("traceparent")):
+            self._do_put_cmd(cmd, kind, reader, writer)
+
+    def _do_put_cmd(self, cmd, kind, reader, writer):
         if kind == "row_insert":
             columns = _arrow_to_columns(reader.read_all())
             n = self.frontend.handle_row_insert(

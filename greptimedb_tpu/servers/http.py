@@ -197,8 +197,10 @@ class HttpServer:
                     body = await request.json()
                     if isinstance(body, dict) and name in body:
                         return str(body[name])
-                except Exception:
-                    pass
+                except ValueError:
+                    # malformed client JSON: fall through to "parameter
+                    # absent" — the handler's 400 names the parameter
+                    return None
         return None
 
     # ---- handlers ----
@@ -267,7 +269,8 @@ class HttpServer:
         loop = asyncio.get_running_loop()
         engine = self._script_engine()
         await loop.run_in_executor(
-            None, lambda: engine.insert_script(name, script, ctx))
+            None, self._traced_call(
+                request, lambda: engine.insert_script(name, script, ctx)))
         return web.json_response({"code": 0})
 
     async def handle_run_script(self, request):
@@ -280,7 +283,8 @@ class HttpServer:
         engine = self._script_engine()
         if name:
             out = await loop.run_in_executor(
-                None, lambda: engine.run(name, ctx=ctx))
+                None, self._traced_call(
+                    request, lambda: engine.run(name, ctx=ctx)))
         else:
             script = (await request.read()).decode()
             if not script:
@@ -289,8 +293,9 @@ class HttpServer:
                      "error": "missing 'name' parameter or script body"},
                     status=400)
             out = await loop.run_in_executor(
-                None, lambda: engine.run(script, ctx=ctx,
-                                         is_script_text=True))
+                None, self._traced_call(
+                    request, lambda: engine.run(script, ctx=ctx,
+                                                is_script_text=True)))
         return web.json_response({
             "code": 0,
             "output": [output_to_json(out)],
@@ -350,7 +355,8 @@ class HttpServer:
                     timestamp_column=tsdb_mod.GREPTIME_TIMESTAMP, ctx=ctx)
             return len(points)
 
-        n = await loop.run_in_executor(None, work)
+        n = await loop.run_in_executor(None,
+                                       self._traced_call(request, work))
         return web.json_response({"success": n, "failed": 0}, status=200)
 
     async def handle_prom_write(self, request):
@@ -381,7 +387,8 @@ class HttpServer:
                 results.append(self._remote_read_query(q, ctx))
             return prom_mod.encode_read_response(results)
 
-        payload = await loop.run_in_executor(None, work)
+        payload = await loop.run_in_executor(None,
+                                             self._traced_call(request, work))
         return web.Response(body=payload,
                             content_type="application/x-protobuf",
                             headers={"Content-Encoding": "snappy"})
@@ -474,7 +481,8 @@ class HttpServer:
                     regions.extend(
                         getattr(t, "regions", {}).values())
         except Exception:  # noqa: BLE001 — status must never 500
-            pass
+            from ..common.telemetry import increment_counter
+            increment_counter("status_partial")
         ingest = scan = None
         for r in regions:
             p = getattr(r, "last_ingest_profile", None)
@@ -521,7 +529,8 @@ class HttpServer:
                 if t is not None:
                     t.flush()
 
-        await loop.run_in_executor(None, work)
+        await loop.run_in_executor(None,
+                                   self._traced_call(request, work))
         return web.json_response({"code": 0})
 
     async def handle_compact(self, request):
@@ -538,7 +547,8 @@ class HttpServer:
                 for region in getattr(t, "regions", {}).values():
                     region.compact()
 
-        await loop.run_in_executor(None, work)
+        await loop.run_in_executor(None,
+                                   self._traced_call(request, work))
         return web.json_response({"code": 0})
 
     async def handle_failpoints(self, request):
@@ -644,7 +654,8 @@ class HttpServer:
             return total
 
         try:
-            rows = await loop.run_in_executor(None, work)
+            rows = await loop.run_in_executor(
+                None, self._traced_call(request, work))
         except Exception as e:  # noqa: BLE001 — surface as API error
             return web.json_response({"code": 1004, "error": str(e)},
                                      status=400)
@@ -673,8 +684,10 @@ class HttpServer:
 
     # ---- lifecycle (thread-hosted event loop) ----
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="http-server")
+        from ..common.runtime import new_thread
+        self._thread = new_thread(self._run, daemon=True,
+                                  name="http-server",
+                                  propagate_context=False)
         self._thread.start()
         if not self._started.wait(timeout=10):
             raise RuntimeError("http server failed to start")

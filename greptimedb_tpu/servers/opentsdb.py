@@ -100,7 +100,9 @@ class OpentsdbServer:
                         continue
                     try:
                         server_self._ingest_line(line)
-                    except Exception as e:  # noqa: BLE001 — answer as text
+                    # the error IS the response: telnet clients get the
+                    # first line back as text
+                    except Exception as e:  # greptlint: disable=GL01
                         msg = str(e).split("\n")[0][:200]
                         self.wfile.write(f"error: {msg}\n".encode())
 
@@ -123,8 +125,10 @@ class OpentsdbServer:
                 timestamp_column=GREPTIME_TIMESTAMP, ctx=ctx)
 
     def serve_in_background(self) -> threading.Thread:
-        self._thread = threading.Thread(target=self._tcp.serve_forever,
-                                        daemon=True, name="opentsdb-server")
+        from ..common.runtime import new_thread
+        self._thread = new_thread(self._tcp.serve_forever, daemon=True,
+                                  name="opentsdb-server",
+                                  propagate_context=False)
         self._thread.start()
         return self._thread
 

@@ -621,8 +621,10 @@ class PostgresServer:
         self._thread: Optional[threading.Thread] = None
 
     def serve_in_background(self) -> threading.Thread:
-        self._thread = threading.Thread(target=self._tcp.serve_forever,
-                                        daemon=True, name="postgres-server")
+        from ..common.runtime import new_thread
+        self._thread = new_thread(self._tcp.serve_forever, daemon=True,
+                                  name="postgres-server",
+                                  propagate_context=False)
         self._thread.start()
         return self._thread
 

@@ -17,6 +17,7 @@ from collections import OrderedDict
 from typing import List, Optional
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedLock
 from .object_store import ObjectStore
 
 _fp.register("cache_read")
@@ -29,7 +30,9 @@ class LruCacheLayer(ObjectStore):
         self.cache_dir = os.path.abspath(cache_dir)
         self.capacity_bytes = capacity_bytes
         os.makedirs(self.cache_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        # NOT io_ok=False: _admit/_evict write and unlink blob files
+        # while holding this lock (admission is serialized by design)
+        self._lock = TrackedLock("storage.cache")
         self._entries: "OrderedDict[str, int]" = OrderedDict()  # key→bytes
         self._size = 0
         self.hits = 0
@@ -63,9 +66,11 @@ class LruCacheLayer(ObjectStore):
         path = self._cache_path(key)
         with self._lock:
             if key not in self._entries:
-                with open(path + ".tmp", "wb") as f:
-                    f.write(data)
-                os.replace(path + ".tmp", path)
+                from ..utils import atomic_write
+                # no fsync: a torn cache blob after a crash is re-fetched
+                # from the backing store, but a HALF-torn one must never
+                # be readable, hence the atomic publish
+                atomic_write(path, data, fsync=False)
                 with open(path + ".key", "w") as f:
                     f.write(key)
                 self._entries[key] = len(data)

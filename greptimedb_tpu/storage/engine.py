@@ -12,6 +12,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ..common.locks import TrackedLock
 from ..datatypes import Schema
 from ..errors import RegionNotFoundError
 from .object_store import FsObjectStore, ObjectStore
@@ -59,7 +60,7 @@ class StorageEngine:
         self.store = store
         self.wal_home = os.path.join(config.data_home, "wal")
         self._regions: Dict[str, Region] = {}
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.engine")
         self.scheduler = LocalScheduler(max_inflight=config.bg_workers,
                                         name="storage-bg")
         self.purger = FilePurger(grace_s=config.purge_grace_s)

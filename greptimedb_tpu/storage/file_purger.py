@@ -16,6 +16,7 @@ import time
 from typing import Callable, List, Tuple
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedLock
 
 logger = logging.getLogger(__name__)
 
@@ -29,7 +30,7 @@ _RETRY_BACKOFF_S = (5.0, 30.0, 120.0)
 class FilePurger:
     def __init__(self, grace_s: float = 60.0):
         self.grace_s = grace_s
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.purger", io_ok=False)
         # (due_time, delete_fn, name, attempt)
         self._pending: List[Tuple[float, Callable[[], None], str, int]] = []
 

@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedLock
 from .object_store import ObjectStore
 
 _fp.register("manifest_commit")
@@ -35,7 +36,7 @@ class RegionManifest:
         self.store = store
         self.dir = manifest_dir.rstrip("/")
         self.checkpoint_margin = checkpoint_margin
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.manifest")
         self._version = -1           # last written version
         self._actions_since_ckpt = 0
 

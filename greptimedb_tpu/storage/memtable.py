@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common.locks import TrackedLock
 from ..datatypes import RecordBatch, Schema
 from .series import SeriesDict
 from .write_batch import OP_DELETE, OP_PUT, WriteBatch
@@ -67,7 +68,7 @@ class Memtable:
         self.series_dict = series_dict
         Memtable._next_id += 1
         self.id = Memtable._next_id
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.memtable", io_ok=False)
         self._series = _GrowBuf(np.int32)
         self._ts = _GrowBuf(np.int64)
         self._seq = _GrowBuf(np.int64)

@@ -44,7 +44,8 @@ def _build() -> Optional[str]:
            "-o", _LIB + ".tmp", _SRC, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_LIB + ".tmp", _LIB)
+        from ..utils import atomic_publish
+        atomic_publish(_LIB + ".tmp", _LIB, fsync=False)  # build artifact
         return _LIB
     except (subprocess.SubprocessError, OSError) as e:
         logger.warning("native WAL build failed (%s); using Python WAL", e)
@@ -171,7 +172,8 @@ class NativeWal(Wal):
     def __del__(self):  # pragma: no cover
         try:
             self.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # greptlint: disable=GL01 — finalizers must
+            # never raise; at interpreter teardown even logging can fail
             pass
 
 

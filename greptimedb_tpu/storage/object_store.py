@@ -16,6 +16,7 @@ import threading
 from typing import List, Optional
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedLock
 
 _fp.register("objstore_read")
 _fp.register("objstore_write")
@@ -95,14 +96,9 @@ class _FsPut:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is None:
-            try:
-                with open(self._tmp, "rb+") as f:
-                    os.fsync(f.fileno())
-                os.replace(self._tmp, self._path)
-                return
-            except BaseException:
-                self._unlink_tmp()           # no orphaned spool files
-                raise
+            from ..utils import atomic_publish
+            atomic_publish(self._tmp, self._path)  # unlinks tmp on failure
+            return
         self._unlink_tmp()
 
     def _unlink_tmp(self) -> None:
@@ -116,7 +112,7 @@ class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.objstore")
 
     def _path(self, key: str) -> str:
         p = os.path.normpath(os.path.join(self.root, key))

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedRLock
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
 from ..datatypes.vector import compat_column, null_column
@@ -352,7 +353,7 @@ class Region:
         # bumped whenever committed data is *retracted* (TTL expiry) rather
         # than superseded — incremental scan caches must rebuild then
         self.retraction_epoch = 0
-        self._writer_lock = threading.RLock()
+        self._writer_lock = TrackedRLock("storage.region_writer")
         if wal is not None:
             self.wal = wal
         else:

@@ -13,6 +13,8 @@ from __future__ import annotations
 import logging
 import threading
 from collections import OrderedDict
+
+from ..common.locks import TrackedLock
 from typing import Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -56,7 +58,7 @@ class LocalScheduler:
     def __init__(self, max_inflight: int = 4, name: str = "bg"):
         self.max_inflight = max(1, max_inflight)
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.scheduler", io_ok=False)
         self._queue: "OrderedDict[str, tuple]" = OrderedDict()
         self._running: Dict[str, bool] = {}
         self._workers: list = []
@@ -71,7 +73,9 @@ class LocalScheduler:
     def submit(self, key: str, fn: Callable[[], object]) -> JobHandle:
         with self._lock:
             if self._stopped:
-                raise RuntimeError(f"scheduler {self.name} stopped")
+                from ..errors import SchedulerStoppedError
+                raise SchedulerStoppedError(
+                    f"scheduler {self.name} stopped")
             if key in self._queue:
                 return self._queue[key][1]        # coalesce
             handle = JobHandle()
@@ -110,7 +114,10 @@ class LocalScheduler:
             try:
                 result = fn()
                 handle._finish(result)
-            except BaseException as e:  # noqa: BLE001
+            # a SimulatedCrash lands in handle.wait(), which re-raises it
+            # in the waiter — delivery, not survival (and the bg retry
+            # path counts it via _finish)
+            except BaseException as e:  # greptlint: disable=GL02
                 logger.exception("%s job %s failed", self.name, key)
                 handle._finish(error=e)
             finally:
@@ -122,8 +129,10 @@ class LocalScheduler:
         with self._lock:
             self._stopped = True
             if not drain:
+                from ..errors import SchedulerStoppedError
                 for _, handle in self._queue.values():
-                    handle._finish(error=RuntimeError("scheduler stopped"))
+                    handle._finish(
+                        error=SchedulerStoppedError("scheduler stopped"))
                 self._queue.clear()
             self._wake.notify_all()
         for t in self._workers:

@@ -11,6 +11,7 @@ import threading
 from dataclasses import dataclass, replace
 from typing import List, Optional, Sequence
 
+from ..common.locks import TrackedLock
 from ..datatypes import Schema
 from .memtable import Memtable, MemtableVersion
 from .series import SeriesDict
@@ -28,7 +29,7 @@ class Version:
 
 class VersionControl:
     def __init__(self, version: Version, committed_sequence: int = 0):
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.version", io_ok=False)
         self._current = version
         self._committed_sequence = committed_sequence
 

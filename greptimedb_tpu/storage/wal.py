@@ -21,6 +21,7 @@ import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from ..common import failpoint as _fp
+from ..common.locks import TrackedLock
 from ..errors import StorageError
 
 logger = logging.getLogger(__name__)
@@ -43,7 +44,7 @@ class Wal:
         self.sync_on_write = sync_on_write
         self.segment_bytes = segment_bytes or self.SEGMENT_BYTES
         os.makedirs(self.dir, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.wal")
         self._fh = None
         self._fh_path: Optional[str] = None
         self._fh_size = 0
@@ -221,7 +222,7 @@ class NoopWal(Wal):
     """WAL-less mode for tests/benchmarks (reference: src/log-store/src/noop.rs)."""
 
     def __init__(self):  # noqa: super-init-not-called
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("storage.wal")
 
     def append(self, seq, payload, schema_version=0):
         pass

@@ -6,7 +6,7 @@ import os
 import tempfile
 
 
-def atomic_write(path: str, data, *, fsync: bool = True,
+def atomic_write(path: str, data: "bytes | str", *, fsync: bool = True,
                  tmp_prefix: str = ".tmp-") -> None:
     """Write `data` (bytes or str) to `path` atomically: temp file in the
     same directory, optional fsync, rename. A crash at any point leaves
@@ -28,6 +28,26 @@ def atomic_write(path: str, data, *, fsync: bool = True,
     except BaseException:
         try:
             os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_publish(tmp_path: str, path: str, *, fsync: bool = True) -> None:
+    """Publish an ALREADY-WRITTEN temp file to its final name atomically:
+    the streaming/subprocess twin of :func:`atomic_write`, for bytes
+    produced by someone else (a compiler, a spooled upload stream).
+    Optionally fsyncs the temp file, renames it into place, and unlinks
+    the temp on failure — same guarantees, same single implementation
+    (greptlint GL03 allows renames only here)."""
+    try:
+        if fsync:
+            with open(tmp_path, "rb+") as f:
+                os.fsync(f.fileno())
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
         except OSError:
             pass
         raise

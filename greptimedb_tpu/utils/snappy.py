@@ -15,7 +15,7 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 _logger = logging.getLogger(__name__)
 
@@ -42,7 +42,9 @@ def _load() -> Optional[ctypes.CDLL]:
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      "-o", _LIB_PATH + ".tmp", _SRC],
                     check=True, capture_output=True, timeout=120)
-                os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+                from . import atomic_publish
+                atomic_publish(_LIB_PATH + ".tmp", _LIB_PATH,
+                               fsync=False)   # build artifact
             lib = ctypes.CDLL(_LIB_PATH)
             lib.snappy_max_compressed.restype = ctypes.c_uint64
             lib.snappy_max_compressed.argtypes = [ctypes.c_uint64]
@@ -64,7 +66,7 @@ def _load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
-def _read_varint(data: bytes, pos: int):
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0
     while True:

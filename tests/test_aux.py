@@ -119,6 +119,30 @@ class TestTelemetry:
         assert key1 in _histograms and key2 in _histograms
         assert _histograms[key1] is not _histograms[key2]
 
+    def test_metric_sanitize_is_thread_safe(self):
+        """Regression (greptlint GL08): _sanitize mutated the module
+        _sanitized_owners dict outside _metrics_lock although every
+        caller takes that lock for the registries — two threads
+        first-time-sanitizing colliding names could disagree on the
+        owner. Hammer it and assert one stable mapping."""
+        import concurrent.futures
+        from greptimedb_tpu.common.telemetry import (_sanitize,
+                                                     _sanitized_owners)
+        names = [f"race.m{i}" for i in range(8)] + \
+                [f"race-m{i}" for i in range(8)]   # 8 colliding pairs
+
+        def worker(_):
+            return {n: _sanitize(n) for n in names}
+
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            results = list(pool.map(worker, range(16)))
+        first = results[0]
+        assert all(r == first for r in results[1:]), \
+            "threads disagree on sanitized metric keys"
+        assert len(set(first.values())) == len(names)  # no shared series
+        for name, key in first.items():
+            assert _sanitized_owners[key] == name
+
     def test_slow_query_threshold_set_get(self):
         from greptimedb_tpu.common.telemetry import (
             set_slow_query_threshold_ms, slow_query_threshold_ms)
@@ -339,6 +363,9 @@ class TestTls:
     def test_postgres_tls_upgrade(self, fe, tmp_path):
         """PG SSLRequest → 'S' → TLS handshake → normal query flow
         (reference: tls.rs + postgres startup)."""
+        pytest.importorskip(
+            "cryptography",
+            reason="self-signed cert generation needs cryptography")
         from greptimedb_tpu.servers.postgres import PostgresServer
         cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
         make_self_signed(cert, key)

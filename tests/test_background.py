@@ -107,6 +107,22 @@ class TestScheduler:
             h.wait(5)
         s.stop()
 
+    def test_submit_after_stop_raises_taxonomy_error(self):
+        """Regression (greptlint GL05): the stopped-scheduler rejection
+        used to be a bare RuntimeError, invisible to the errors.*
+        taxonomy; SchedulerStoppedError keeps RuntimeError compat for
+        the shutdown paths that catch it."""
+        from greptimedb_tpu.errors import (GreptimeError,
+                                           SchedulerStoppedError,
+                                           StorageError)
+        s = LocalScheduler(max_inflight=1)
+        s.stop()
+        with pytest.raises(SchedulerStoppedError) as ei:
+            s.submit("k", lambda: None)
+        assert isinstance(ei.value, StorageError)
+        assert isinstance(ei.value, GreptimeError)
+        assert isinstance(ei.value, RuntimeError)   # legacy catch sites
+
     def test_stop_drains(self):
         s = LocalScheduler(max_inflight=1)
         out = []

@@ -184,3 +184,50 @@ class TestDatabaseClient:
         assert n == 2
         batches = db.sql("SELECT count(val) AS n FROM autotab")
         assert next(batches[0].rows())[0] == 2
+
+
+class TestDoPutTracePropagation:
+    """Regression (greptlint GL07): client/flight._put has always sent
+    the caller's traceparent inside the descriptor command, but the
+    server's do_put dropped it — bulk writes detached from the client's
+    trace while queries (do_get) joined it."""
+
+    def test_do_put_joins_client_trace(self, tmp_path):
+        from greptimedb_tpu.common import telemetry
+        from greptimedb_tpu.frontend.instance import FrontendInstance
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "data"),
+            register_numbers_table=False))
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        srv = FlightFrontendServer(fe)
+        srv.serve_in_background()
+        _wait_port(srv)
+        db = Database(srv.address)
+        try:
+            server_side = []
+            orig = fe.handle_row_insert
+
+            def spy(*args, **kwargs):
+                # runs on the Flight handler thread: what trace is live?
+                server_side.append(telemetry.current_traceparent())
+                return orig(*args, **kwargs)
+
+            fe.handle_row_insert = spy
+            with telemetry.span("client-bulk-write"):
+                client_tp = telemetry.current_traceparent()
+                n = db.insert(
+                    "traced_tab",
+                    {"host": ["a"], "greptime_timestamp": [1],
+                     "val": [1.0]}, tag_columns=["host"])
+            assert n == 1
+            assert server_side and server_side[0] is not None, \
+                "do_put handler ran without a trace context"
+            client_trace = telemetry.parse_traceparent(client_tp)[0]
+            server_trace = telemetry.parse_traceparent(server_side[0])[0]
+            assert server_trace == client_trace
+        finally:
+            db.close()
+            srv.shutdown()
+            fe.shutdown()
