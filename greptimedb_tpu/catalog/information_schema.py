@@ -71,6 +71,33 @@ _CLUSTER_INFO_SCHEMA = Schema([
     ColumnSchema("region_stats", dt.STRING),
 ])
 
+_PROCESSES_SCHEMA = Schema([
+    ColumnSchema("id", dt.INT64),
+    ColumnSchema("node", dt.STRING),
+    ColumnSchema("catalog", dt.STRING),
+    ColumnSchema("schema", dt.STRING),
+    ColumnSchema("query", dt.STRING),
+    ColumnSchema("protocol", dt.STRING),
+    ColumnSchema("state", dt.STRING),
+    ColumnSchema("trace_id", dt.STRING),
+    ColumnSchema("elapsed_ms", dt.FLOAT64),
+    ColumnSchema("rows_scanned", dt.INT64),
+    ColumnSchema("bytes_read", dt.INT64),
+    ColumnSchema("rpcs", dt.INT64),
+])
+
+_SELF_MONITOR_SCHEMA = Schema([
+    ColumnSchema("node", dt.STRING),
+    ColumnSchema("ticks", dt.INT64),
+    ColumnSchema("metric_rows", dt.INT64),
+    ColumnSchema("heat_rows", dt.INT64),
+    ColumnSchema("rows_written", dt.INT64),
+    ColumnSchema("retention_deleted", dt.INT64),
+    ColumnSchema("retention_ms", dt.INT64),
+    ColumnSchema("last_tick_ms", dt.FLOAT64),
+    ColumnSchema("last_error", dt.STRING, nullable=True),
+])
+
 _FLOWS_SCHEMA = Schema([
     ColumnSchema("flow_name", dt.STRING),
     ColumnSchema("source_table", dt.STRING),
@@ -149,27 +176,20 @@ def _engine_gauges(catalog_manager, catalog_name: str):
 def _collect_families():
     """One walk of the default Prometheus registry, shared by the raw
     sample rows and the pXX summaries (the registry grows with statement
-    kinds × protocols × routes — don't materialize it twice per query)."""
-    try:
-        from prometheus_client import REGISTRY
-    except ImportError:  # pragma: no cover — prometheus is baked in
-        return []
-    return list(REGISTRY.collect())
+    kinds × protocols × routes — don't materialize it twice per query).
+    Delegates to the telemetry helper so this view, /metrics and the
+    self-monitoring scraper read the SAME walk and label formatting —
+    greptime_private.node_metrics can never diverge from
+    runtime_metrics."""
+    from ..common.telemetry import collect_families
+    return collect_families()
 
 
 def _prometheus_samples(families=None):
     """Every sample the /metrics endpoint would render, via the same
     default registry prometheus_client.generate_latest reads."""
-    if families is None:
-        families = _collect_families()
-    rows = []
-    for family in families:
-        for s in family.samples:
-            labels = "{" + ", ".join(
-                f'{k}="{v}"' for k, v in sorted(s.labels.items())) + "}" \
-                if s.labels else ""
-            rows.append((s.name, labels, float(s.value), family.type))
-    return rows
+    from ..common.telemetry import registry_snapshot
+    return registry_snapshot(families)
 
 
 def _latency_summary_rows(families=None):
@@ -334,6 +354,26 @@ def information_schema_table(catalog_manager, catalog_name: str,
             return rows
         return _VirtualTable("cluster_info", _CLUSTER_INFO_SCHEMA,
                              build_cluster_info)
+    if name == "processes":
+        def build_processes():
+            from ..common import process_list
+            rows = {k: [] for k in _PROCESSES_SCHEMA.names()}
+            for r in process_list.REGISTRY.rows():
+                for k in rows:
+                    rows[k].append(r.get(k))
+            return rows
+        return _VirtualTable("processes", _PROCESSES_SCHEMA,
+                             build_processes)
+    if name == "self_monitor":
+        def build_self_monitor():
+            rows = {k: [] for k in _SELF_MONITOR_SCHEMA.names()}
+            mon = getattr(catalog_manager, "self_monitor", None)
+            if mon is not None:
+                for k, v in mon.row().items():
+                    rows[k].append(v)
+            return rows
+        return _VirtualTable("self_monitor", _SELF_MONITOR_SCHEMA,
+                             build_self_monitor)
     if name == "runtime_metrics":
         def build_metrics():
             families = _collect_families()

@@ -332,6 +332,12 @@ def frontend_start(args) -> None:
     meta = _meta_client(args.metasrv_addr)
     clients = PeerClientRegistry(meta)
     fe = DistInstance(meta, clients)
+    # self-monitoring scrape loop: frontend registry + cluster-wide
+    # region heat (meta heartbeats) → greptime_private tables
+    from ..common.runtime import env_int
+    monitor_interval = env_int("GREPTIME_SELF_MONITOR_INTERVAL_S", 30)
+    if monitor_interval > 0:
+        fe.self_monitor.start_background(monitor_interval)
     servers = [HttpServer(fe, NoopUserProvider(), args.http_addr)]
     if args.mysql_addr:
         from ..servers.mysql import MysqlServer
@@ -352,6 +358,7 @@ def frontend_start(args) -> None:
                  args.metasrv_addr)
 
     def shutdown():
+        fe.self_monitor.stop()
         for s in servers:
             s.shutdown()
         meta.close()

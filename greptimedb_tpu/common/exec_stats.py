@@ -157,6 +157,58 @@ class ExecStats:
         with self._lock:
             self.remote_total_ms += float(d.get("total_ms", 0.0))
 
+    #: stages whose `rows` mean "rows scanned from storage". The three
+    #: are mutually exclusive per region (cpu fallback / resident /
+    #: streamed), so summing them never double-counts; `decode` is a
+    #: sub-stage of stream_scan and stays out.
+    _SCAN_STAGES = frozenset({"scan", "scan_prep", "stream_scan"})
+
+    def totals(self) -> Dict[str, int]:
+        """Running resource totals for the process list: rows scanned,
+        bytes read off storage, datanode RPCs consumed. Accumulates as
+        stages record — a live query reports its progress so far, not
+        just a final number — and folds per-node sub-collectors in (a
+        distributed scan's rows live on the node blocks)."""
+        resident = streamed = streamed_live = 0
+        io_bytes = decode_bytes = rpcs = 0
+        with self._lock:
+            for st in self.stages.values():
+                if st.stage == "stream_scan":
+                    streamed += st.rows
+                elif st.stage in self._SCAN_STAGES:
+                    resident += st.rows
+                if st.stage == "io_read":
+                    io_bytes += int(st.detail.get("bytes", 0))
+                if st.stage == "decode":
+                    # stream_rows = the streamed share of the decode
+                    # rows (the lean reader tags them; the resident
+                    # path's read_sst decode rows carry no tag and are
+                    # already counted by scan/scan_prep)
+                    streamed_live = int(st.detail.get("stream_rows", 0))
+                    decode_bytes += int(st.detail.get("bytes", 0))
+                rpcs += int(st.detail.get("rpcs", 0))
+            nodes = [entry["stats"] for entry in self.nodes.values()]
+        # while a streamed scan RUNS, its rows land on `decode` slice by
+        # slice and `stream_scan` is only published at the end — the
+        # live floor makes a long scan's progress visible in the
+        # processes view instead of reading 0 until it finishes, and a
+        # mixed resident+cold statement keeps counting its resident
+        # rows while the cold region streams
+        rows = resident + max(streamed, streamed_live)
+        # io_read (object-store bytes) and decode (decoded batch bytes)
+        # describe the SAME data at two stages — summing both would
+        # double-bill a cold scan. Prefer the storage-side number;
+        # decoded bytes stand in for cache-resident scans that never
+        # touch the store.
+        bytes_read = io_bytes if io_bytes else decode_bytes
+        for ns in nodes:
+            sub = ns.totals()
+            rows += sub["rows_scanned"]
+            bytes_read += sub["bytes_read"]
+            rpcs += sub["rpcs"]
+        return {"rows_scanned": rows, "bytes_read": bytes_read,
+                "rpcs": rpcs}
+
     def node_elapsed_ms(self, wall_ms: float = 0.0) -> float:
         """The node-side share of a sub-collector: the remote-reported
         total when the stats crossed a wire; for an in-process RPC the
@@ -278,6 +330,13 @@ def collect(stats: Optional[ExecStats] = None) -> Iterator[ExecStats]:
     prev = getattr(_tls, "stats", None)
     s = stats if stats is not None else ExecStats()
     _tls.stats = s
+    # publish to the process-list entry (if this statement is tracked):
+    # the processes view reads live rows-scanned/bytes/RPC totals off
+    # the collector WHILE the query runs
+    from . import process_list as _pl
+    entry = _pl.current()
+    if entry is not None and entry.stats is None:
+        entry.stats = s
     t0 = time.perf_counter()
     try:
         yield s

@@ -128,7 +128,7 @@ def span(name: str, **attrs: object) -> Iterator[Dict]:
                      elapsed_ms, attrs)
         _observe(f"span_{name}", elapsed_ms / 1e3)
         exporter = _OTLP[0]
-        if exporter is not None:
+        if exporter is not None and not metrics_suppressed():
             exporter.enqueue(s, int(elapsed_ms * 1e6))
 
 
@@ -148,11 +148,20 @@ def propagate(fn: Callable) -> Callable:
     for the same reason: per-stage EXPLAIN ANALYZE counters recorded by
     pool workers (SST reads, slice decodes) land on the query's
     collector instead of vanishing. ExecStats methods are lock-guarded,
-    so concurrent workers may share one collector."""
+    so concurrent workers may share one collector.
+
+    The active process-list entry (common/process_list.py) and the
+    metric-suppression flag travel too: a KILL must be observable from
+    a prefetch worker's cancellation check, and the self-monitoring
+    scraper's pooled writes must stay excluded from the counters it
+    scrapes."""
     from . import exec_stats as _es
+    from . import process_list as _pl
     stack = getattr(_tls, "spans", None)
     stats = _es.current()
-    if not stack and stats is None:
+    entry = _pl.current()
+    suppressed = metrics_suppressed()
+    if not stack and stats is None and entry is None and not suppressed:
         return fn
     captured = list(stack) if stack else []
     import functools
@@ -160,12 +169,15 @@ def propagate(fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):  # type: ignore[no-untyped-def]
         prev = getattr(_tls, "spans", None)
+        prev_sup = getattr(_tls, "suppress_metrics", False)
         _tls.spans = list(captured)
-        with _es.collect_into(stats):
+        _tls.suppress_metrics = suppressed
+        with _es.collect_into(stats), _pl.install(entry):
             try:
                 return fn(*args, **kwargs)
             finally:
                 _tls.spans = prev if prev is not None else []
+                _tls.suppress_metrics = prev_sup
     return wrapped
 
 
@@ -386,6 +398,33 @@ def configure_otlp(endpoint: Optional[str],
 
 
 # ---------------------------------------------------------------------------
+# metric suppression (self-monitoring recursion guard)
+# ---------------------------------------------------------------------------
+
+def metrics_suppressed() -> bool:
+    return getattr(_tls, "suppress_metrics", False)
+
+
+@contextlib.contextmanager
+def suppress_metrics() -> Iterator[None]:
+    """Make every metric observation on this thread a no-op for the
+    duration (timers, counters, latency histograms, OTLP span export).
+
+    The self-monitoring scraper writes its registry snapshot through the
+    NORMAL ingest path; without this guard those writes would bump the
+    very counters the next tick scrapes (stmt/ingest/WAL counters), so
+    an idle cluster's metrics would grow forever from the act of
+    recording them. propagate() carries the flag into pool workers, so
+    the exclusion covers fanned-out parts of a system-table write too."""
+    prev = getattr(_tls, "suppress_metrics", False)
+    _tls.suppress_metrics = True
+    try:
+        yield
+    finally:
+        _tls.suppress_metrics = prev
+
+
+# ---------------------------------------------------------------------------
 # timer metrics (prometheus registry shared with /metrics)
 # ---------------------------------------------------------------------------
 
@@ -424,6 +463,8 @@ def _sanitize(name: str) -> str:
 
 
 def _observe(name: str, seconds: float) -> None:
+    if metrics_suppressed():
+        return
     try:
         from prometheus_client import Histogram
     except ImportError:  # pragma: no cover
@@ -438,6 +479,8 @@ def _observe(name: str, seconds: float) -> None:
 
 
 def increment_counter(name: str, value: int = 1) -> None:
+    if metrics_suppressed():
+        return
     try:
         from prometheus_client import Counter
     except ImportError:  # pragma: no cover
@@ -485,6 +528,8 @@ def observe_latency(name: str, seconds: float,
     `greptime_<name>_seconds{**labels}`. Label NAMES must be stable per
     metric (prometheus fixes them at creation); a mismatched call is
     dropped with an error instead of raising on a hot path."""
+    if metrics_suppressed():
+        return
     try:
         from prometheus_client import Histogram
     except ImportError:  # pragma: no cover
@@ -526,6 +571,40 @@ def observe_latency(name: str, seconds: float,
                          labelnames, created_names)
         return
     (h.labels(**labels) if labelnames else h).observe(float(seconds))
+
+
+# ---------------------------------------------------------------------------
+# registry snapshot (the ONE reader behind /metrics-equivalent views:
+# information_schema.runtime_metrics and the self-monitoring scraper both
+# consume this, so what lands in greptime_private.node_metrics is exactly
+# what the endpoint would have served at that instant)
+# ---------------------------------------------------------------------------
+
+def collect_families() -> list:
+    """One walk of the default Prometheus registry (the same registry
+    prometheus_client.generate_latest serves on /metrics)."""
+    try:
+        from prometheus_client import REGISTRY
+    except ImportError:  # pragma: no cover — prometheus is baked in
+        return []
+    return list(REGISTRY.collect())
+
+
+def registry_snapshot(families: Optional[list] = None
+                      ) -> List[Tuple[str, str, float, str]]:
+    """Every sample in the registry as (name, labels_str, value, kind)
+    rows. Pass pre-collected `families` to share one registry walk with
+    other consumers (runtime_metrics reuses it for the pXX rows)."""
+    if families is None:
+        families = collect_families()
+    rows = []
+    for family in families:
+        for s in family.samples:
+            labels = "{" + ", ".join(
+                f'{k}="{v}"' for k, v in sorted(s.labels.items())) + "}" \
+                if s.labels else ""
+            rows.append((s.name, labels, float(s.value), family.type))
+    return rows
 
 
 def latency_summaries(quantiles: Sequence[float] = (0.5, 0.95, 0.99),

@@ -33,6 +33,12 @@ class DatanodeOptions:
     #: never started under pytest (tests drive FlowManager.tick()
     #: cooperatively — tier-1 safety), and 0 disables it everywhere
     flow_tick_interval_s: float = 10.0
+    #: self-monitoring scrape cadence (metrics + region heat →
+    #: greptime_private system tables); same pytest/0 rules as the flow
+    #: tick. 30s keeps the history fine-grained enough for the region
+    #: split/migrate decisions ROADMAP item 1 needs without measurable
+    #: ingest overhead (<3%, see bench.py self_monitoring_overhead)
+    self_monitor_interval_s: float = 30.0
 
 
 class DatanodeInstance:

@@ -427,8 +427,65 @@ class UnlockedModuleMutation(Rule):
                 f"declares a module lock for its shared state")
 
 
+class AdhocMetricObject(Rule):
+    id = "GL09"
+    title = ("prometheus metric objects constructed outside "
+             "common/telemetry helpers: the self-monitoring scraper and "
+             "runtime_metrics only see the shared registry walk — a "
+             "bespoke Counter/Gauge/Histogram also dodges the "
+             "suppress_metrics recursion guard and the name-collision "
+             "sanitizer")
+
+    EXEMPT = ("common/telemetry.py",)
+    METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram", "Summary",
+                              "Info", "Enum"})
+
+    def _prometheus_bindings(self, mod: ModuleInfo
+                             ) -> Tuple[Set[str], Set[str]]:
+        """(metric names, module aliases) bound from prometheus_client
+        in this module (module level or inside functions — telemetry
+        itself imports lazily), so a bare `Counter(...)` from
+        collections never false-positives and `import prometheus_client
+        as pc; pc.Counter(...)` doesn't dodge the rule (the GL04
+        aliased-import lesson)."""
+        names: Set[str] = set()
+        modules: Set[str] = {"prometheus_client"}
+        for imp in mod.nodes(ast.ImportFrom):
+            if imp.module and imp.module.split(".")[0] == \
+                    "prometheus_client":
+                for alias in imp.names:
+                    if alias.name in self.METRIC_TYPES:
+                        names.add(alias.asname or alias.name)
+        for imp in mod.nodes(ast.Import):
+            for alias in imp.names:
+                if alias.name.split(".")[0] == "prometheus_client":
+                    modules.add(alias.asname or alias.name.split(".")[0])
+        return names, modules
+
+    def check(self, mod, ctx):
+        if _is_module(mod.rel, self.EXEMPT):
+            return
+        bound, modules = self._prometheus_bindings(mod)
+        for call in mod.nodes(ast.Call):
+            d = _dotted(call.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            is_metric = (len(parts) == 2 and parts[0] in modules
+                         and parts[1] in self.METRIC_TYPES) \
+                or d in bound
+            if not is_metric:
+                continue
+            yield mod.finding(
+                self.id, call,
+                f"ad-hoc metric object {d}() — use common.telemetry "
+                f"helpers (increment_counter / timer / observe_latency) "
+                f"so the metric lands in the shared registry the "
+                f"scraper, /metrics and runtime_metrics all read")
+
+
 ALL_RULES: List[Rule] = [
     SwallowedException(), BaseExceptionCaught(), BareRename(),
     UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
-    UntracedHandler(), UnlockedModuleMutation(),
+    UntracedHandler(), UnlockedModuleMutation(), AdhocMetricObject(),
 ]

@@ -156,3 +156,12 @@ class TransientRpcError(GreptimeError):
     failpoint-injected faults."""
 
     status_code = StatusCode.STORAGE_UNAVAILABLE
+
+
+class QueryCancelledError(GreptimeError):
+    """The statement was killed (`KILL <id>`): cooperative cancellation
+    fired at a batch boundary in the streamed scan / scatter-gather
+    loops. NOT transient — a retry would re-run the work the operator
+    just killed."""
+
+    status_code = StatusCode.ENGINE_EXECUTE_QUERY

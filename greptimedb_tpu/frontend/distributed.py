@@ -299,11 +299,18 @@ class DistTable(Table):
             observe_latency("dist_rpc_hop", wall_ms / 1e3, what=what)
             return res, wall_ms, label, holder["stats"]
 
+        from ..common import process_list
         for res, wall_ms, label, stats in runtime.parallel_imap(
                 one, targets, max_workers=runtime.dist_fanout(),
                 pool=runtime.dist_runtime()):
+            # cooperative KILL at the gather boundary: raising here
+            # closes the bounded gather, whose finally cancels every
+            # queued RPC — a killed fan-out frees its dist-pool slots
+            # instead of orphaning futures
+            process_list.check_cancelled()
             if parent is not None and stats is not None:
                 parent.record_node(label, stats, wall_ms)
+                parent.record("dist_scatter", rpcs=1)
             if node_ms is not None:
                 node_ms.append((label, wall_ms))
             yield res, wall_ms
@@ -360,7 +367,7 @@ class DistTable(Table):
             pool=runtime.dist_runtime()))
         if len(tasks) > 1:
             exec_stats.record("dist_write", rows=written,
-                              fan_out=len(tasks))
+                              fan_out=len(tasks), rpcs=len(tasks))
         return written
 
     def _first_region(self) -> int:
@@ -534,6 +541,18 @@ class DistInstance:
         self.flow_manager.recover()
         self.query_engine.flow_manager = self.flow_manager
         self.catalog.flow_manager = self.flow_manager
+        # self-monitoring: the frontend scrapes its own registry plus the
+        # meta service's cluster-wide region heat (heartbeat-derived)
+        # into greptime_private tables, written through the normal
+        # distributed ingest path. Background ticking is opt-in
+        # (self_monitor.start_background) — cmd/main wires it; tests
+        # drive tick() cooperatively.
+        from ..common import process_list
+        from ..monitor import SelfMonitor
+        self.self_monitor = SelfMonitor(self, node_label="frontend",
+                                        meta=meta)
+        self.catalog.self_monitor = self.self_monitor
+        process_list.configure_node("frontend")
 
     def _create_flow_sink(self, spec, schema, pk_indices):
         """Materialize a flow sink as an ordinary distributed table."""
@@ -818,6 +837,7 @@ class DistInstance:
     def do_query(self, sql: str, ctx: Optional[QueryContext] = None):
         import time as _time
 
+        from ..common import process_list
         from ..common.telemetry import (
             increment_counter, observe_latency, slow_query_threshold_ms,
             span, timer)
@@ -830,7 +850,12 @@ class DistInstance:
                                  None)
             try:
                 with span("execute_stmt", stmt=type(stmt).__name__,
-                          distributed=True) as sp, timer("stmt_execute"):
+                          distributed=True) as sp, timer("stmt_execute"), \
+                        process_list.track(
+                            sql, protocol=ctx.channel.value,
+                            catalog=ctx.current_catalog,
+                            schema=ctx.current_schema,
+                            trace_id=sp["trace_id"]):
                     outs.append(self.execute_stmt(stmt, ctx))
             finally:
                 # finally: failing statements must count in the
@@ -884,6 +909,9 @@ class DistInstance:
             # work on a cluster router too — one shared handler
             from .statement import apply_set_variable
             return apply_set_variable(stmt, ctx)
+        if isinstance(stmt, ast.Kill):
+            from .statement import apply_kill
+            return apply_kill(stmt)
         return self.query_engine.execute(stmt, ctx)
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):

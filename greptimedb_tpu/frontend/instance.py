@@ -45,6 +45,14 @@ class FrontendInstance:
         self.script_engine = None
         from ..common.plugins import Plugins
         self.plugins = Plugins()
+        # self-monitoring: the scraper walks the telemetry registry +
+        # per-region heat and writes both through handle_row_insert into
+        # greptime_private system tables (monitor/scraper.py)
+        from ..common import process_list
+        from ..monitor import SelfMonitor
+        self.self_monitor = SelfMonitor(self, node_label="standalone")
+        self.catalog.self_monitor = self.self_monitor
+        process_list.configure_node("standalone")
 
     def start(self) -> None:
         if not self.datanode._started:
@@ -54,8 +62,16 @@ class FrontendInstance:
         from ..script import ScriptEngine
         self.script_engine = ScriptEngine(self)
         self.script_engine.load_scripts()
+        # free-running scrape tick only outside pytest (tests drive
+        # tick() cooperatively — the same tier-1 rule flows follow)
+        import os as _os
+        interval = getattr(self.datanode.opts,
+                           "self_monitor_interval_s", 0)
+        if interval > 0 and "PYTEST_CURRENT_TEST" not in _os.environ:
+            self.self_monitor.start_background(interval)
 
     def shutdown(self) -> None:
+        self.self_monitor.stop()
         self.datanode.shutdown()
 
     # ---- SqlQueryHandler ----
@@ -70,6 +86,7 @@ class FrontendInstance:
             stmts = interceptor.post_parsing(stmts, ctx)
         import time as _time
 
+        from ..common import process_list
         from ..common.telemetry import (
             increment_counter, observe_latency, slow_query_threshold_ms,
             span, timer)
@@ -83,7 +100,12 @@ class FrontendInstance:
             try:
                 with span("execute_stmt", stmt=type(s).__name__,
                           channel=ctx.channel.value) as sp, \
-                        timer("stmt_execute"):
+                        timer("stmt_execute"), \
+                        process_list.track(
+                            sql, protocol=ctx.channel.value,
+                            catalog=ctx.current_catalog,
+                            schema=ctx.current_schema,
+                            trace_id=sp["trace_id"]):
                     out = self.execute_stmt(s, ctx)
             finally:
                 # log-bucketed latency distribution per statement kind ×
@@ -150,6 +172,9 @@ class FrontendInstance:
             return ex.use_database(stmt, ctx)
         if isinstance(stmt, ast.SetVariable):
             return ex.set_variable(stmt, ctx)
+        if isinstance(stmt, ast.Kill):
+            from .statement import apply_kill
+            return apply_kill(stmt)
         if isinstance(stmt, ast.Copy):
             return ex.copy(stmt, ctx)
         if isinstance(stmt, ast.Tql):

@@ -177,6 +177,34 @@ def _int_setting(stmt: ast.SetVariable) -> int:
             f"SET {stmt.name}: expected an integer, got {stmt.value!r}")
 
 
+def apply_kill(stmt: ast.Kill) -> Output:
+    """Shared KILL handler: trip the cancel event of a running statement
+    in the process-wide registry. The killed statement raises
+    QueryCancelledError at its next batch boundary; an unknown or
+    already-finished id is a clean InvalidArgumentsError (the registry
+    raises it), never a crash. One function for both frontends so the
+    semantics cannot drift."""
+    from ..common import process_list
+    process_list.REGISTRY.kill(stmt.process_id)
+    return Output.rows(1)
+
+
+#: session variables wire clients set as connection boilerplate (mysql
+#: connectors, psql, JDBC). Accepted as no-ops — erroring would break
+#: every driver handshake — but ONLY these: any other unknown name is a
+#: typo'd knob and errors identically on both frontends.
+_CLIENT_COMPAT_VARS = frozenset({
+    "names", "autocommit", "sql_mode", "wait_timeout",
+    "net_write_timeout", "net_read_timeout", "interactive_timeout",
+    "character_set_results", "character_set_client",
+    "character_set_connection", "collation_connection", "sql_select_limit",
+    "max_execution_time", "transaction_isolation", "tx_isolation",
+    # postgres-dialect session boilerplate
+    "client_encoding", "datestyle", "extra_float_digits", "search_path",
+    "application_name", "statement_timeout",
+})
+
+
 def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
     """Shared SET handler: every knob here is session- or process-level
     state, so the standalone executor and the distributed frontend
@@ -249,6 +277,22 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
             from ..query import tpu_exec
             tpu_exec.TPU_DISPATCH_MIN_ROWS = value
             tpu_exec._observed_min_dt[0] = None
+    elif name == "self_monitor_retention_ms":
+        # retention window for greptime_private.node_metrics /
+        # region_heat (monitor/scraper.py sweeps on each tick;
+        # 0 disables the sweep)
+        from ..monitor import scraper
+        scraper.configure_retention(_int_setting(stmt))
+    elif name in _CLIENT_COMPAT_VARS or name.startswith("@"):
+        # connection boilerplate from wire clients: accepted, ignored
+        pass
+    else:
+        # unknown knob: the SAME error on both frontends (this function
+        # is the one SET path), instead of the silent success that let a
+        # typo'd `SET slow_query_treshold_ms` do nothing
+        raise InvalidArgumentsError(
+            f"SET {stmt.name}: unknown session variable (see README "
+            f"'Session variables' for the supported knobs)")
     return Output.rows(0)
 
 

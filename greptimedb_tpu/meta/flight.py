@@ -104,6 +104,14 @@ class FlightMetaServer(flight.FlightServerBase):
                     metasrv_addr=self.address,
                     metasrv_state=self.raft_node.role
                     if self.raft_node is not None else None)}
+            elif kind == "region_heat":
+                # same leader-only rule as cluster_info: heartbeat stats
+                # are leader-local memory
+                if self.raft_node is not None \
+                        and not self.raft_node.is_leader:
+                    from .replication import NotLeaderError
+                    raise NotLeaderError(self.raft_node.leader_id)
+                resp = {"ok": True, "rows": self.srv.region_heat()}
             elif kind == "list_datanodes":
                 peers = self.srv.alive_datanodes() \
                     if body.get("alive_only", True) else self.srv.peers()
@@ -221,6 +229,9 @@ class FlightMetaClient:
 
     def cluster_info(self) -> List[dict]:
         return self._action("cluster_info", {})["nodes"]
+
+    def region_heat(self) -> List[dict]:
+        return self._action("region_heat", {})["rows"]
 
     def put_table_info(self, full_name: str, info: dict) -> None:
         self._action("put_table_info", {"name": full_name, "info": info})

@@ -126,6 +126,12 @@ class MetaSrv:
         #: so consecutive reports yield a per-node ingest rate
         self._prev_ingest: Dict[int, tuple] = {}
         self._ingest_rate: Dict[int, float] = {}
+        #: per-REGION twins of the above: {node: {region: rows}} at the
+        #: previous full beat and the derived {node: {region: rps}} —
+        #: the cluster-wide region-heat feed the self-monitoring
+        #: scraper persists into greptime_private.region_heat
+        self._prev_region_rows: Dict[int, tuple] = {}
+        self._region_rates: Dict[int, Dict[str, float]] = {}
         self._last_seen: Dict[int, float] = {}
         self._detectors: Dict[int, PhiAccrualFailureDetector] = {}
         self._phi_threshold = phi_threshold
@@ -193,6 +199,19 @@ class MetaSrv:
                     0.0, (stat.approximate_rows - prev[0]) /
                     (now - prev[1]))
             self._prev_ingest[node_id] = (stat.approximate_rows, now)
+            # per-region rate across consecutive FULL beats (light beats
+            # carry no region rows, so the divisor is the true elapsed
+            # time between stat walks, same rule as the node rate)
+            by_region = {rs["region"]: int(rs["rows"])
+                         for rs in stat.region_stats}
+            prev_r = self._prev_region_rows.get(node_id)
+            if prev_r is not None and now > prev_r[1]:
+                dt = now - prev_r[1]
+                self._region_rates[node_id] = {
+                    region: max(0.0,
+                                (rows - prev_r[0].get(region, 0)) / dt)
+                    for region, rows in by_region.items()}
+            self._prev_region_rows[node_id] = (by_region, now)
             self._stats[node_id] = stat
         elif stat is not None:
             # light beat: region_count only (selector freshness); keep
@@ -350,6 +369,30 @@ class MetaSrv:
             })
         return rows
 
+    def region_heat(self, now: Optional[float] = None) -> List[dict]:
+        """One row per (datanode, region): heartbeat-reported rows and
+        size plus the per-region ingest rate derived across full stat
+        beats — the cluster-wide feed behind
+        greptime_private.region_heat. Rates zero for non-alive nodes
+        (same derivative rule as cluster_info's node rate)."""
+        now = time.time() if now is None else now
+        alive = {p.id for p in self.alive_datanodes(now)}
+        rows: List[dict] = []
+        for node_id in sorted(self._stats):
+            stat = self._stats[node_id]
+            rates = self._region_rates.get(node_id, {})
+            for rs in stat.region_stats:
+                rows.append({
+                    "node": f"dn{node_id}",
+                    "region": rs["region"],
+                    "rows": int(rs["rows"]),
+                    "size_bytes": int(rs["size_bytes"]),
+                    "ingest_rate_rps": round(
+                        rates.get(rs["region"], 0.0), 3)
+                    if node_id in alive else 0.0,
+                })
+        return rows
+
     # ---- region failover (the action the reference leaves TODO,
     # meta-srv/src/handler/failure_handler/runner.rs:132; design per
     # docs/rfcs/2023-03-08-region-fault-tolerance.md: region data lives
@@ -436,6 +479,9 @@ class MetaClient:
 
     def cluster_info(self) -> List[dict]:
         return self._srv.cluster_info()
+
+    def region_heat(self) -> List[dict]:
+        return self._srv.region_heat()
 
     def put_table_info(self, full_name: str, info: dict) -> None:
         self._srv.put_table_info(full_name, info)

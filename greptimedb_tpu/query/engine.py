@@ -25,8 +25,8 @@ from ..errors import (
 from ..session import QueryContext
 from ..sql.ast import (
     Column, DescribeTable, Explain, Expr, FunctionCall, InList, Literal,
-    Query, SetQuery, ShowCreateTable, ShowDatabases, ShowTables,
-    ShowVariable, Star, Statement, TableRef, WindowSpec)
+    Query, SetQuery, ShowCreateTable, ShowDatabases, ShowProcessList,
+    ShowTables, ShowVariable, Star, Statement, TableRef, WindowSpec)
 from ..table.table import Table
 from .expr import Evaluator, expr_name, like_to_regex
 from .functions import AGGREGATE_FUNCTIONS
@@ -66,6 +66,8 @@ class QueryEngine:
             return show_impl.show_create_table(self, stmt, ctx)
         if isinstance(stmt, ShowVariable):
             return show_impl.show_variable(self, stmt, ctx)
+        if isinstance(stmt, ShowProcessList):
+            return show_impl.show_processlist(self, stmt, ctx)
         if isinstance(stmt, DescribeTable):
             return show_impl.describe_table(self, stmt, ctx)
         if isinstance(stmt, Explain):
@@ -213,6 +215,8 @@ class QueryEngine:
 
     def _execute_query_inner(self, query: Query, ctx: QueryContext
                              ) -> Output:
+        from ..common import process_list
+        process_list.check_cancelled()     # KILL between sub-statements
         if isinstance(query, SetQuery):     # e.g. a UNION-bodied CTE /
             return self.execute_set_query(query, ctx)  # derived table
         self._rewrite_query_subqueries(query, ctx)

@@ -159,6 +159,42 @@ def show_create_table(engine, stmt, ctx: QueryContext) -> Output:
     return Output.record_batches([rb], schema)
 
 
+def show_processlist(engine, stmt, ctx: QueryContext) -> Output:
+    """SHOW [FULL] PROCESSLIST over the process-wide active-statement
+    registry (common/process_list.py) — the same rows
+    information_schema.processes serves. Non-FULL truncates the
+    statement text at 100 chars, the MySQL `Info` convention."""
+    from ..common import process_list
+    rows = process_list.REGISTRY.rows()
+    schema = Schema([
+        ColumnSchema("Id", dt.INT64),
+        ColumnSchema("Node", dt.STRING),
+        ColumnSchema("Db", dt.STRING),
+        ColumnSchema("Protocol", dt.STRING),
+        ColumnSchema("State", dt.STRING),
+        ColumnSchema("Elapsed_ms", dt.INT64),
+        ColumnSchema("Rows_scanned", dt.INT64),
+        ColumnSchema("Bytes_read", dt.INT64),
+        ColumnSchema("Trace_id", dt.STRING),
+        ColumnSchema("Info", dt.STRING),
+    ])
+    full = bool(getattr(stmt, "full", False))
+    rb = RecordBatch.from_pydict(schema, {
+        "Id": [r["id"] for r in rows],
+        "Node": [r["node"] for r in rows],
+        "Db": [r["schema"] for r in rows],
+        "Protocol": [r["protocol"] for r in rows],
+        "State": [r["state"] for r in rows],
+        "Elapsed_ms": [int(r["elapsed_ms"]) for r in rows],
+        "Rows_scanned": [r["rows_scanned"] for r in rows],
+        "Bytes_read": [r["bytes_read"] for r in rows],
+        "Trace_id": [r["trace_id"] for r in rows],
+        "Info": [r["query"] if full else r["query"][:100]
+                 for r in rows],
+    })
+    return Output.record_batches([rb], schema)
+
+
 def show_variable(engine, stmt, ctx: QueryContext) -> Output:
     """MySQL-compat surface: SHOW VARIABLES / FULL TABLES etc. return an
     empty-ish answer rather than erroring (reference: mysql federated)."""

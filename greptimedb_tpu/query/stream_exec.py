@@ -36,8 +36,13 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from ..common.failpoint import register as _fp_register
 from ..common.time import TimestampRange
 from ..ops.kernels import OP_PUT, merge_dedup_numpy, shape_bucket
+
+# per-slice boundary of the streamed cold scan: delay(ms) makes a scan
+# deterministically slow for the KILL-cancellation tests
+_fp_register("stream_slice")
 
 #: stream (instead of caching) any region estimated above this many rows
 _STREAM_THRESHOLD_ROWS = [64_000_000]
@@ -423,6 +428,7 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
 
     _t0 = _time.perf_counter()
     _rows_read = 0
+    _bytes_read = 0
     _reduce_s = 0.0
     schema = snap._version.schema
     ts_name = schema.timestamp_column.name
@@ -462,6 +468,7 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
                 if nb == 0:
                     continue
                 _rows_read += nb
+                _bytes_read += batch.nbytes
                 data = _lean_batch(batch, schema, needed_fields,
                                    want_types, ts_name, need_ts, nb)
                 if data is None:
@@ -474,7 +481,12 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
                     frames.append(f)
     # the lean reader bypasses read_sst, so it reports its own decode
     # stats (same stage names, so EXPLAIN ANALYZE sees one decode line)
+    # stream_rows marks these decode rows as the STREAMED share (the
+    # resident path's read_sst records plain decode rows too):
+    # ExecStats.totals() uses it as the live rows-scanned floor while
+    # stream_scan is still unpublished
     exec_stats.record("decode", rows=_rows_read, files=len(files),
+                      bytes=_bytes_read, stream_rows=_rows_read,
                       elapsed_s=_time.perf_counter() - _t0 - _reduce_s)
     exec_stats.record("reduce", rows=_rows_read, elapsed_s=_reduce_s)
     return frames, _rows_read
@@ -973,6 +985,7 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
     _t_stream = _time.perf_counter()
     load = propagate(_load_slice)
     from ..common.runtime import transient_executor
+    from ..common import failpoint, process_list
     with span("stream_scan", region=region.name, slices=len(jobs),
               mode=mode), \
             transient_executor(depth, "stream-scan") as pool:
@@ -980,34 +993,48 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
                             sd, _ROW_BUCKET_MIN, clip, plan, mode,
                             sid_keys)
                 for dim, lo, hi, clip in jobs[:depth]]
-        for i in range(len(jobs)):
-            res = futs[i].result()
-            if i + depth < len(jobs):
-                dim, lo, hi, clip = jobs[i + depth]
-                futs.append(pool.submit(load, snap, dim, lo, hi,
-                                        unit, needed, sd, _ROW_BUCKET_MIN,
-                                        clip, plan, mode, sid_keys))
-            futs[i] = None                   # free the slice as we go
-            if res is None:
-                prof.bump("empty_slices")
-                continue
-            kind, payload, info = res
-            prof.rows += info.get("rows", 0)
-            for k in ("lean_slices", "merged_slices", "dedup_skip_slices"):
-                if info.get(k):
-                    prof.bump(k, info[k])
-            if kind == "frames":
-                frames.extend(payload)
-                continue
-            if kind == "frame":
-                if payload is not None and len(payload):
-                    frames.append(payload)
-                continue
-            prof.bump("device_slices")
-            ln = _launch_scan_kernel(payload, schema, plan)
-            if ln is not None:
-                launched.append(ln)
-            del payload, res
+        try:
+            for i in range(len(jobs)):
+                # cooperative KILL at the slice boundary: prefetched
+                # slices are cancelled in the finally, so a killed scan
+                # releases its workers within one slice
+                process_list.check_cancelled()
+                failpoint.fail_point("stream_slice")
+                res = futs[i].result()
+                if i + depth < len(jobs):
+                    dim, lo, hi, clip = jobs[i + depth]
+                    futs.append(pool.submit(
+                        load, snap, dim, lo, hi, unit, needed,
+                        sd, _ROW_BUCKET_MIN, clip, plan, mode, sid_keys))
+                futs[i] = None               # free the slice as we go
+                if res is None:
+                    prof.bump("empty_slices")
+                    continue
+                kind, payload, info = res
+                prof.rows += info.get("rows", 0)
+                for k in ("lean_slices", "merged_slices",
+                          "dedup_skip_slices"):
+                    if info.get(k):
+                        prof.bump(k, info[k])
+                if kind == "frames":
+                    frames.extend(payload)
+                    continue
+                if kind == "frame":
+                    if payload is not None and len(payload):
+                        frames.append(payload)
+                    continue
+                prof.bump("device_slices")
+                ln = _launch_scan_kernel(payload, schema, plan)
+                if ln is not None:
+                    launched.append(ln)
+                del payload, res
+        finally:
+            # a raise (KILL, failed slice) must not leave prefetched
+            # slices occupying the pool: unstarted futures cancel now,
+            # the `with` shutdown then only waits for the ≤depth running
+            for f in futs:
+                if f is not None:
+                    f.cancel()
     prof.mark("decode_reduce", _time.perf_counter() - _t_stream)
     _publish_stream_stats(prof)
     if sid_keys and frames:

@@ -914,7 +914,9 @@ def region_moment_frames(table, plan: TpuPlan,
     cold = [region_streams_cold(r) for r in regions]
     exec_stats.set_dispatch(local_dispatch_decision(table, cold, regions))
     frames = []
+    from ..common import process_list
     for region, streams in zip(regions, cold):
+        process_list.check_cancelled()     # per-region batch boundary
         if streams:
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))

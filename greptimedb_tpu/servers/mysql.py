@@ -60,6 +60,7 @@ T_DATETIME, T_VARCHAR, T_BLOB, T_VAR_STRING, T_STRING = 12, 15, 252, 253, 254
 
 # commands
 COM_QUIT, COM_INIT_DB, COM_QUERY, COM_FIELD_LIST = 0x01, 0x02, 0x03, 0x04
+COM_PROCESS_KILL = 0x0C
 COM_PING = 0x0E
 COM_STMT_PREPARE, COM_STMT_EXECUTE = 0x16, 0x17
 COM_STMT_CLOSE, COM_STMT_RESET = 0x19, 0x1A
@@ -417,6 +418,17 @@ class _Connection:
         elif cmd == COM_STMT_CLOSE:
             self.stmts.pop(struct.unpack_from("<I", body, 0)[0], None)
         elif cmd == COM_STMT_RESET:
+            self.send_ok()
+        elif cmd == COM_PROCESS_KILL:
+            # `mysqladmin kill` / the wire form of KILL <id>: same
+            # registry and same clean-error semantics as the SQL path
+            from ..common import process_list
+            pid = struct.unpack_from("<I", body, 0)[0]
+            try:
+                process_list.REGISTRY.kill(pid)
+            except GreptimeError as e:
+                self.send_err(str(e), errno=1094)  # ER_NO_SUCH_THREAD
+                return
             self.send_ok()
         else:
             self.send_err(f"unsupported command 0x{cmd:02x}", errno=1047)

@@ -397,6 +397,18 @@ class ShowVariable(Statement):
 
 
 @dataclass
+class ShowProcessList(Statement):
+    full: bool = False
+
+
+@dataclass
+class Kill(Statement):
+    """KILL [QUERY] <id> — cooperative cancellation of a running
+    statement from information_schema.processes / SHOW PROCESSLIST."""
+    process_id: int = 0
+
+
+@dataclass
 class DescribeTable(Statement):
     table: ObjectName = None
 

@@ -15,11 +15,11 @@ from .ast import (
     AddColumn, AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef,
     Copy, CreateDatabase, CreateFlow, CreateTable, Delete, DescribeTable,
     DropColumn, DropDatabase, DropFlow, DropTable, Explain, Expr,
-    FunctionCall, InList, Insert, Interval, IsNull, Join, Literal, ObjectName,
-    PartitionEntry, Partitions, Placeholder, Query, RenameTable, SelectItem,
-    SetQuery, SetVariable, ShowCreateTable, ShowDatabases, ShowFlows,
-    ShowTables, ShowVariable, Star, Statement, Subquery, TableRef, Tql,
-    TruncateTable, UnaryOp, Use,
+    FunctionCall, InList, Insert, Interval, IsNull, Join, Kill, Literal,
+    ObjectName, PartitionEntry, Partitions, Placeholder, Query, RenameTable,
+    SelectItem, SetQuery, SetVariable, ShowCreateTable, ShowDatabases,
+    ShowFlows, ShowProcessList, ShowTables, ShowVariable, Star, Statement,
+    Subquery, TableRef, Tql, TruncateTable, UnaryOp, Use,
 )
 from .tokenizer import EOF, IDENT, NUMBER, OP, QIDENT, STRING, Token, tokenize
 
@@ -266,7 +266,21 @@ class Parser:
             self.next()
             self.match_kw("TABLE")
             return TruncateTable(name=self.parse_object_name())
+        if kw == "KILL":
+            return self.parse_kill()
         raise ParserError(f"unsupported statement start: {t.value!r} at {t.pos}")
+
+    def parse_kill(self) -> Kill:
+        """KILL [QUERY] <id> — the id is the `id` column of
+        information_schema.processes / SHOW PROCESSLIST."""
+        self.expect_kw("KILL")
+        self.match_kw("QUERY")
+        t = self.next()
+        if t.kind != NUMBER:
+            raise ParserError(
+                f"KILL expects a numeric query id, got {t.value!r} at "
+                f"{t.pos}")
+        return Kill(process_id=self._to_int(t))
 
     # ---- WITH (CTE) ----
     def parse_with(self) -> Statement:
@@ -1159,6 +1173,8 @@ class Parser:
             if where is not None:
                 raise ParserError("SHOW FLOWS supports LIKE, not WHERE")
             return ShowFlows(like)
+        if self.match_kw("PROCESSLIST"):
+            return ShowProcessList(full=full)
         if self.match_kw("CREATE"):
             self.expect_kw("TABLE")
             return ShowCreateTable(self.parse_object_name())

@@ -164,6 +164,10 @@ class RegionSnapshot:
         region = self._region
         v = self._version
         schema = v.schema
+        # cooperative KILL: a killed statement stops before (and between)
+        # file reads instead of decoding the rest of the region
+        from ..common import process_list
+        process_list.check_cancelled()
         field_names = [c.name for c in schema.field_columns()
                        if projection is None or c.name in projection]
         runs: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
@@ -205,6 +209,7 @@ class RegionSnapshot:
                     series_range=series_range, synthetic_seq=synthetic_seq,
                     need_ts=need_ts),
                 v.ssts.files_in_range(time_range)):
+            process_list.check_cancelled()     # per-file batch boundary
             if sst.num_rows == 0:
                 continue
             sel = None

@@ -101,7 +101,15 @@ class RetryingObjectStore(ObjectStore):
 
     # ---- ObjectStore surface ----
     def read(self, key: str) -> bytes:
-        return self._with_retry("read", key, lambda: self.inner.read(key))
+        data = self._with_retry("read", key,
+                                lambda: self.inner.read(key))
+        # per-read byte accounting: lands on the active statement's
+        # ExecStats collector (live `bytes_read` in the processes view);
+        # a thread-local read when nobody collects, so the hot path
+        # stays unobserved-free
+        from ..common import exec_stats
+        exec_stats.record("io_read", bytes=len(data))
+        return data
 
     def write(self, key: str, data: bytes) -> None:
         return self._with_retry("write", key,

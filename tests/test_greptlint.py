@@ -152,6 +152,22 @@ def test_gl04_recognizes_aliased_register_imports(tmp_path):
     assert [f for f in fresh if f.rule == "GL04"] == []
 
 
+def test_gl09_catches_module_alias_and_skips_collections_counter(
+        tmp_path):
+    """Regression (the GL04 aliased-import lesson applied to GL09):
+    `import prometheus_client as pc; pc.Counter(...)` must flag, while
+    collections.Counter stays clean."""
+    mod = tmp_path / "aliased_metric.py"
+    mod.write_text(
+        "import prometheus_client as pc\n"
+        "from collections import Counter\n"
+        'M = pc.Counter("aliased_total", "dodges the from-import check")\n'
+        'C = Counter("abc")          # collections, not a metric\n')
+    fresh, _a, _e = lint_paths([str(mod)])
+    hits = [f for f in fresh if f.rule == "GL09"]
+    assert len(hits) == 1 and hits[0].line == 3
+
+
 def test_single_file_scan_matches_directory_scan():
     """Regression: explicitly-passed files used a bare basename as rel,
     so path-scoped rules (GL05 storage/, GL07 servers/) silently never

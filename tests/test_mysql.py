@@ -251,6 +251,25 @@ class TestMysqlProtocol:
         names, rows = client.query("DESCRIBE TABLE shown")
         assert any(r[0] == "ts" for r in rows)
 
+    def test_show_processlist_and_kill(self, client):
+        """SHOW PROCESSLIST over the wire lists the statement itself;
+        KILL of an unknown id is an ER-packet, not a dropped
+        connection; COM_PROCESS_KILL takes the same path."""
+        names, rows = client.query("SHOW PROCESSLIST")
+        assert "Info" in names and "Id" in names
+        infos = [r[names.index("Info")] for r in rows]
+        assert any("SHOW PROCESSLIST" in (i or "") for i in infos)
+        proto = [r[names.index("Protocol")] for r in rows]
+        assert "mysql" in proto
+        with pytest.raises(RuntimeError, match="no such running"):
+            client.query("KILL 424242")
+        # wire-level COM_PROCESS_KILL: unknown id → ER packet too
+        client._command(0x0C, struct.pack("<I", 424242))
+        pkt = client.io.read_packet()
+        assert pkt[0] == 0xFF
+        assert b"no such running" in pkt
+        assert client.ping()                 # connection survives
+
     def test_prepared_statements(self, client):
         client.query("CREATE TABLE pst (host STRING, ts TIMESTAMP"
                      " TIME INDEX, cpu DOUBLE, PRIMARY KEY(host))")
