@@ -561,6 +561,145 @@ def bench_dist_scatter(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_region_migration_availability(n_rows: int):
+    """Sixth driver metric (ISSUE 9): migrate a region between datanodes
+    UNDER sustained single-row ingest and measure availability:
+
+    - ``handoff_window_ms`` — the fenced window (WAL-tail capture →
+      route commit, from the op doc's state timestamps): the ONLY span
+      in which writes to the migrating region stall.
+    - ``max_write_stall_ms`` — the worst user-visible insert latency
+      during the whole migration (the stale-route retry riding over the
+      fence; every other insert proceeds at normal speed).
+    - ``lost_rows`` / ``dup_rows`` — acked-write continuity: every row
+      the ingest thread got an ack for is readable EXACTLY once after
+      the handoff (asserted zero/zero, then published).
+
+    2 in-process datanodes over one SHARED object store (the elastic
+    deployment shape); the balancer + heartbeats run in a background
+    pump thread at production-like cadence while the foreground ingests.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from greptimedb_tpu.client import LocalDatanodeClient
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.distributed import DistInstance
+    from greptimedb_tpu.meta import MemKv, MetaClient, MetaSrv, Peer
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-migrate-")
+    datanodes = {}
+    try:
+        shared = FsObjectStore(f"{tmpdir}/shared")
+        srv = MetaSrv(MemKv())
+        srv.balancer.resend_interval_s = 0.05
+        meta = MetaClient(srv)
+        clients = {}
+        for i in (1, 2):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=f"{tmpdir}/dn{i}", node_id=i,
+                register_numbers_table=False), store=shared)
+            dn.start()
+            dn.attach_meta(meta)
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        ctx = QueryContext()
+        fe.do_query(
+            "CREATE TABLE mig (host STRING, ts TIMESTAMP TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY(host)) "
+            "PARTITION BY RANGE COLUMNS (host) ("
+            "  PARTITION r0 VALUES LESS THAN ('m'),"
+            "  PARTITION r1 VALUES LESS THAN (MAXVALUE))", ctx)
+        table = fe.catalog.table("greptime", "public", "mig")
+        # preload the region that will move (host 'a' < 'm' → region 0)
+        ts0 = np.arange(n_rows, dtype=np.int64) * 1000
+        table.bulk_load({
+            "host": np.array(["a"] * n_rows, dtype=object), "ts": ts0,
+            "v": np.random.default_rng(3).random(n_rows)})
+        table.flush()
+        route = srv.table_route("greptime.public.mig")
+        src = next(rr.leader.id for rr in route.region_routes
+                   if rr.region_number == 0)
+        dst = 2 if src == 1 else 1
+
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                srv.balancer.tick()
+                for i, dn in datanodes.items():
+                    resp = srv.handle_heartbeat(i)
+                    for msg in resp.mailbox:
+                        dn._handle_mailbox(msg)
+                time.sleep(0.02)
+
+        acked = []
+        stalls = []
+        ingest_stop = threading.Event()
+
+        def ingest():
+            n = 0
+            while not ingest_stop.is_set():
+                n += 1
+                key_ts = 10_000_000 + n
+                t0 = time.perf_counter()
+                try:
+                    fe.do_query(
+                        f"INSERT INTO mig VALUES ('a', {key_ts}, 1.0)",
+                        ctx)
+                except Exception:  # noqa: BLE001 — an unacked write
+                    continue       # during the fault is legal
+                stalls.append((time.perf_counter() - t0) * 1e3)
+                acked.append(key_ts)
+
+        pump_t = threading.Thread(target=pump, daemon=True)
+        ingest_t = threading.Thread(target=ingest, daemon=True)
+        pump_t.start()
+        ingest_t.start()
+        time.sleep(0.3)                       # steady-state ingest
+        fe.do_query(f"ADMIN MIGRATE REGION mig 0 TO {dst}", ctx)
+        t0 = time.time()
+        while srv.balancer.ops() and time.time() - t0 < 120:
+            time.sleep(0.05)
+        time.sleep(0.3)                       # post-handoff ingest
+        ingest_stop.set()
+        ingest_t.join(timeout=60)
+        stop.set()
+        pump_t.join(timeout=10)
+
+        done = srv.balancer.done_ops()[-1]
+        assert done["state"] == "done", done
+        times = done.get("times", {})
+        handoff_ms = max(0, times.get("release", 0) -
+                         times.get("open", 0))
+        # continuity: every acked row readable exactly once
+        out = fe.do_query(
+            "SELECT ts FROM mig WHERE ts >= 10000000", ctx)[-1]
+        got = [r[0] for b in out.batches for r in b.rows()]
+        lost = len(set(acked) - set(got))
+        dup = len(got) - len(set(got))
+        assert lost == 0, f"lost {lost} acked rows"
+        assert dup == 0, f"{dup} duplicated rows"
+        new_owner = next(
+            rr.leader.id for rr in
+            srv.table_route("greptime.public.mig").region_routes
+            if rr.region_number == 0)
+        assert new_owner == dst
+        return (handoff_ms, max(stalls) if stalls else 0.0, len(acked),
+                lost, dup)
+    finally:
+        for dn in datanodes.values():
+            dn.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main():
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
@@ -620,6 +759,21 @@ def main():
         "rows": dist_rows,
         "datanodes": 4,
         "scatter_node_ms": node_ms,
+    }))
+
+    mig_rows = int(os.environ.get("GREPTIME_BENCH_MIGRATE_ROWS",
+                                  1_000_000))
+    handoff_ms, max_stall_ms, acked_n, lost, dup = \
+        bench_region_migration_availability(mig_rows)
+    print(json.dumps({
+        "metric": "region_migration_availability",
+        "value": round(handoff_ms, 1),
+        "unit": "ms_handoff_window",
+        "max_write_stall_ms": round(max_stall_ms, 1),
+        "migrated_rows": mig_rows,
+        "acked_writes_during_migration": acked_n,
+        "lost_rows": lost,
+        "dup_rows": dup,
     }))
 
     fp_rows = int(os.environ.get("GREPTIME_BENCH_FAILPOINT_ROWS",

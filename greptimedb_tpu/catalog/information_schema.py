@@ -71,6 +71,18 @@ _CLUSTER_INFO_SCHEMA = Schema([
     ColumnSchema("region_stats", dt.STRING),
 ])
 
+_REGION_PEERS_SCHEMA = Schema([
+    ColumnSchema("table_name", dt.STRING),
+    ColumnSchema("region_number", dt.INT64),
+    ColumnSchema("peer_id", dt.INT64),
+    ColumnSchema("peer_addr", dt.STRING),
+    ColumnSchema("is_leader", dt.STRING),
+    ColumnSchema("status", dt.STRING),
+    ColumnSchema("route_version", dt.INT64),
+    ColumnSchema("operation", dt.STRING, nullable=True),
+    ColumnSchema("op_id", dt.STRING, nullable=True),
+])
+
 _PROCESSES_SCHEMA = Schema([
     ColumnSchema("id", dt.INT64),
     ColumnSchema("node", dt.STRING),
@@ -242,6 +254,42 @@ def _cluster_nodes(catalog_manager, catalog_name: str):
     }]
 
 
+def _region_peer_rows(catalog_manager, catalog_name: str):
+    """region_peers rows: placement + lease state + in-flight balancer
+    operation per (table, region). Meta-backed on a clustered frontend
+    (same advisory degradation as cluster_info); synthesized from local
+    regions standalone so the view exists on every topology."""
+    meta = getattr(catalog_manager, "meta_client", None)
+    if meta is not None and hasattr(meta, "region_peers"):
+        try:
+            if hasattr(meta, "advisory"):
+                meta = meta.advisory()
+            return meta.region_peers()
+        except Exception:  # noqa: BLE001 — health view over a flaky
+            import logging                 # meta must degrade, not 500
+            logging.getLogger(__name__).exception(
+                "region_peers: meta unreachable")
+            return []
+    rows = []
+    for schema_name in catalog_manager.schema_names(catalog_name):
+        for tname in catalog_manager.table_names(catalog_name,
+                                                 schema_name):
+            t = catalog_manager.table(catalog_name, schema_name, tname)
+            regions = getattr(t, "regions", None)
+            if not regions:
+                continue
+            for rn in sorted(regions):
+                rows.append({
+                    "table_name":
+                        f"{catalog_name}.{schema_name}.{tname}",
+                    "region_number": rn, "peer_id": 0, "peer_addr": "",
+                    "is_leader": "Yes", "status": "ALIVE",
+                    "route_version": 0, "operation": None,
+                    "op_id": None,
+                })
+    return rows
+
+
 class _VirtualTable(Table):
     """Read-only table whose rows come from a builder at scan time."""
 
@@ -354,6 +402,15 @@ def information_schema_table(catalog_manager, catalog_name: str,
             return rows
         return _VirtualTable("cluster_info", _CLUSTER_INFO_SCHEMA,
                              build_cluster_info)
+    if name == "region_peers":
+        def build_region_peers():
+            rows = {k: [] for k in _REGION_PEERS_SCHEMA.names()}
+            for peer in _region_peer_rows(catalog_manager, catalog_name):
+                for k in rows:
+                    rows[k].append(peer.get(k))
+            return rows
+        return _VirtualTable("region_peers", _REGION_PEERS_SCHEMA,
+                             build_region_peers)
     if name == "processes":
         def build_processes():
             from ..common import process_list

@@ -56,12 +56,16 @@ def _to_greptime_error(e: flight.FlightError) -> GreptimeError:
     """Server-side GreptimeErrors cross the wire as gRPC status messages;
     rebuild the closest taxonomy member so callers keep one except path.
     Unavailable/timeout faults map to TransientRpcError so the
-    distributed fan-out's retry loop recognizes real network hops."""
-    from ..errors import TransientRpcError
+    distributed fan-out's retry loop recognizes real network hops; the
+    'stale route' marker maps to StaleRouteError so the DistTable's
+    route-refresh retry works across real sockets too."""
+    from ..errors import StaleRouteError, TransientRpcError
     msg = str(e).split(". gRPC client debug context:")[0]
     if isinstance(e, (flight.FlightUnavailableError,
                       flight.FlightTimedOutError)):
         return TransientRpcError(msg)
+    if StaleRouteError.WIRE_MARKER in msg:
+        return StaleRouteError(msg)
     if "not found" in msg or "not on datanode" in msg:
         return TableNotFoundError(msg)
     return GreptimeError(msg)
@@ -94,6 +98,9 @@ class _FlightBase:
             err = resp.get("error", "unknown flight error")
             if resp.get("error_type") == "TableNotFoundError":
                 raise TableNotFoundError(err)
+            if resp.get("error_type") == "StaleRouteError":
+                from ..errors import StaleRouteError
+                raise StaleRouteError(err)
             raise GreptimeError(err)
         return resp
 

@@ -231,7 +231,7 @@ def metasrv_start(args) -> None:
         kv = ReplicatedKv(raft_node)
     else:
         kv = FileKv(args.store) if args.store else MemKv()
-    srv = MetaSrv(kv)
+    srv = MetaSrv(kv, datanode_lease_secs=args.datanode_lease_secs)
     server = FlightMetaServer(srv, f"grpc://{args.bind_addr}",
                               raft_node=raft_node)
     server.serve_in_background()
@@ -258,8 +258,11 @@ def metasrv_start(args) -> None:
     election.start()
 
     # region failover runner (reference: FailureDetectRunner on the
-    # leader; the action itself is this build's upgrade over v0.2)
+    # leader; the action itself is this build's upgrade over v0.2) plus
+    # the elastic-region balancer control loop (split/migrate/rebalance
+    # state machines resume from the __balancer/ KV keys on restart)
     from ..common.runtime import RepeatedTask
+    srv.balancer.is_leader_fn = lambda: election.is_leader
 
     def failover_tick():
         if not election.is_leader:
@@ -268,6 +271,7 @@ def metasrv_start(args) -> None:
         for m in moves:
             logging.warning("failover: region %s of %s moved %d -> %d",
                             m["region"], m["table"], m["from"], m["to"])
+        srv.balancer.tick()
 
     runner = RepeatedTask(args.failover_interval, failover_tick,
                           name="failover-runner")
@@ -393,6 +397,7 @@ def main(argv=None) -> int:
                         "the full metasrv replica set (enables the "
                         "replicated raft store)")
     mstart.add_argument("--failover-interval", type=float, default=10.0)
+    mstart.add_argument("--datanode-lease-secs", type=float, default=15.0)
     mstart.add_argument("--log-level")
     mstart.set_defaults(func=metasrv_start)
 

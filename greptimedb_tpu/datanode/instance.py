@@ -4,21 +4,35 @@ Reference behavior: src/datanode/src/instance.rs — `Instance::new_with`
 builds object store → log store → storage engine → mito engine → catalog →
 query engine; `start_instance` replays the catalog (which replays region
 WALs via table open).
+
+Elastic-region worker side: meta's balancer (meta/balancer.py) drives
+multi-step region operations through mailbox messages riding heartbeat
+responses; each handler here performs one idempotent step (flush
+snapshot, fence + WAL-tail read, adopt + tail replay, release, split
+copy/apply) and reports back through ``balancer_ack`` on the meta
+client, so a re-delivered message after a crash resumes the operation
+instead of corrupting it.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..catalog import LocalCatalogManager
+from ..common import failpoint as _fp
 from ..mito import MitoEngine
 from ..query import QueryEngine
 from ..storage.engine import EngineConfig, StorageEngine
 from ..storage.object_store import FsObjectStore, ObjectStore
 from ..table import NumbersTable
 from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
+
+logger = logging.getLogger(__name__)
+
+_fp.register("balancer_snapshot_upload")
 
 
 @dataclass
@@ -47,6 +61,12 @@ class DatanodeInstance:
         self.opts = opts
         config = EngineConfig(
             data_home=opts.data_home,
+            # node-scoped WAL home: datanodes that share one data_home
+            # (shared object store deployments) must never share WAL
+            # dirs or region fence markers — both are per-owner state
+            wal_home=os.path.join(opts.data_home, "nodes",
+                                  str(opts.node_id), "wal")
+            if opts.node_id else None,
             flush_size_bytes=opts.flush_size_bytes,
             wal_sync_on_write=opts.wal_sync_on_write,
             disable_wal=opts.disable_wal)
@@ -83,6 +103,9 @@ class DatanodeInstance:
         self.catalog.flow_manager = self.flow_manager
         self._started = False
         self._heartbeat_task = None
+        #: meta client for datanode→meta control RPCs (balancer step
+        #: acks); start_heartbeat wires it, tests may attach directly
+        self._meta_client = None
 
     def _create_flow_sink(self, spec, schema, pk_indices):
         from ..table.requests import CreateTableRequest
@@ -113,6 +136,11 @@ class DatanodeInstance:
                 NumbersTable())
         self._started = True
 
+    def attach_meta(self, meta_client) -> None:
+        """Wire the meta client used for balancer step acks (heartbeat
+        startup calls this; cooperative tests call it directly)."""
+        self._meta_client = meta_client
+
     def start_heartbeat(self, meta_client, interval_s: float = 5.0,
                         stats_every: int = 4) -> None:
         """Report liveness + region stats to the meta service (reference:
@@ -126,6 +154,7 @@ class DatanodeInstance:
         from ..common.telemetry import span
         from ..meta import DatanodeStat
         from ..storage.scheduler import RepeatedTask
+        self.attach_meta(meta_client)
         counter = [0]
 
         def beat():
@@ -163,17 +192,17 @@ class DatanodeInstance:
 
     def _handle_mailbox(self, msg: dict) -> None:
         """Meta→datanode control messages riding heartbeat responses."""
-        if msg.get("type") == "flush_table":
+        kind = msg.get("type")
+        if kind == "flush_table":
             t = self.catalog.table(msg["catalog"], msg["schema"],
                                    msg["table"])
             if t is not None:
                 t.flush()
-        elif msg.get("type") == "open_regions":
+        elif kind == "open_regions":
             # failover: adopt a dead peer's regions (data on the shared
             # object store; schema shipped in the message)
             if msg.get("table_info") is None:
-                import logging
-                logging.getLogger(__name__).error(
+                logger.error(
                     "open_regions for %s without table info; skipping",
                     msg.get("table"))
                 return
@@ -183,6 +212,101 @@ class DatanodeInstance:
                                   msg["table"]) is None:
                 self.catalog.register_table(
                     msg["catalog"], msg["schema"], msg["table"], table)
+        elif kind is not None and kind.startswith("balancer_"):
+            self._handle_balancer_msg(msg)
+
+    # ---- elastic-region steps (meta/balancer.py's worker side) ----
+    def _handle_balancer_msg(self, msg: dict) -> None:
+        """Run one balancer step and ack the result to meta. SimulatedCrash
+        (a BaseException) propagates — the torture harness, like a real
+        SIGKILL, must see the step die before its ack."""
+        op_id, step = msg.get("op_id"), msg.get("type")
+        try:
+            payload = self._balancer_step(msg)
+            ok, error = True, None
+        except Exception as e:  # noqa: BLE001 — relayed to the balancer,
+            # which rolls the operation back or retries the step
+            logger.exception("balancer step %s of op %s failed",
+                             step, op_id)
+            ok, error, payload = False, f"{type(e).__name__}: {e}", {}
+        if self._meta_client is None:
+            logger.error("balancer step %s of op %s has no meta client "
+                         "to ack through", step, op_id)
+            return
+        try:
+            self._meta_client.balancer_ack(
+                self.opts.node_id, op_id, step, ok, error, payload or {})
+        except Exception:  # noqa: BLE001 — the balancer re-mails the
+            logger.exception(          # step after its ack timeout
+                "balancer ack for op %s step %s failed", op_id, step)
+
+    def _balancer_step(self, msg: dict) -> dict:
+        kind = msg["type"]
+        cat, sch, tbl = msg["catalog"], msg["schema"], msg["table"]
+        if kind == "balancer_snapshot":
+            # migrate step 1: make the region's full state durable on the
+            # shared object store (ingest continues meanwhile)
+            _fp.fail_point("balancer_snapshot_upload")
+            _, region = self.mito._hosted(cat, sch, tbl, msg["region"])
+            region.flush()
+            return {"flushed_seq":
+                    int(region.version_control.current.flushed_sequence)}
+        if kind == "balancer_fence":
+            # migrate step 2: stop the world for THIS region only, then
+            # read the final WAL tail for the target to replay
+            _, region = self.mito._hosted(cat, sch, tbl, msg["region"])
+            region.fence()
+            return {"wal_tail": region.wal_tail()}
+        if kind == "balancer_open":
+            # migrate step 3 (target side): last-flushed shared state +
+            # shipped WAL tail = everything the source ever acked
+            table = self.mito.adopt_region_with_tail(
+                msg["table_info"], msg["region"], msg.get("wal_tail"))
+            if self.catalog.table(cat, sch, tbl) is None:
+                self.catalog.register_table(cat, sch, tbl, table)
+            return {"replayed": len(msg.get("wal_tail") or [])}
+        if kind == "balancer_release":
+            gone = self.mito.release_region(cat, sch, tbl, msg["region"])
+            if gone:
+                self.catalog.deregister_table(cat, sch, tbl)
+            return {"table_gone": gone}
+        if kind == "balancer_unfence":
+            table = self.catalog.table(cat, sch, tbl)
+            region = (getattr(table, "regions", None) or {}).get(
+                msg["region"])
+            if region is not None and region.fenced:
+                region.unfence()
+            return {}
+        if kind == "balancer_split_prepare":
+            if msg.get("at_value") is None:
+                # probe-only round: the balancer pins the value in the
+                # op doc BEFORE any copy, so a re-delivered prepare
+                # cannot re-probe a moved median and copy rows across a
+                # different boundary (cross-child duplicates)
+                value = self.mito.probe_split_value(
+                    cat, sch, tbl, msg["region"])
+                return {"split_value": value, "probed": True}
+            _fp.fail_point("balancer_snapshot_upload")
+            seq, copied = self.mito.prepare_split(
+                cat, sch, tbl, msg["region"], list(msg["children"]),
+                msg["at_value"])
+            return {"split_value": msg["at_value"], "snapshot_seq": seq,
+                    "copied": copied}
+        if kind == "balancer_split_catchup":
+            copied = self.mito.split_catchup(
+                cat, sch, tbl, msg["region"], list(msg["children"]),
+                msg["at_value"], int(msg["snapshot_seq"]))
+            return {"copied": copied}
+        if kind == "balancer_split_apply":
+            self.mito.apply_split(cat, sch, tbl, msg["region"],
+                                  list(msg["children"]), msg["rule"])
+            return {}
+        if kind == "balancer_split_abort":
+            self.mito.abort_split(cat, sch, tbl, msg["region"],
+                                  list(msg["children"]))
+            return {}
+        from ..errors import UnsupportedError
+        raise UnsupportedError(f"unknown balancer step {kind!r}")
 
     def shutdown(self) -> None:
         self.flow_manager.stop()

@@ -165,3 +165,21 @@ class QueryCancelledError(GreptimeError):
     just killed."""
 
     status_code = StatusCode.ENGINE_EXECUTE_QUERY
+
+
+class StaleRouteError(GreptimeError):
+    """The caller's region route is out of date: the region moved
+    (migrate), was refined away (split), or is fenced for an in-flight
+    handoff. The DistTable catches this, refreshes its route + partition
+    rule from meta, and retries — so elastic region movement is
+    invisible to SQL clients. Every message carries the ``stale route``
+    marker because Flight flattens error types to strings on the wire
+    (client/flight.py rebuilds the type from it)."""
+
+    status_code = StatusCode.REGION_NOT_FOUND
+    WIRE_MARKER = "stale route"
+
+    def __init__(self, msg: str):
+        if self.WIRE_MARKER not in msg:
+            msg = f"{self.WIRE_MARKER}: {msg}"
+        super().__init__(msg)

@@ -49,8 +49,8 @@ from ..common.failpoint import register as _fp_register
 from ..common.runtime import env_int
 from ..datatypes.schema import Schema
 from ..errors import (
-    GreptimeError, InvalidArgumentsError, TableAlreadyExistsError,
-    TableNotFoundError, UnsupportedError)
+    GreptimeError, InvalidArgumentsError, StaleRouteError,
+    TableAlreadyExistsError, TableNotFoundError, UnsupportedError)
 from ..meta import MetaClient, TableRoute
 from ..partition import rule_from_partitions, split_rows
 from ..query import QueryEngine
@@ -72,6 +72,15 @@ def _serialize_dist_rule(rule):
 
 
 
+
+#: stale-route retries: attempts AFTER the first try for a statement
+#: whose route moved mid-flight (migrate/split) or whose target region
+#: is fenced for an in-flight handoff. Backoff doubles from
+#: _STALE_ROUTE_BASE_MS so the retries ride over the bounded fence
+#: window instead of failing into the client.
+_STALE_ROUTE_MAX_RETRIES = [env_int("GREPTIME_STALE_ROUTE_MAX_RETRIES", 6)]
+_STALE_ROUTE_BASE_MS = [env_int("GREPTIME_STALE_ROUTE_BASE_MS", 50)]
+_STALE_ROUTE_MAX_BACKOFF_MS = 2000
 
 #: attempts AFTER the first try for one datanode RPC (0 disables retry)
 _DIST_RPC_MAX_RETRIES = [env_int("GREPTIME_DIST_RPC_MAX_RETRIES", 2)]
@@ -132,15 +141,89 @@ class DistTable(Table):
     supports_filter_pushdown = True
 
     def __init__(self, info: TableInfo, rule, route: TableRoute,
-                 clients: Dict[int, DatanodeClient]):
+                 clients: Dict[int, DatanodeClient], meta=None):
         super().__init__(info)
         self.partition_rule = rule
         self.route = route
         self.clients = clients
+        #: meta client for the stale-route refresh (regions move under
+        #: live tables: migrate/split/failover); None degrades to no
+        #: refresh — the StaleRouteError surfaces after the retries
+        self.meta = meta
         self._warned_remote_regions = False
         #: per-node wall latency of the most recent scatter on this
         #: frontend ({label: ms}; bench.py's scatter profile reads it)
         self.last_scatter_node_ms: Dict[str, float] = {}
+
+    # ---- stale-route refresh (elastic regions) ----
+    def refresh_route(self) -> bool:
+        """Re-pull the route AND the partition rule from meta: a migrate
+        changes placement, a split changes the rule + the region set.
+        Returns whether anything was actually refreshed."""
+        if self.meta is None:
+            return False
+        full = (f"{self.info.catalog_name}.{self.info.schema_name}."
+                f"{self.info.name}")
+        try:
+            route = self.meta.route(full)
+        except Exception:  # noqa: BLE001 — refresh is best-effort; the
+            logger.exception(       # caller's retry loop handles failure
+                "stale-route refresh of %s failed", full)
+            return False
+        if route is None:
+            return False
+        self.route = route
+        info_doc = self.meta.table_info(full) \
+            if hasattr(self.meta, "table_info") else None
+        if info_doc:
+            meta_doc = info_doc.get("meta", {})
+            from ..mito.engine import _deserialize_rule
+            self.partition_rule = _deserialize_rule(
+                meta_doc.get("partition_rule"))
+            self.info.meta.partition_rule = meta_doc.get("partition_rule")
+            self.info.meta.region_numbers = sorted(
+                rr.region_number for rr in route.region_routes)
+        from ..common.telemetry import increment_counter
+        increment_counter("stale_route_refresh")
+        logger.info("refreshed route of %s to v%d (%d regions)", full,
+                    route.version, len(route.region_routes))
+        return True
+
+    def _retry_stale(self, what: str, call):
+        """Run a whole-table operation, refreshing the route and retrying
+        on StaleRouteError — regions move under live statements
+        (migrate/split commit) or sit briefly fenced mid-handoff; the
+        backoff rides over the bounded fence window."""
+        from ..storage.retry import is_transient
+        attempt = 0
+        while True:
+            try:
+                return call()
+            except GreptimeError as e:
+                # retryable shapes: an explicit stale route; a datanode
+                # whose LAST region of the table left (TableNotFound over
+                # the wire); a peer the per-RPC retry gave up on that may
+                # simply be DEAD (failover re-places its regions, so a
+                # refresh covers the detection window). Everything else
+                # propagates untouched.
+                retryable = isinstance(
+                    e, (StaleRouteError, TableNotFoundError)) or \
+                    is_transient(e)
+                if not retryable or \
+                        attempt >= _STALE_ROUTE_MAX_RETRIES[0]:
+                    raise
+                attempt += 1
+                delay_ms = min(
+                    _STALE_ROUTE_BASE_MS[0] * (2 ** (attempt - 1)),
+                    _STALE_ROUTE_MAX_BACKOFF_MS)
+                logger.info(
+                    "%s of %s hit a stale route (%s); refresh + retry "
+                    "%d/%d in %dms", what, self.info.name, e, attempt,
+                    _STALE_ROUTE_MAX_RETRIES[0], delay_ms)
+                time.sleep(delay_ms / 1e3 * (0.5 + random.random()))
+                if not self.refresh_route() and \
+                        isinstance(e, TableNotFoundError):
+                    raise                  # the table is genuinely gone
 
     # ---- placement helpers ----
     def _owner(self, region_number: int) -> DatanodeClient:
@@ -352,11 +435,23 @@ class DistTable(Table):
 
         def write_one(task):
             rnum, part = task
-            return _dist_rpc(
-                f"write_region[{rnum}]",
-                lambda: self._owner(rnum).write_region(
-                    self.info.catalog_name, self.info.schema_name,
-                    self.info.name, rnum, part, op))
+            try:
+                return _dist_rpc(
+                    f"write_region[{rnum}]",
+                    lambda: self._owner(rnum).write_region(
+                        self.info.catalog_name, self.info.schema_name,
+                        self.info.name, rnum, part, op))
+            except GreptimeError as e:
+                # also covers _owner()'s "region not in route" against a
+                # refreshed-but-shrunk route; only stale-route shapes
+                # re-route — everything else propagates
+                if not isinstance(e, StaleRouteError) and \
+                        "not in route" not in str(e):
+                    raise
+                # the region moved (migrate) or was refined away (split)
+                # mid-statement: re-split ONLY this part under the fresh
+                # rule — completed sibling parts must not double-count
+                return self._rewrite_stale_part(part, op)
 
         # per-REGION scatter: a multi-region insert/bulk load overlaps
         # WAL+memtable (or SST encode) work across datanodes instead of
@@ -370,6 +465,47 @@ class DistTable(Table):
                               fan_out=len(tasks), rpcs=len(tasks))
         return written
 
+    def _rewrite_stale_part(self, part: Dict[str, Sequence],
+                            op: str) -> int:
+        """Re-route one failed write part after a stale-route refresh:
+        the refined rule may fan the SAME rows across different (child)
+        regions. Retries with backoff ride over the fenced handoff
+        window; re-writes are MVCC-idempotent upserts, so a row that DID
+        land before the error cannot duplicate."""
+        attempt = 0
+        while True:
+            attempt += 1
+            if attempt > _STALE_ROUTE_MAX_RETRIES[0]:
+                raise StaleRouteError(
+                    f"write to {self.info.name} still stale after "
+                    f"{attempt - 1} route refreshes")
+            delay_ms = min(_STALE_ROUTE_BASE_MS[0] * (2 ** (attempt - 1)),
+                           _STALE_ROUTE_MAX_BACKOFF_MS)
+            time.sleep(delay_ms / 1e3 * (0.5 + random.random()))
+            self.refresh_route()
+            num_rows = len(next(iter(part.values())))
+            splits = split_rows(self.partition_rule, part, num_rows) \
+                if self.partition_rule is not None \
+                else {self._first_region(): None}
+            try:
+                written = 0
+                for rnum, idx in splits.items():
+                    piece = part if idx is None else \
+                        {k: v[idx] if isinstance(v, np.ndarray)
+                         else [v[i] for i in idx] for k, v in part.items()}
+                    written += _dist_rpc(
+                        f"write_region[{rnum}]",
+                        lambda r=rnum, p=piece: self._owner(r).write_region(
+                            self.info.catalog_name, self.info.schema_name,
+                            self.info.name, r, p, op))
+                from ..common.telemetry import increment_counter
+                increment_counter("stale_route_write_reroutes")
+                return written
+            except StaleRouteError as e:
+                logger.info("re-routed write to %s still stale (%s); "
+                            "retry %d/%d", self.info.name, e, attempt,
+                            _STALE_ROUTE_MAX_RETRIES[0])
+
     def _first_region(self) -> int:
         return self.route.region_routes[0].region_number
 
@@ -377,11 +513,23 @@ class DistTable(Table):
     def scan_batches(self, projection: Optional[Sequence[str]] = None,
                      time_range=None, limit: Optional[int] = None,
                      filters: Optional[Sequence] = None) -> list:
-        """Pruned parallel scan. `filters` are the statement's WHERE
-        conjuncts (query/engine.py): they prune regions here, and the
-        pushable tag subset also ships over the wire so datanodes drop
-        dead rows before they ever cross a socket. `limit` travels only
-        when the shipped subset IS the whole predicate — otherwise a
+        """Pruned parallel scan with stale-route refresh: a datanode that
+        no longer hosts a requested region (migrate/split landed mid-
+        statement) raises StaleRouteError instead of returning partial
+        rows, and the whole scan re-plans under the fresh route."""
+        return self._retry_stale(
+            "scan", lambda: self._scan_batches_once(
+                projection=projection, time_range=time_range,
+                limit=limit, filters=filters))
+
+    def _scan_batches_once(self, projection: Optional[Sequence[str]] = None,
+                           time_range=None, limit: Optional[int] = None,
+                           filters: Optional[Sequence] = None) -> list:
+        """One pruned parallel scan pass. `filters` are the statement's
+        WHERE conjuncts (query/engine.py): they prune regions here, and
+        the pushable tag subset also ships over the wire so datanodes
+        drop dead rows before they ever cross a socket. `limit` travels
+        only when the shipped subset IS the whole predicate — otherwise a
         frontend-side re-filter could leave fewer than `limit` rows."""
         from ..mito.engine import pushable_tag_filter
         filters = list(filters or ())
@@ -434,22 +582,30 @@ class DistTable(Table):
         """(survivors, total, targets) for an aggregate plan, memoized
         on the plan object — try_execute asks for the dispatch string
         (scatter_describe) right before execute_tpu_plan runs the same
-        plan, and the route walk should happen once."""
+        plan, and the route walk should happen once. Keyed on the route
+        version too: a stale-route refresh mid-statement must re-plan
+        instead of re-using a scatter over regions that just moved."""
         cached = getattr(plan, "_dist_scatter_cache", None)
-        if cached is not None and cached[0] is self:
-            return cached[1]
+        if cached is not None and cached[0] is self and \
+                cached[1] == self.route.version:
+            return cached[2]
         survivors, total = self._prune_regions(
             filters=plan.tag_predicates, time_lo=plan.time_lo,
             time_hi=plan.time_hi)
         targets = self._owners_for(survivors)
         result = (survivors, total, targets)
-        plan._dist_scatter_cache = (self, result)
+        plan._dist_scatter_cache = (self, self.route.version, result)
         return result
 
     def execute_tpu_plan(self, plan) -> List[pd.DataFrame]:
         """Aggregate pushdown: prune regions by the plan's tag/time
         predicates, then each surviving datanode reduces ONLY its
-        surviving regions on device; moment frames fold as they arrive."""
+        surviving regions on device; moment frames fold as they arrive.
+        Stale routes re-plan + retry like the scan path."""
+        return self._retry_stale(
+            "aggregate", lambda: self._execute_tpu_plan_once(plan))
+
+    def _execute_tpu_plan_once(self, plan) -> List[pd.DataFrame]:
         survivors, total, targets = self._plan_scatter(plan)
         self._record_scatter(len(survivors), total, len(targets))
         frames: List[pd.DataFrame] = []
@@ -476,13 +632,15 @@ class DistTable(Table):
     def flush(self) -> None:
         """Flush every datanode's regions concurrently (the serial loop
         used to pay the sum of N datanode flushes)."""
-        for _ in self._scatter(
-                self._owners_for(self._all_region_numbers()),
-                lambda c, regs: c.flush_table(
-                    self.info.catalog_name, self.info.schema_name,
-                    self.info.name),
-                what="flush_table"):
-            pass
+        def once():
+            for _ in self._scatter(
+                    self._owners_for(self._all_region_numbers()),
+                    lambda c, regs: c.flush_table(
+                        self.info.catalog_name, self.info.schema_name,
+                        self.info.name),
+                    what="flush_table"):
+                pass
+        self._retry_stale("flush", once)
 
 
 class _RouteHydratingCatalog(MemoryCatalogManager):
@@ -647,7 +805,8 @@ class DistInstance:
         # can materialize regions on datanodes that never saw the DDL
         if hasattr(self.meta, "put_table_info"):
             self.meta.put_table_info(full, info.to_dict())
-        table = DistTable(info, rule, route, self.clients)
+        table = DistTable(info, rule, route, self.clients,
+                          meta=self.meta)
         self.catalog.register_table(catalog, schema_name, table_name, table)
         return table
 
@@ -704,7 +863,8 @@ class DistInstance:
                     next_column_id=info.meta.next_column_id,
                     options=dict(info.meta.options)),
                 catalog_name=catalog, schema_name=schema_name)
-            table = DistTable(info, rule, route, self.clients)
+            table = DistTable(info, rule, route, self.clients,
+                          meta=self.meta)
             self.catalog.register_table(catalog, schema_name, name, table)
             return table
         return None
@@ -757,7 +917,8 @@ class DistInstance:
                                engine="mito", region_numbers=[0],
                                next_column_id=len(schema)),
                 catalog_name=catalog, schema_name=schema_name)
-            table = DistTable(info, None, route, self.clients)
+            table = DistTable(info, None, route, self.clients,
+                              meta=self.meta)
             self.catalog.register_table(catalog, schema_name, table_name,
                                         table)
         else:
@@ -905,14 +1066,48 @@ class DistInstance:
             from .statement import show_flows_output
             return show_flows_output(self.flow_manager, stmt, ctx)
         if isinstance(stmt, ast.SetVariable):
-            # session/process knobs (SET dist_fanout, failpoint_*, ...)
-            # work on a cluster router too — one shared handler
+            # balancer knobs forward to meta-srv (the balancer lives on
+            # the meta leader); everything else is the shared handler
+            name = stmt.name.lower()
+            if name.startswith("balancer_") and \
+                    hasattr(self.meta, "balancer_configure"):
+                from ..query.output import Output as _Output
+                self.meta.balancer_configure(
+                    name[len("balancer_"):], stmt.value)
+                return _Output.rows(0)
             from .statement import apply_set_variable
             return apply_set_variable(stmt, ctx)
         if isinstance(stmt, ast.Kill):
             from .statement import apply_kill
             return apply_kill(stmt)
+        if isinstance(stmt, ast.Admin):
+            return self._admin(stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
+
+    def _admin(self, stmt: ast.Admin, ctx: QueryContext):
+        """ADMIN MIGRATE/SPLIT/REBALANCE → meta balancer ops. Async by
+        design (the reference's migrate_region returns a procedure id):
+        the returned op id tracks progress in region_peers."""
+        from .statement import admin_ops_output
+        if stmt.kind == "rebalance":
+            full = None
+            if stmt.table is not None:
+                catalog, schema_name, name = ctx.resolve(stmt.table)
+                full = f"{catalog}.{schema_name}.{name}"
+            return admin_ops_output(self.meta.admin_rebalance(full))
+        catalog, schema_name, name = ctx.resolve(stmt.table)
+        full = f"{catalog}.{schema_name}.{name}"
+        if self._resolve_table(catalog, schema_name, name) is None:
+            raise TableNotFoundError(f"table {name!r} not found")
+        if stmt.kind == "migrate_region":
+            op = self.meta.admin_migrate_region(full, stmt.region,
+                                                stmt.target_node)
+        elif stmt.kind == "split_region":
+            op = self.meta.admin_split_region(full, stmt.region,
+                                              stmt.at_value)
+        else:
+            raise UnsupportedError(f"ADMIN {stmt.kind}")
+        return admin_ops_output([op])
 
     def _insert(self, stmt: ast.Insert, ctx: QueryContext):
         from ..query.output import Output

@@ -175,6 +175,13 @@ class FrontendInstance:
         if isinstance(stmt, ast.Kill):
             from .statement import apply_kill
             return apply_kill(stmt)
+        if isinstance(stmt, ast.Admin):
+            # region placement is a cluster concept: standalone's single
+            # implicit node has nothing to migrate/split between
+            from ..errors import UnsupportedError
+            raise UnsupportedError(
+                "ADMIN region operations require a distributed "
+                "deployment (metasrv + datanodes)")
         if isinstance(stmt, ast.Copy):
             return ex.copy(stmt, ctx)
         if isinstance(stmt, ast.Tql):

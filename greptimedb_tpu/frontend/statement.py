@@ -177,6 +177,41 @@ def _int_setting(stmt: ast.SetVariable) -> int:
             f"SET {stmt.name}: expected an integer, got {stmt.value!r}")
 
 
+def admin_ops_output(ops: List[dict]) -> Output:
+    """Render ADMIN MIGRATE/SPLIT/REBALANCE results: one row per enqueued
+    balancer operation (async — the op id is the tracking handle;
+    information_schema.region_peers shows live state)."""
+    from ..datatypes import data_type as dt
+    from ..datatypes.record_batch import RecordBatch
+    from ..datatypes.schema import Schema as _Schema
+
+    def detail(op: dict) -> str:
+        if op["kind"] == "migrate":
+            return f"dn{op['from_node']} -> dn{op['to_node']}"
+        d = f"children={op['children']}"
+        if op.get("at_value") is not None:
+            d += f" at={op['at_value']!r}"
+        return d
+
+    schema = _Schema([
+        ColumnSchema("op_id", dt.STRING),
+        ColumnSchema("kind", dt.STRING),
+        ColumnSchema("table_name", dt.STRING),
+        ColumnSchema("region", dt.INT64),
+        ColumnSchema("detail", dt.STRING),
+        ColumnSchema("state", dt.STRING),
+    ])
+    rb = RecordBatch.from_pydict(schema, {
+        "op_id": [op["id"] for op in ops],
+        "kind": [op["kind"] for op in ops],
+        "table_name": [op["table"] for op in ops],
+        "region": [op["region"] for op in ops],
+        "detail": [detail(op) for op in ops],
+        "state": [op["state"] for op in ops],
+    })
+    return Output.record_batches([rb], schema)
+
+
 def apply_kill(stmt: ast.Kill) -> Output:
     """Shared KILL handler: trip the cancel event of a running statement
     in the process-wide registry. The killed statement raises
@@ -283,6 +318,13 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
         # 0 disables the sweep)
         from ..monitor import scraper
         scraper.configure_retention(_int_setting(stmt))
+    elif name.startswith("balancer_"):
+        # elastic-region balancer knobs live in meta-srv; the distributed
+        # frontend intercepts and forwards them BEFORE this shared
+        # handler, so reaching here means a standalone deployment
+        raise InvalidArgumentsError(
+            f"SET {stmt.name}: balancer knobs apply to a distributed "
+            f"cluster (standalone has no region balancer)")
     elif name in _CLIENT_COMPAT_VARS or name.startswith("@"):
         # connection boilerplate from wire clients: accepted, ignored
         pass

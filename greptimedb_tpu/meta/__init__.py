@@ -3,6 +3,7 @@
 Reference behavior: src/meta-srv + src/meta-client (see service.py).
 """
 
+from .balancer import RegionBalancer
 from .failure_detector import PhiAccrualFailureDetector
 from .kv import MemKv
 from .service import (
@@ -13,5 +14,5 @@ from .service import (
 __all__ = [
     "DatanodeStat", "HeartbeatResponse", "MemKv", "MetaClient", "MetaSrv",
     "NoAliveDatanodeError", "Peer", "PhiAccrualFailureDetector",
-    "RegionRoute", "TableRoute",
+    "RegionBalancer", "RegionRoute", "TableRoute",
 ]

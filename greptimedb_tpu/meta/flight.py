@@ -112,6 +112,47 @@ class FlightMetaServer(flight.FlightServerBase):
                     from .replication import NotLeaderError
                     raise NotLeaderError(self.raft_node.leader_id)
                 resp = {"ok": True, "rows": self.srv.region_heat()}
+            elif kind == "region_peers":
+                # leader-only like cluster_info: lease state + balancer
+                # op state are leader-local memory
+                if self.raft_node is not None \
+                        and not self.raft_node.is_leader:
+                    from .replication import NotLeaderError
+                    raise NotLeaderError(self.raft_node.leader_id)
+                resp = {"ok": True, "rows": self.srv.region_peers()}
+            elif kind in ("admin_migrate_region", "admin_split_region",
+                          "admin_rebalance", "balancer_ack",
+                          "balancer_configure"):
+                # balancer surface: ops mutate routes / consume leader-
+                # local acks, so only the leader may run them
+                if self.raft_node is not None \
+                        and not self.raft_node.is_leader:
+                    from .replication import NotLeaderError
+                    raise NotLeaderError(self.raft_node.leader_id)
+                if kind == "admin_migrate_region":
+                    resp = {"ok": True,
+                            "op": self.srv.admin_migrate_region(
+                                body["name"], body["region"],
+                                body["to_node"])}
+                elif kind == "admin_split_region":
+                    resp = {"ok": True,
+                            "op": self.srv.admin_split_region(
+                                body["name"], body["region"],
+                                body.get("at_value"))}
+                elif kind == "admin_rebalance":
+                    resp = {"ok": True,
+                            "ops": self.srv.admin_rebalance(
+                                body.get("name"))}
+                elif kind == "balancer_configure":
+                    self.srv.balancer.configure(body["knob"],
+                                                body["value"])
+                    resp = {"ok": True}
+                else:
+                    self.srv.balancer_ack(
+                        body["node_id"], body["op_id"], body["step"],
+                        body["ok"], body.get("error"),
+                        body.get("payload") or {})
+                    resp = {"ok": True}
             elif kind == "list_datanodes":
                 peers = self.srv.alive_datanodes() \
                     if body.get("alive_only", True) else self.srv.peers()
@@ -246,6 +287,34 @@ class FlightMetaClient:
     def list_datanodes(self, alive_only: bool = True) -> List[Peer]:
         resp = self._action("list_datanodes", {"alive_only": alive_only})
         return [Peer.from_dict(p) for p in resp["peers"]]
+
+    # ---- elastic region balancer surface ----
+    def region_peers(self) -> List[dict]:
+        return self._action("region_peers", {})["rows"]
+
+    def admin_migrate_region(self, full_name: str, region: int,
+                             to_node: int) -> dict:
+        return self._action("admin_migrate_region", {
+            "name": full_name, "region": region, "to_node": to_node})["op"]
+
+    def admin_split_region(self, full_name: str, region: int,
+                           at_value=None) -> dict:
+        return self._action("admin_split_region", {
+            "name": full_name, "region": region,
+            "at_value": at_value})["op"]
+
+    def admin_rebalance(self, full_name: Optional[str] = None
+                        ) -> List[dict]:
+        return self._action("admin_rebalance", {"name": full_name})["ops"]
+
+    def balancer_configure(self, knob: str, value) -> None:
+        self._action("balancer_configure", {"knob": knob, "value": value})
+
+    def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
+                     error: Optional[str], payload: dict) -> None:
+        self._action("balancer_ack", {
+            "node_id": node_id, "op_id": op_id, "step": step, "ok": ok,
+            "error": error, "payload": payload or {}})
 
     # generic kv passthroughs (KvFlowStore persists flow specs under
     # __flow/ — without these a WIRE frontend crashed at start trying

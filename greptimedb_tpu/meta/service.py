@@ -56,6 +56,10 @@ class TableRoute:
     table_id: int
     table_name: str                    # catalog.schema.table
     region_routes: List[RegionRoute] = field(default_factory=list)
+    #: bumped on EVERY placement mutation (failover, migrate commit,
+    #: split commit) — frontends compare it after a StaleRouteError
+    #: refresh to tell "the route moved" from "still mid-handoff"
+    version: int = 0
 
     def regions_on(self, peer_id: int) -> List[int]:
         return [r.region_number for r in self.region_routes
@@ -69,13 +73,15 @@ class TableRoute:
 
     def to_dict(self) -> dict:
         return {"table_id": self.table_id, "table_name": self.table_name,
-                "region_routes": [r.to_dict() for r in self.region_routes]}
+                "region_routes": [r.to_dict() for r in self.region_routes],
+                "version": self.version}
 
     @staticmethod
     def from_dict(d: dict) -> "TableRoute":
         return TableRoute(d["table_id"], d["table_name"],
                           [RegionRoute.from_dict(r)
-                           for r in d["region_routes"]])
+                           for r in d["region_routes"]],
+                          version=int(d.get("version", 0)))
 
 
 @dataclass
@@ -142,6 +148,11 @@ class MetaSrv:
         # regions (split-brain: the old leaders keep serving writes). Treat
         # process start as the last-seen time for unseen persisted peers.
         self._start_time = time.time()
+        # elastic region control loop (split / migrate / rebalance): the
+        # op state machines persist under __balancer/ in the same KV, so
+        # a metasrv restart resumes them (meta/balancer.py)
+        from .balancer import RegionBalancer
+        self.balancer = RegionBalancer(self)
 
     # ---- membership ----
     def register_datanode(self, peer: Peer) -> None:
@@ -154,6 +165,10 @@ class MetaSrv:
     def peers(self) -> List[Peer]:
         return [Peer.from_dict(json.loads(v))
                 for _, v in self.kv.range(PEER_PREFIX)]
+
+    def peer(self, node_id: int) -> Optional[Peer]:
+        raw = self.kv.get(f"{PEER_PREFIX}{node_id}")
+        return Peer.from_dict(json.loads(raw)) if raw is not None else None
 
     def alive_datanodes(self, now: Optional[float] = None) -> List[Peer]:
         now = time.time() if now is None else now
@@ -393,6 +408,60 @@ class MetaSrv:
                 })
         return rows
 
+    # ---- elastic region admin (ADMIN MIGRATE/SPLIT/REBALANCE route
+    # here through the frontends; meta/balancer.py runs the state
+    # machines) ----
+    def admin_migrate_region(self, full_table_name: str, region: int,
+                             to_node: int) -> dict:
+        return self.balancer.migrate(full_table_name, region, to_node)
+
+    def admin_split_region(self, full_table_name: str, region: int,
+                           at_value=None) -> dict:
+        return self.balancer.split(full_table_name, region,
+                                   at_value=at_value)
+
+    def admin_rebalance(self, full_table_name: Optional[str] = None
+                        ) -> List[dict]:
+        return self.balancer.rebalance(full_table_name)
+
+    def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
+                     error: Optional[str], payload: dict) -> None:
+        self.balancer.handle_ack(node_id, op_id, step, ok, error, payload)
+
+    def region_peers(self, now: Optional[float] = None) -> List[dict]:
+        """One row per (table, region): placement + lease state of the
+        hosting node + any in-flight balancer operation touching it —
+        the information_schema.region_peers feed."""
+        now = time.time() if now is None else now
+        states = {r["peer_id"]: r["lease_state"]
+                  for r in self.cluster_info(now)}
+        addrs = {p.id: p.addr for p in self.peers()}
+        ops_by_region: Dict[tuple, dict] = {}
+        for op in self.balancer.ops():
+            ops_by_region[(op["table"], op["region"])] = op
+            for child in op.get("children") or []:
+                ops_by_region.setdefault((op["table"], child), op)
+        rows: List[dict] = []
+        for route in self.all_table_routes():
+            for rr in sorted(route.region_routes,
+                             key=lambda r: r.region_number):
+                op = ops_by_region.get(
+                    (route.table_name, rr.region_number))
+                rows.append({
+                    "table_name": route.table_name,
+                    "region_number": rr.region_number,
+                    "peer_id": rr.leader.id,
+                    "peer_addr": addrs.get(rr.leader.id, rr.leader.addr),
+                    "is_leader": "Yes",
+                    "status": states.get(rr.leader.id, "unknown").upper(),
+                    "route_version": route.version,
+                    "operation": f"{op['kind']}:{op['state']}"
+                    if op is not None else None,
+                    "op_id": op["id"] if op is not None else None,
+                })
+        rows.sort(key=lambda r: (r["table_name"], r["region_number"]))
+        return rows
+
     # ---- region failover (the action the reference leaves TODO,
     # meta-srv/src/handler/failure_handler/runner.rs:132; design per
     # docs/rfcs/2023-03-08-region-fault-tolerance.md: region data lives
@@ -415,8 +484,15 @@ class MetaSrv:
             return []
         load = {p.id: self._stats.get(p.id, DatanodeStat()).region_count
                 for p in alive}
+        # tables mid-balancer-op are off limits: re-placing a region the
+        # balancer is migrating would dual-own it (both paths rewrite the
+        # route); the op finishes or times out into a rollback first, and
+        # a truly dead source is caught by the NEXT failover pass
+        busy_tables = {o["table"] for o in self.balancer.ops()}
         moves: List[dict] = []
         for route in self.all_table_routes():
+            if route.table_name in busy_tables:
+                continue
             lost = [rr for rr in route.region_routes
                     if rr.leader.id in dead]
             if not lost:
@@ -432,7 +508,8 @@ class MetaSrv:
                 moves.append({"table": route.table_name,
                               "region": rr.region_number,
                               "from": old.id, "to": target.id})
-            self.kv.put(f"{ROUTE_PREFIX}{route.table_name}",
+            route.version += 1     # placement changed: stale frontends
+            self.kv.put(f"{ROUTE_PREFIX}{route.table_name}",  # must refresh
                         json.dumps(route.to_dict()).encode())
             info = self.table_info(route.table_name)
             catalog, schema_name, tname = route.table_name.split(".", 2)
@@ -482,6 +559,28 @@ class MetaClient:
 
     def region_heat(self) -> List[dict]:
         return self._srv.region_heat()
+
+    def region_peers(self) -> List[dict]:
+        return self._srv.region_peers()
+
+    def admin_migrate_region(self, full_name: str, region: int,
+                             to_node: int) -> dict:
+        return self._srv.admin_migrate_region(full_name, region, to_node)
+
+    def admin_split_region(self, full_name: str, region: int,
+                           at_value=None) -> dict:
+        return self._srv.admin_split_region(full_name, region, at_value)
+
+    def admin_rebalance(self, full_name: Optional[str] = None
+                        ) -> List[dict]:
+        return self._srv.admin_rebalance(full_name)
+
+    def balancer_configure(self, knob: str, value) -> None:
+        self._srv.balancer.configure(knob, value)
+
+    def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
+                     error: Optional[str], payload: dict) -> None:
+        self._srv.balancer_ack(node_id, op_id, step, ok, error, payload)
 
     def put_table_info(self, full_name: str, info: dict) -> None:
         self._srv.put_table_info(full_name, info)

@@ -99,6 +99,13 @@ def describe_table(engine, stmt, ctx: QueryContext) -> Output:
 
 def show_create_table(engine, stmt, ctx: QueryContext) -> Output:
     table = engine.resolve_table(stmt.table, ctx)
+    # elastic regions refine partition rules AFTER create (balancer
+    # split): a distributed table re-pulls its rule from meta so the
+    # rendered PARTITION clause matches the live layout — the data path
+    # refreshes on StaleRouteError, but SHOW CREATE never scans
+    refresh = getattr(table, "refresh_route", None)
+    if callable(refresh):
+        refresh()
     info = table.info
     lines = [f"CREATE TABLE IF NOT EXISTS {info.name} ("]
     defs = []

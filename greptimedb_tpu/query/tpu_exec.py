@@ -908,6 +908,15 @@ def region_moment_frames(table, plan: TpuPlan,
         regions = list(table.regions.values())
     else:
         want = set(regions)
+        missing = want - set(table.regions)
+        if missing:
+            # a pruned aggregate naming regions this node no longer hosts
+            # must not silently reduce a partial set — typed so the
+            # DistTable refreshes its route and retries
+            from ..errors import StaleRouteError
+            raise StaleRouteError(
+                f"region(s) {sorted(missing)} of table "
+                f"{table.info.name} are not hosted here")
         regions = [r for rn, r in table.regions.items() if rn in want]
     if not regions:
         return []

@@ -22,7 +22,7 @@ __all__ = [
     "DropColumn", "RenameTable", "ShowDatabases", "ShowTables",
     "ShowCreateTable", "DescribeTable", "ShowVariable", "Use", "Tql", "Copy",
     "Explain", "SetVariable", "TruncateTable", "ObjectName",
-    "CreateFlow", "DropFlow", "ShowFlows",
+    "CreateFlow", "DropFlow", "ShowFlows", "Admin",
 ]
 
 
@@ -406,6 +406,21 @@ class Kill(Statement):
     """KILL [QUERY] <id> — cooperative cancellation of a running
     statement from information_schema.processes / SHOW PROCESSLIST."""
     process_id: int = 0
+
+
+@dataclass
+class Admin(Statement):
+    """Elastic region administration (meta balancer surface):
+
+    - ``ADMIN MIGRATE REGION <table> <region> TO <node_id>``
+    - ``ADMIN SPLIT REGION <table> <region> [AT <literal>]``
+    - ``ADMIN REBALANCE [TABLE <table>]``
+    """
+    kind: str = ""                  # migrate_region | split_region | rebalance
+    table: Optional[ObjectName] = None
+    region: Optional[int] = None
+    target_node: Optional[int] = None
+    at_value: Any = None
 
 
 @dataclass

@@ -12,7 +12,8 @@ from typing import Any, List, Optional, Tuple
 
 from .ast import *  # noqa: F401,F403
 from .ast import (
-    AddColumn, AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef,
+    AddColumn, Admin, AlterTable, Between, BinaryOp, Case, Cast, Column,
+    ColumnDef,
     Copy, CreateDatabase, CreateFlow, CreateTable, Delete, DescribeTable,
     DropColumn, DropDatabase, DropFlow, DropTable, Explain, Expr,
     FunctionCall, InList, Insert, Interval, IsNull, Join, Kill, Literal,
@@ -268,7 +269,47 @@ class Parser:
             return TruncateTable(name=self.parse_object_name())
         if kw == "KILL":
             return self.parse_kill()
+        if kw == "ADMIN":
+            return self.parse_admin()
         raise ParserError(f"unsupported statement start: {t.value!r} at {t.pos}")
+
+    def parse_admin(self) -> Admin:
+        """Elastic region administration:
+
+        - ADMIN MIGRATE REGION <table> <region> TO <node_id>
+        - ADMIN SPLIT REGION <table> <region> [AT <literal>]
+        - ADMIN REBALANCE [TABLE <table>]
+        """
+        self.expect_kw("ADMIN")
+        if self.match_kw("REBALANCE"):
+            table = None
+            if self.match_kw("TABLE"):
+                table = self.parse_object_name()
+            return Admin(kind="rebalance", table=table)
+        if self.match_kw("MIGRATE"):
+            self.expect_kw("REGION")
+            table = self.parse_object_name()
+            region = self._parse_int("region number")
+            self.expect_kw("TO")
+            target = self._parse_int("target datanode id")
+            return Admin(kind="migrate_region", table=table,
+                         region=region, target_node=target)
+        if self.match_kw("SPLIT"):
+            self.expect_kw("REGION")
+            table = self.parse_object_name()
+            region = self._parse_int("region number")
+            at_value = None
+            if self.match_kw("AT"):
+                at_value = self._parse_literal_value()
+                if at_value is None:
+                    raise ParserError("ADMIN SPLIT ... AT needs a "
+                                      "concrete literal, not NULL")
+            return Admin(kind="split_region", table=table, region=region,
+                         at_value=at_value)
+        t = self.peek()
+        raise ParserError(
+            f"expected MIGRATE REGION / SPLIT REGION / REBALANCE after "
+            f"ADMIN, found {t.value!r} at {t.pos}")
 
     def parse_kill(self) -> Kill:
         """KILL [QUERY] <id> — the id is the `id` column of

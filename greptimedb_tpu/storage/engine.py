@@ -23,6 +23,10 @@ from .wal import NoopWal
 @dataclass
 class EngineConfig:
     data_home: str
+    #: WAL root; defaults to <data_home>/wal. Distributed datanodes
+    #: sharing one data_home (shared object store) MUST scope this per
+    #: node: the WAL and the region fence marker are node-local state
+    wal_home: Optional[str] = None
     flush_size_bytes: int = 64 * 1024 * 1024
     wal_sync_on_write: bool = False
     wal_backend: str = "auto"           # auto | native | python
@@ -58,7 +62,8 @@ class StorageEngine:
             store = RetryingObjectStore(
                 FsObjectStore(os.path.join(config.data_home, "data")))
         self.store = store
-        self.wal_home = os.path.join(config.data_home, "wal")
+        self.wal_home = config.wal_home or \
+            os.path.join(config.data_home, "wal")
         self._regions: Dict[str, Region] = {}
         self._lock = TrackedLock("storage.engine")
         self.scheduler = LocalScheduler(max_inflight=config.bg_workers,
@@ -76,7 +81,10 @@ class StorageEngine:
 
     def _ttl_sweep(self) -> None:
         for region in self.list_regions().values():
-            if region.ttl_ms is not None and not region.closed:
+            # fenced regions are mid-handoff: their shared dir belongs to
+            # the adopting node, so no manifest edits from this process
+            if region.ttl_ms is not None and not region.closed \
+                    and not region.fenced:
                 region.apply_ttl()
                 if region.version_control.current.ssts.levels[0]:
                     region.schedule_compaction()
@@ -144,6 +152,17 @@ class StorageEngine:
             region = self._regions.pop(name, None)
         if region is not None:
             region.drop()
+
+    def release_region(self, name: str) -> bool:
+        """Drop the in-process region WITHOUT touching its shared data —
+        the migrated region's new owner serves it now. Returns whether
+        this engine actually hosted it."""
+        with self._lock:
+            region = self._regions.pop(name, None)
+        if region is None:
+            return False
+        region.release()
+        return True
 
     def list_regions(self) -> Dict[str, Region]:
         with self._lock:

@@ -48,6 +48,13 @@ _fp.register("bulk_commit")
 _fp.register("compaction_commit")
 _fp.register("dict_persist")
 _fp.register("region_write_memtable")
+_fp.register("balancer_wal_tail_replay")
+_fp.register("balancer_handoff_fence")
+
+#: node-local fence marker (lives in the region's WAL dir, NOT on the
+#: shared object store: the fence is about THIS node's serving state —
+#: the adopting node must open the same shared region dir writable)
+FENCE_MARKER = "FENCED"
 
 
 @dataclass
@@ -358,6 +365,12 @@ class Region:
         # bumped whenever committed data is *retracted* (TTL expiry) rather
         # than superseded — incremental scan caches must rebuild then
         self.retraction_epoch = 0
+        # elastic-region handoff fence: a fenced region rejects writes
+        # with StaleRouteError and suppresses flush/compaction so the
+        # adopting node's view of the shared region dir stays stable.
+        # Persisted as a node-local marker file so a restart mid-handoff
+        # cannot resurrect an unfenced old owner (see fence()).
+        self.fenced = False
         self._writer_lock = TrackedRLock("storage.region_writer")
         if wal is not None:
             self.wal = wal
@@ -479,6 +492,14 @@ class Region:
         if region.sweep_orphans:
             region._sweep_orphan_ssts()
         region._replay_wal(flushed_sequence)
+        import os as _os
+        if _os.path.exists(region._fence_marker_path()):
+            # this node fenced the region mid-handoff and then restarted:
+            # it must come back fenced (an unfenced resurrection could
+            # ack writes the migration target will never see)
+            region.fenced = True
+            logger.warning("region %s reopened FENCED (handoff marker "
+                           "present)", region.name)
         return region
 
     def _sweep_orphan_ssts(self) -> int:
@@ -547,6 +568,10 @@ class Region:
         with timer("region_write"), self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
+            if self.fenced:
+                from ..errors import StaleRouteError
+                raise StaleRouteError(
+                    f"region {self.name} is fenced for migration")
             vc = self.version_control
             seq = vc.next_sequence()
             with timer("wal_append"):
@@ -637,6 +662,10 @@ class Region:
             n_in = len(next(iter(data.values()))) if data else 0
             chunk_rows = max(2_000_000, -(-n_in // cpus))
 
+        if self.fenced:
+            from ..errors import StaleRouteError
+            raise StaleRouteError(
+                f"region {self.name} is fenced for migration")
         vc = self.version_control
         schema0 = vc.current.schema
         # all-ndarray batches skip the WriteBatch/Vector coercion (string
@@ -667,6 +696,14 @@ class Region:
         with self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
+            if self.fenced:
+                # RE-checked under the lock: the early check races the
+                # fence — a bulk commit slipping past it would land rows
+                # in neither the pre-fence flush nor the shipped WAL
+                # tail (acked-write loss across the migration)
+                from ..errors import StaleRouteError
+                raise StaleRouteError(
+                    f"region {self.name} is fenced for migration")
             schema = vc.current.schema
             seq = vc.next_sequence()
             vc.set_committed_sequence(seq)
@@ -893,6 +930,10 @@ class Region:
         """Flush all frozen + mutable data to L0 SSTs and wait for
         completion (reference: src/storage/src/flush.rs FlushJob). The
         write path instead schedules `_flush_job` asynchronously."""
+        if self.fenced:
+            # mid-handoff: the shared manifest belongs to the adopting
+            # node; the WAL tail already shipped everything unflushed
+            return []
         if self.scheduler is None:
             with self._writer_lock:
                 vc = self.version_control
@@ -911,7 +952,7 @@ class Region:
         # flush whose failure is swallowed for retry — a synchronous flush
         # must not report success while the memtables it froze are still
         # unflushed (callers like /v1/admin/flush rely on the contract)
-        if not self.closed and frozen & {
+        if not self.closed and not self.fenced and frozen & {
                 m.id for m in
                 self.version_control.current.memtables.immutables}:
             last = self.bg_errors.get("flush", {}).get("last_error",
@@ -934,10 +975,13 @@ class Region:
 
     def _flush_job_inner(self) -> List[FileMeta]:
         from ..common.telemetry import increment_counter, span, timer
-        if self.closed:
+        if self.closed or self.fenced:
             # a delayed retry may fire after DROP destroyed the region
             # dir: writing SSTs there would leak files forever (a dropped
-            # region never reopens, so no sweep collects them)
+            # region never reopens, so no sweep collects them). A FENCED
+            # region's manifest belongs to the adopting node now — its
+            # WAL tail already shipped, so flushing it here would race
+            # the new owner's manifest edits with duplicate data.
             return []
         vc = self.version_control
         to_flush = list(vc.current.memtables.immutables)
@@ -1101,7 +1145,9 @@ class Region:
     def _compact_job(self, min_l0_files: Optional[int] = None,
                      now_ms: Optional[int] = None) -> List[FileMeta]:
         from .compaction import pick_compaction, run_compaction
-        if self.closed:
+        if self.closed or self.fenced:
+            # fenced: the shared region dir belongs to the adopting node;
+            # a compaction here would purge files its manifest references
             return []
         plan = pick_compaction(
             self.version_control.current.ssts, ttl_ms=self.ttl_ms,
@@ -1191,6 +1237,101 @@ class Region:
     def snapshot(self) -> RegionSnapshot:
         vc = self.version_control
         return RegionSnapshot(self, vc.current, vc.committed_sequence)
+
+    # ---- elastic handoff (meta/balancer.py drives these) ----
+    def _fence_marker_path(self) -> str:
+        import os as _os
+        return _os.path.join(self.descriptor.wal_dir, FENCE_MARKER)
+
+    def fence(self) -> None:
+        """Stop accepting writes, durably: the marker file (node-local,
+        next to the WAL) survives a restart, so a crashed-and-reopened
+        old owner cannot ack a write the migration target never sees.
+        Waits out any in-flight flush so the shared manifest is quiescent
+        before the caller reads the WAL tail."""
+        import os as _os
+        from ..utils import atomic_write
+        with self._writer_lock:
+            if self.fenced:
+                return
+            _os.makedirs(self.descriptor.wal_dir, exist_ok=True)
+            atomic_write(self._fence_marker_path(), "fenced\n",
+                         tmp_prefix=".fence-")
+            self.fenced = True
+            # crash HERE (torture): the marker is durable, so the reopened
+            # region comes back fenced and the balancer resumes the step
+            _fp.fail_point("balancer_handoff_fence")
+        # outside the writer lock: the flush worker needs it to commit
+        self._flush_done.wait(timeout=60)
+        logger.info("region %s fenced for handoff", self.name)
+
+    def unfence(self) -> None:
+        """Roll back a fence (aborted migration)."""
+        import os as _os
+        with self._writer_lock:
+            try:
+                _os.remove(self._fence_marker_path())
+            except FileNotFoundError:
+                pass
+            self.fenced = False
+        logger.info("region %s unfenced (handoff rolled back)", self.name)
+
+    def wal_tail(self) -> List[dict]:
+        """Every WAL record past the flushed sequence, wire-encodable —
+        the delta the migration target replays on top of the shared
+        object store's last-flushed state. Call only on a FENCED region
+        (the tail must be final)."""
+        import base64
+        flushed = self.version_control.current.flushed_sequence
+        out: List[dict] = []
+        for seq, schema_version, payload in self.wal.read_from(flushed + 1):
+            if seq <= flushed:
+                continue
+            out.append({"seq": int(seq), "schema_version": schema_version,
+                        "payload": base64.b64encode(payload).decode()})
+        return out
+
+    def ingest_wal_tail(self, entries: List[dict]) -> int:
+        """Replay a shipped WAL tail into this (adopted) region: each
+        record appends to the LOCAL WAL for durability, then lands in
+        the memtable at its ORIGINAL sequence so MVCC ordering matches
+        the source exactly. Idempotent: records at or below the committed
+        sequence are skipped, so a crash mid-replay resumes cleanly."""
+        import base64
+        replayed = 0
+        with self._writer_lock:
+            if self.closed:
+                raise StorageError(f"region {self.name} closed")
+            vc = self.version_control
+            for e in entries:
+                seq = int(e["seq"])
+                if seq <= vc.committed_sequence:
+                    continue
+                _fp.fail_point("balancer_wal_tail_replay")
+                payload = base64.b64decode(e["payload"])
+                self.wal.append(
+                    seq, payload,
+                    schema_version=int(e.get("schema_version") or 0))
+                wb = WriteBatch.decode(payload, vc.current.schema)
+                vc.current.memtables.mutable.write(seq, wb)
+                vc.set_committed_sequence(seq)
+                replayed += 1
+        if replayed:
+            logger.info("region %s replayed %d shipped WAL tail record(s)",
+                        self.name, replayed)
+        return replayed
+
+    def release(self) -> None:
+        """Hand the region off: close WITHOUT flushing (the new owner
+        already has everything — last-flushed SSTs plus the shipped WAL
+        tail) and delete the node-local WAL + fence marker. Shared
+        object-store data is untouched: it belongs to the new owner."""
+        with self._writer_lock:
+            self.closed = True
+            self.wal.close()
+        import shutil
+        shutil.rmtree(self.descriptor.wal_dir, ignore_errors=True)
+        logger.info("region %s released to its new owner", self.name)
 
     # ---- misc ----
     def drop(self) -> None:

@@ -1,0 +1,41 @@
+-- ADMIN MIGRATE REGION: elastic region movement between datanodes.
+-- The op is async (op_id tracks it); the runner pumps the balancer to
+-- completion after each statement, so placement below is settled.
+CREATE TABLE mig (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,
+                  PRIMARY KEY(host))
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h5'),
+  PARTITION r1 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO mig VALUES ('h1', 1000, 1.0), ('h3', 1001, 2.0),
+                       ('h7', 1002, 3.0), ('h9', 1003, 4.0);
+
+-- region 0 starts on dn1 (load-based placement): move it to dn2
+ADMIN MIGRATE REGION mig 0 TO 2;
+
+-- zero acked rows lost or duplicated by the move
+SELECT count(*) AS c, sum(v) AS s FROM mig;
+
+-- placement reflects the migration; no operation is left in flight
+SELECT table_name, region_number, peer_id, is_leader, status, operation
+FROM information_schema.region_peers;
+
+-- writes route to the new owner transparently
+INSERT INTO mig VALUES ('h2', 1004, 5.0);
+
+SELECT count(*) AS c FROM mig WHERE host < 'h5';
+
+-- unknown region / unknown table / no-op target are clean errors
+ADMIN MIGRATE REGION mig 7 TO 2;
+
+ADMIN MIGRATE REGION nope 0 TO 2;
+
+ADMIN MIGRATE REGION mig 1 TO 2;
+
+-- everything ended up on dn2: REBALANCE moves one region back
+ADMIN REBALANCE;
+
+SELECT table_name, region_number, peer_id FROM
+information_schema.region_peers;
+
+DROP TABLE mig;

@@ -1,0 +1,48 @@
+-- information_schema.region_peers: placement + in-flight balancer ops.
+CREATE TABLE rp (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,
+                 PRIMARY KEY(host))
+PARTITION BY RANGE COLUMNS (host) (
+  PARTITION r0 VALUES LESS THAN ('h5'),
+  PARTITION r1 VALUES LESS THAN (MAXVALUE));
+
+INSERT INTO rp VALUES ('h1', 1000, 1.0), ('h2', 1001, 2.0),
+                      ('h6', 1002, 3.0), ('h7', 1003, 4.0),
+                      ('h8', 1004, 5.0);
+
+SELECT table_name, region_number, peer_id, is_leader, status,
+       route_version, operation
+FROM information_schema.region_peers;
+
+-- split the hot upper region at a chosen boundary: the parent region is
+-- replaced by two children and the partition rule refines in place
+ADMIN SPLIT REGION rp 1 AT 'h7';
+
+SELECT table_name, region_number, peer_id, is_leader, status,
+       route_version, operation
+FROM information_schema.region_peers;
+
+-- the refined rule round-trips through the codec and renders correctly
+SHOW CREATE TABLE rp;
+
+-- reads and writes keep answering across the refined layout
+SELECT count(*) AS c, sum(v) AS s FROM rp;
+
+SELECT count(*) AS c FROM rp WHERE host >= 'h7';
+
+INSERT INTO rp VALUES ('h9', 1005, 6.0);
+
+SELECT count(*) AS c FROM rp WHERE host >= 'h7';
+
+-- a hash-partitioned table cannot split one bucket
+CREATE TABLE rph (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,
+                  PRIMARY KEY(host))
+PARTITION BY HASH (host) PARTITIONS 4;
+
+ADMIN SPLIT REGION rph 0;
+
+-- splitting at a value outside the region's range is a clean error
+ADMIN SPLIT REGION rp 0 AT 'h6';
+
+DROP TABLE rp;
+
+DROP TABLE rph;

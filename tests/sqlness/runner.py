@@ -71,7 +71,8 @@ def _strip_comment_lines(stmt: str) -> str:
 #: flow watermark timestamps in SHOW FLOWS / information_schema.flows;
 #: last-seen heartbeat times and dialed addresses in cluster_info)
 _VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>",
-                     "last_seen_ms": "<last_seen>", "peer_addr": "<addr>"}
+                     "last_seen_ms": "<last_seen>", "peer_addr": "<addr>",
+                     "op_id": "<op_id>"}
 
 #: wall-clock fragments inside EXPLAIN ANALYZE detail strings: the
 #: scatter's slowest-node latency, the per-node latency vector, and the
@@ -187,22 +188,44 @@ class _DistEnv:
         from greptimedb_tpu.meta import MetaClient, Peer
         from greptimedb_tpu.meta.kv import MemKv
         from greptimedb_tpu.meta.service import MetaSrv
+        from greptimedb_tpu.storage.object_store import FsObjectStore
         self.datanodes = []
-        srv = MetaSrv(MemKv())
+        self.srv = MetaSrv(MemKv())
+        meta = MetaClient(self.srv)
         clients = {}
+        # ONE shared object store (the elastic-region deployment shape:
+        # migrate/split hand regions between nodes through it); control
+        # state + WAL stay node-scoped
+        shared = FsObjectStore(f"{data_home}/shared")
         for i in (1, 2):
             dn = DatanodeInstance(DatanodeOptions(
                 data_home=f"{data_home}/dn{i}", node_id=i,
-                register_numbers_table=False))
+                register_numbers_table=False), store=shared)
             dn.start()
+            dn.attach_meta(meta)
             self.datanodes.append(dn)
             clients[i] = LocalDatanodeClient(dn)
-            srv.register_datanode(Peer(i, f"dn{i}"))
-            srv.handle_heartbeat(i)
-        self.fe = DistInstance(MetaClient(srv), clients)
+            self.srv.register_datanode(Peer(i, f"dn{i}"))
+            self.srv.handle_heartbeat(i)
+        self.fe = DistInstance(meta, clients)
 
     def do_query(self, sql: str, ctx=None):
-        return self.fe.do_query(sql, ctx)
+        outs = self.fe.do_query(sql, ctx)
+        self._pump_balancer()
+        return outs
+
+    def _pump_balancer(self):
+        """Drive any balancer ops the statement enqueued to completion
+        (the cooperative stand-in for the background tick + heartbeat
+        loops, so ADMIN goldens are deterministic)."""
+        for _ in range(24):
+            if not self.srv.balancer.ops():
+                return
+            self.srv.balancer.tick()
+            for dn in self.datanodes:
+                resp = self.srv.handle_heartbeat(dn.opts.node_id)
+                for msg in resp.mailbox:
+                    dn._handle_mailbox(msg)
 
     def shutdown(self):
         for dn in self.datanodes:
