@@ -3,11 +3,12 @@
 
 PY ?= python
 
-.PHONY: check lint typecheck test test-slow baseline bench
+.PHONY: check lint typecheck test test-slow race baseline bench
 
 check: lint typecheck test
 
-# greptlint: project-invariant static analyzer (rules GL01-GL08).
+# greptlint: project-invariant static analyzer (rules GL01-GL12;
+# GL10-GL12 are interprocedural over the repo-wide call graph).
 # Exit 0 requires a clean scan modulo .greptlint-baseline.json.
 lint:
 	$(PY) -m greptimedb_tpu.devtools.greptlint greptimedb_tpu/
@@ -30,6 +31,16 @@ test:
 test-slow:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  --continue-on-collection-errors -p no:cacheprovider
+
+# greptsan happens-before race detector, focused: the seeded selftest
+# plus the multi-thread hammer (concurrent ingest+flush+compact+
+# scatter+balancer+self-monitor) under an explicit GREPTIME_RACE_CHECK=1.
+# The full `make test` run carries the detector too (auto-on under
+# pytest); this target is the quick iteration loop for concurrency work.
+race:
+	GREPTIME_RACE_CHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
+	  tests/test_greptsan.py tests/test_locks.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Re-record grandfathered findings. Only for CONSCIOUS grandfathering —
 # the tier-1 gate asserts the baseline total only ever shrinks (≤ 10).

@@ -65,10 +65,13 @@ class MemoryCatalogManager(CatalogManager):
     """In-memory catalogs (reference: src/catalog/src/local/memory.rs:592)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
-        self._catalogs: Dict[str, Dict[str, Dict[str, Table]]] = {
-            DEFAULT_CATALOG_NAME: {DEFAULT_SCHEMA_NAME: {}},
-        }
+        from ..common.locks import TrackedRLock
+        from ..common.tracking import tracked_state
+        self._lock = TrackedRLock("catalog.manager")
+        self._catalogs: Dict[str, Dict[str, Dict[str, Table]]] = \
+            tracked_state({
+                DEFAULT_CATALOG_NAME: {DEFAULT_SCHEMA_NAME: {}},
+            }, "catalog.manager.catalogs")
 
     def catalog_names(self) -> List[str]:
         with self._lock:

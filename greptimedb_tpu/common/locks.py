@@ -61,6 +61,15 @@ class IoUnderLockError(LockOrderError):
 
 
 def _env_enabled() -> bool:
+    # greptsan (devtools/greptsan) derives its happens-before edges from
+    # tracked acquire/release events, so forcing the race detector on
+    # forces lock tracking on too — even over an explicit
+    # GREPTIME_LOCK_CHECK=0 (raceless edges would report every
+    # lock-protected access as a data race)
+    r = os.environ.get("GREPTIME_RACE_CHECK")
+    if r is not None and r.strip().lower() not in ("", "0", "false",
+                                                   "off", "no"):
+        return True
     v = os.environ.get("GREPTIME_LOCK_CHECK")
     if v is not None:
         return v.strip().lower() not in ("", "0", "false", "off", "no")
@@ -68,6 +77,19 @@ def _env_enabled() -> bool:
 
 
 _ENABLED: bool = _env_enabled()
+
+#: (on_acquire, on_release) installed by greptsan when the race detector
+#: is enabled — every tracked acquisition/release (including the
+#: Condition wait release/reacquire cycle) reports here so vector clocks
+#: pick up the release->acquire happens-before edge. None otherwise:
+#: one is-None branch on the tracked (test-only) path.
+_RACE_HOOKS: Optional[Tuple] = None
+
+
+def set_race_hooks(on_acquire, on_release) -> None:
+    global _RACE_HOOKS
+    _RACE_HOOKS = (on_acquire, on_release) \
+        if on_acquire is not None else None
 
 #: failpoint sites that sit on blocking-I/O paths; reaching one while an
 #: ``io_ok=False`` lock is held is a bug even when no failpoint is armed
@@ -140,7 +162,7 @@ class _Tracked:
     """Active-mode wrapper. Never constructed when the detector is off —
     the TrackedLock/TrackedRLock factories return raw locks instead."""
 
-    __slots__ = ("_inner", "name", "io_ok", "_reentrant")
+    __slots__ = ("_inner", "name", "io_ok", "_reentrant", "_san_clock")
 
     def __init__(self, inner: Union[threading.Lock, threading.RLock],
                  name: str, io_ok: bool, reentrant: bool):
@@ -148,6 +170,10 @@ class _Tracked:
         self.name = name
         self.io_ok = io_ok
         self._reentrant = reentrant
+        #: greptsan's per-lock vector-clock snapshot (generation, clock);
+        #: read/written only while the lock is held, so the lock itself
+        #: is its synchronization
+        self._san_clock = None
 
     # -- ordering ----------------------------------------------------
     def _check_order(self, held: List["_Tracked"]) -> None:
@@ -195,6 +221,8 @@ class _Tracked:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             held.append(self)
+            if _RACE_HOOKS is not None:
+                _RACE_HOOKS[0](self)
         return ok
 
     def release(self) -> None:
@@ -203,7 +231,9 @@ class _Tracked:
             if held[i] is self:
                 del held[i]
                 break
-        self._inner.release()
+        if _RACE_HOOKS is not None:
+            _RACE_HOOKS[1](self)       # while still holding: the clock
+        self._inner.release()          # publish races with the release
 
     def __enter__(self) -> bool:
         return self.acquire()
@@ -232,6 +262,8 @@ class _Tracked:
             if held[i] is self:
                 del held[i]
                 count += 1
+        if _RACE_HOOKS is not None:
+            _RACE_HOOKS[1](self)       # cond.wait releases: a real edge
         if self._reentrant:
             return (self._inner._release_save(), count)
         self._inner.release()
@@ -244,6 +276,8 @@ class _Tracked:
         else:
             self._inner.acquire()
         _held().extend([self] * count)
+        if _RACE_HOOKS is not None:
+            _RACE_HOOKS[0](self)       # waiter reacquired: join the clock
 
     def __repr__(self) -> str:
         kind = "TrackedRLock" if self._reentrant else "TrackedLock"
@@ -298,3 +332,12 @@ def _install_io_hook() -> None:
 
 if _ENABLED:
     _install_io_hook()
+    # the race detector (devtools/greptsan) decides its own enablement
+    # (GREPTIME_RACE_CHECK / pytest); importing it here installs its
+    # lock/thread/pool happens-before hooks without requiring every
+    # entry point to know it exists. Guarded: a trimmed deployment that
+    # ships common/ without devtools/ must still lock-check.
+    try:
+        from ..devtools.greptsan import detector as _greptsan  # noqa: F401
+    except Exception as e:  # noqa: BLE001 — optional tooling, never fatal
+        logger.debug("greptsan unavailable; lock-order checking only: %s", e)

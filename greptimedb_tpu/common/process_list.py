@@ -99,8 +99,11 @@ class ProcessRegistry:
     """All running statements of this process, keyed by id."""
 
     def __init__(self, node: str = "standalone"):
-        self._lock = threading.Lock()
-        self._entries: Dict[int, ProcessEntry] = {}
+        from .tracking import tracked_state
+        from .locks import TrackedLock
+        self._lock = TrackedLock("common.process_registry")
+        self._entries: Dict[int, ProcessEntry] = tracked_state(
+            {}, "process_list.entries")
         self._ids = itertools.count(1)
         self.node = node
 

@@ -428,14 +428,19 @@ def suppress_metrics() -> Iterator[None]:
 # timer metrics (prometheus registry shared with /metrics)
 # ---------------------------------------------------------------------------
 
-_metrics_lock = threading.Lock()
-_histograms: Dict[str, object] = {}
-_counters: Dict[str, object] = {}
+from .locks import TrackedLock as _TrackedLock
+from .tracking import tracked_state as _tracked_state
+
+_metrics_lock = _TrackedLock("common.telemetry_metrics")
+_histograms: Dict[str, object] = _tracked_state(
+    {}, "telemetry.histograms")
+_counters: Dict[str, object] = _tracked_state({}, "telemetry.counters")
 #: sanitized key → the original name that claimed it. Distinct originals
 #: sanitizing to one key ("a.b" and "a-b" → "a_b") used to silently share
 #: one time series; now the newcomer is deterministically disambiguated
 #: (crc suffix) and the collision is logged.
-_sanitized_owners: Dict[str, str] = {}
+_sanitized_owners: Dict[str, str] = _tracked_state(
+    {}, "telemetry.sanitized_owners")
 
 
 def _sanitize(name: str) -> str:
@@ -516,10 +521,12 @@ def timer(name: str) -> Iterator[None]:
 LATENCY_BUCKETS = tuple(1e-4 * (2.0 ** k) for k in range(20))
 
 #: sanitized key → (Histogram, labelnames) for observe_latency metrics
-_latency_hists: Dict[str, tuple] = {}
+_latency_hists: Dict[str, tuple] = _tracked_state(
+    {}, "telemetry.latency_hists")
 
 #: (key, labelnames) pairs already warned about — mismatches log once
-_latency_label_mismatches: set = set()
+_latency_label_mismatches: set = _tracked_state(
+    set(), "telemetry.latency_label_mismatches")
 
 
 def observe_latency(name: str, seconds: float,

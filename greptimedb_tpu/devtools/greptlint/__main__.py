@@ -16,7 +16,9 @@ DEFAULT_BASELINE = ".greptlint-baseline.json"
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="greptlint",
-        description="project-invariant static analyzer (rules GL01-GL08)")
+        description="project-invariant static analyzer (rules GL01-GL12; "
+                    "GL10-GL12 are interprocedural over the repo-wide "
+                    "call graph)")
     ap.add_argument("paths", nargs="*", default=["greptimedb_tpu"],
                     help="files or directories to scan")
     ap.add_argument("--baseline", metavar="PATH", default=None,

@@ -1,4 +1,8 @@
-"""greptlint rules GL01-GL08: the project's load-bearing conventions.
+"""greptlint rules GL01-GL12: the project's load-bearing conventions.
+
+GL01-GL09 are per-file; GL10-GL12 are *interprocedural* — they consume
+the repo-wide call graph core.build_context assembles (exception-flow,
+cancellation reachability, failpoint reachability).
 
 Each rule is grounded in a real past bug class (see README "Static
 analysis & invariants"); together they turn six PRs of reviewer folklore
@@ -18,7 +22,8 @@ from __future__ import annotations
 import ast
 from typing import Iterator, List, Optional, Sequence, Set, Tuple
 
-from .core import Finding, ModuleInfo, ProjectContext
+from .core import (Finding, ModuleInfo, ProjectContext, _call_leaf,
+                   _str_arg0)
 
 
 def _segments(rel: str) -> List[str]:
@@ -484,8 +489,270 @@ class AdhocMetricObject(Rule):
                 f"scraper, /metrics and runtime_metrics all read")
 
 
+# ---------------------------------------------------------------------
+# interprocedural rules (GL10-GL12): these consume the repo-wide call
+# graph core.build_context assembles. Resolution is name-based with a
+# hub cutoff (see core.CallGraph) — biased toward precision, so a
+# finding is always actionable and the budget stays at zero.
+# ---------------------------------------------------------------------
+
+def _shallow_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk one function's body without descending into nested defs
+    (those are separate call-graph nodes with their own reachability)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class UntypedHandlerException(Rule):
+    id = "GL10"
+    title = ("exception-flow: any `raise` reachable from a protocol "
+             "handler (Flight do_get/do_put/do_action, HTTP/mysql/"
+             "postgres handle_*, datanode mailbox steps) must be an "
+             "errors.* taxonomy type or wire-mapped — untyped raises "
+             "cross the RPC boundary as status UNKNOWN")
+
+    FLIGHT_METHODS = ("do_get", "do_put", "do_action", "do_exchange")
+    MAILBOX_METHODS = ("_handle_mailbox", "_handle_balancer_msg",
+                       "_balancer_step")
+    #: raise targets that cross the boundary deliberately:
+    #: - SimulatedCrash is crash injection (GL02 guards its catching);
+    #: - NotImplementedError is an abstract-surface contract — 500 is
+    #:   the honest status for "this build cannot do that";
+    #: - stop/system/keyboard are control flow, not errors;
+    #: - ValueError/TypeError/KeyError are the validated-input contract
+    #:   the protocol surfaces translate at the boundary (http/flight
+    #:   handlers and the SET machinery catch them into 400s);
+    #: - OSError/FileNotFoundError are the object-store read contract
+    #:   (callers branch on not-found; the retry layer classifies the
+    #:   rest);
+    #: - LockOrderError/IoUnderLockError are the test-only lock
+    #:   detector, which must fail LOUDLY wherever it trips.
+    WIRE_MAPPED = frozenset({
+        "SimulatedCrash", "NotImplementedError", "StopIteration",
+        "StopAsyncIteration", "KeyboardInterrupt", "SystemExit",
+        "TimeoutError", "BrokenPipeError", "ConnectionError",
+        "ConnectionResetError", "ValueError", "TypeError", "KeyError",
+        "OSError", "FileNotFoundError", "PermissionError",
+        "UnicodeDecodeError", "LockOrderError", "IoUnderLockError",
+    })
+
+    def _roots(self, ctx: ProjectContext) -> Iterator:
+        for fn in ctx.callgraph.functions:
+            in_servers = _in_dirs(fn.rel, ("servers", "selftest"))
+            if in_servers and fn.cls and fn.name in self.FLIGHT_METHODS:
+                yield fn
+            elif in_servers and fn.cls and fn.name.startswith("handle_"):
+                yield fn
+            elif fn.rel.replace("\\", "/").endswith(
+                    "datanode/instance.py") and \
+                    fn.name in self.MAILBOX_METHODS:
+                yield fn
+
+    def _reach(self, ctx: ProjectContext):
+        reach = ctx.cache.get(self.id)
+        if reach is None:
+            reach = ctx.callgraph.reachable(self._roots(ctx))
+            ctx.cache[self.id] = reach
+        return reach
+
+    def check(self, mod, ctx):
+        reach = self._reach(ctx)
+        for fn in ctx.callgraph.functions:
+            if fn.mod is not mod or fn not in reach:
+                continue
+            for node in _shallow_nodes(fn.node):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                if isinstance(exc, ast.Name) and not exc.id[:1].isupper():
+                    continue              # propagating a bound object
+                    # (an UPPERCASE bare Name is a class raise — `raise
+                    # RuntimeError` without parens raises an instance
+                    # all the same and falls through to the check)
+                target = exc.func if isinstance(exc, ast.Call) else exc
+                d = _dotted(target) if target is not None else ""
+                leaf = d.split(".")[-1]
+                if not leaf or leaf in ctx.taxonomy or \
+                        leaf in self.WIRE_MAPPED:
+                    continue
+                if not leaf[:1].isupper():
+                    # `raise _to_greptime_error(e)`: a converter factory,
+                    # not a class — its return type is beyond static
+                    # reach, and the converters exist to produce typed
+                    # errors (under-approximate rather than false-flag)
+                    continue
+                path = reach[fn]
+                via = " -> ".join(path[-3:]) if len(path) > 1 else path[0]
+                yield mod.finding(
+                    self.id, node,
+                    f"raise {leaf} reachable from a protocol handler "
+                    f"(via {via}) — raise a GreptimeError subclass "
+                    f"(errors.py) so the wire carries a real status "
+                    f"code instead of UNKNOWN/500")
+
+
+class UncancellableLoop(Rule):
+    id = "GL11"
+    title = ("cancellation reachability: every loop over SST files / "
+             "regions / RPC futures / streamed slices reachable from "
+             "statement execution must pass through check_cancelled() — "
+             "KILL <id> otherwise cannot interrupt it")
+
+    #: loops are only *scanned* in the read/execution layers — write-side
+    #: and background loops (flush, compaction, purge) must NOT be
+    #: cancellable mid-flight, their atomicity is the crash-safety story
+    SCAN_DIRS = ("query", "promql", "selftest")
+    SCAN_MODULES = ("storage/region.py", "frontend/distributed.py")
+    #: RPC leaf calls that make a loop iteration remote-heavy
+    RPC_CALLS = frozenset({"_dist_rpc"})
+
+    def _roots(self, ctx: ProjectContext) -> Iterator:
+        for fn in ctx.callgraph.functions:
+            if fn.name == "do_query":
+                yield fn
+            elif fn.name == "execute" and _in_dirs(fn.rel, ("query",
+                                                            "selftest")):
+                yield fn
+
+    def _closures(self, ctx: ProjectContext):
+        cached = ctx.cache.get(self.id)
+        if cached is not None:
+            return cached
+        from ...common.locks import IO_FAILPOINT_SITES
+        cg = ctx.callgraph
+        reach = cg.reachable(self._roots(ctx))
+
+        def fixpoint(base_pred):
+            members = {fn for fn in cg.functions if base_pred(fn)}
+            changed = True
+            while changed:
+                changed = False
+                for fn in cg.functions:
+                    if fn in members:
+                        continue
+                    for callee in fn.calls:
+                        if any(t in members for t in cg.targets(callee)):
+                            members.add(fn)
+                            changed = True
+                            break
+            return members
+
+        io_reach = fixpoint(
+            lambda fn: bool(fn.failpoint_sites & IO_FAILPOINT_SITES)
+            or fn.name in self.RPC_CALLS)
+        can_reach = fixpoint(lambda fn: "check_cancelled" in fn.calls)
+        cached = (reach, io_reach, can_reach)
+        ctx.cache[self.id] = cached
+        return cached
+
+    def _in_scope(self, rel: str) -> bool:
+        return _in_dirs(rel, self.SCAN_DIRS) or \
+            _is_module(rel, self.SCAN_MODULES)
+
+    def check(self, mod, ctx):
+        if not self._in_scope(mod.rel):
+            return
+        reach, io_reach, can_reach = self._closures(ctx)
+        cg = ctx.callgraph
+        from ...common.locks import IO_FAILPOINT_SITES
+
+        def body_nodes(loop):
+            stack = list(loop.body)
+            while stack:
+                node = stack.pop()
+                yield node
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(node))
+
+        for fn in cg.functions:
+            if fn.mod is not mod or fn not in reach:
+                continue
+            for loop in _shallow_nodes(fn.node):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                io_heavy = False
+                covered = False
+                for node in body_nodes(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    leaf = _call_leaf(node)
+                    if leaf == "check_cancelled":
+                        covered = True
+                        break
+                    if leaf in ("fail_point", "fires") and \
+                            _str_arg0(node) in IO_FAILPOINT_SITES:
+                        io_heavy = True
+                        continue
+                    targets = cg.targets(leaf)
+                    if any(t in can_reach for t in targets):
+                        covered = True
+                        break
+                    if leaf in self.RPC_CALLS or \
+                            any(t in io_reach for t in targets):
+                        io_heavy = True
+                if io_heavy and not covered:
+                    yield mod.finding(
+                        self.id, loop,
+                        f"loop in {fn.qual} does per-iteration I/O or "
+                        f"RPC work, is reachable from statement "
+                        f"execution, and never passes through "
+                        f"check_cancelled() — KILL cannot interrupt it "
+                        f"at a batch boundary")
+
+
+class DeadFailpoint(Rule):
+    id = "GL12"
+    title = ("failpoint reachability: every registered failpoint name "
+             "must be evaluated by a call site reachable from at least "
+             "one non-test caller — dead failpoints rot the torture "
+             "matrix (experiments arm them and silently never fire)")
+
+    def check(self, mod, ctx):
+        cg = ctx.callgraph
+        for name, (rel, lineno) in \
+                sorted(ctx.registered_failpoints.items()):
+            if rel != mod.rel:
+                continue                  # report at the register() site
+            site_fns = [fn for fn in cg.functions
+                        if name in fn.failpoint_sites]
+            module_site = any(name in sites for sites
+                              in cg.module_failpoint_sites.values())
+            anchor = _Line(lineno)
+            if not site_fns and not module_site:
+                yield mod.finding(
+                    self.id, anchor,
+                    f"failpoint {name!r} is registered here but no "
+                    f"fail_point()/fires() site evaluates it anywhere "
+                    f"in the scanned tree — arming it never fires")
+            elif not module_site and not any(
+                    cg.has_caller(fn) for fn in site_fns):
+                owners = ", ".join(fn.qual for fn in site_fns[:3])
+                yield mod.finding(
+                    self.id, anchor,
+                    f"failpoint {name!r} is only evaluated inside "
+                    f"{owners}, which no non-test code calls — the "
+                    f"site is dead and the experiment never fires")
+
+
+class _Line:
+    """Anchor object for findings not tied to one AST node."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.col_offset = 0
+
+
 ALL_RULES: List[Rule] = [
     SwallowedException(), BaseExceptionCaught(), BareRename(),
     UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
     UntracedHandler(), UnlockedModuleMutation(), AdhocMetricObject(),
+    UntypedHandlerException(), UncancellableLoop(), DeadFailpoint(),
 ]

@@ -1,0 +1,19 @@
+"""Seeded GL11 violation: a per-file I/O loop reachable from statement
+execution (`do_query` is a root) that never passes through
+check_cancelled() — a KILL could not interrupt it at a batch boundary.
+The failpoint name is registered here so GL04 stays quiet; the site's
+enclosing function has a caller so GL12 stays quiet too."""
+
+register("objstore_read")  # noqa: F821 — parsed, never run
+
+
+def do_query(sst_files):
+    out = []
+    for f in sst_files:            # the uncancellable batch loop
+        out.append(_read_one(f))
+    return out
+
+
+def _read_one(f):
+    fail_point("objstore_read")  # noqa: F821 — blocking-I/O site
+    return f

@@ -386,13 +386,16 @@ class FlowManager:
         #: create_sink_fn(spec, schema, pk_indices) -> Table; when None the
         #: sink table must already exist
         self.create_sink_fn = create_sink_fn
-        self._lock = threading.RLock()
+        from ..common.locks import TrackedLock, TrackedRLock
+        from ..common.tracking import tracked_state
+        self._lock = TrackedRLock("flow.manager")
         #: serializes folds: the background tick thread and a query-path
         #: refresh() must not fold the same flow concurrently (both would
         #: read one watermark and double-count the same delta, and
         #: store.save would serialize a mid-mutation watermark dict)
-        self._fold_lock = threading.Lock()
-        self._flows: Dict[str, FlowSpec] = {}
+        self._fold_lock = TrackedLock("flow.fold")
+        self._flows: Dict[str, FlowSpec] = tracked_state(
+            {}, "flow.manager.flows")
         self._task = None
         #: read-path refresh floor for sources WITHOUT sequence counters
         #: (DistTables): lagging() cannot cheaply answer there, so

@@ -38,12 +38,15 @@ import json
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Tuple
 
 from ..common import failpoint as _fp
 from ..common.runtime import env_int
 from ..errors import GreptimeError, InvalidArgumentsError
 from .service import Peer, RegionRoute, ROUTE_PREFIX, TINFO_PREFIX
+
+if TYPE_CHECKING:  # circular at runtime: service constructs the balancer
+    from .service import MetaSrv
 
 logger = logging.getLogger(__name__)
 
@@ -72,7 +75,9 @@ _STEP_MSG = {
 class RegionBalancer:
     """Leader-only control loop over one MetaSrv's KV + heartbeat state."""
 
-    def __init__(self, srv, is_leader_fn=None):
+    def __init__(self, srv: "MetaSrv",
+                 is_leader_fn: Optional[Callable[[], bool]] = None
+                 ) -> None:
         self.srv = srv
         #: None = always leader (single metasrv / in-process tests)
         self.is_leader_fn = is_leader_fn
@@ -88,14 +93,19 @@ class RegionBalancer:
         self.step_timeout_s = float(env_int(
             "GREPTIME_BALANCER_STEP_TIMEOUT_S", 300))
         self.resend_interval_s = 5.0
+        from ..common.locks import TrackedLock
+        from ..common.tracking import tracked_state
         #: (op_id, msg_type) -> ack dict; heartbeat threads write, the
         #: tick thread consumes
-        self._acks: Dict[Tuple[str, str], dict] = {}
-        self._acks_lock = threading.Lock()
+        self._acks: Dict[Tuple[str, str], dict] = tracked_state(
+            {}, "meta.balancer.acks")
+        self._acks_lock = TrackedLock("meta.balancer_acks")
         #: (op_id, msg_type) -> monotonic last-send time (in-memory only:
         #: after a meta restart every current step re-sends immediately,
-        #: which is safe because steps are idempotent)
-        self._sent: Dict[Tuple[str, str], float] = {}
+        #: which is safe because steps are idempotent). Tick-thread only —
+        #: unlike _acks it has exactly one writer, so no lock
+        self._sent: Dict[Tuple[str, str], float] = tracked_state(
+            {}, "meta.balancer.sent")
 
     # ------------------------------------------------------------------
     # knobs
@@ -103,7 +113,7 @@ class RegionBalancer:
     KNOBS = ("enabled", "split_size_bytes", "split_rate_rps",
              "rebalance_threshold", "max_inflight", "step_timeout_s")
 
-    def configure(self, knob: str, value) -> None:
+    def configure(self, knob: str, value: object) -> None:
         """SET balancer_<knob> = value (both frontends forward here)."""
         if knob not in self.KNOBS:
             raise InvalidArgumentsError(
@@ -218,7 +228,8 @@ class RegionBalancer:
                     op["from_node"], to_node, " (auto)" if auto else "")
         return op
 
-    def split(self, full_name: str, region: int, at_value=None,
+    def split(self, full_name: str, region: int,
+              at_value: object = None,
               auto: bool = False) -> dict:
         from ..common.telemetry import increment_counter
         from ..mito.engine import _deserialize_rule

@@ -19,7 +19,7 @@ class PhiAccrualFailureDetector:
                  min_std_deviation_ms: float = 100.0,
                  acceptable_heartbeat_pause_ms: float = 3000.0,
                  first_heartbeat_estimate_ms: float = 1000.0,
-                 max_sample_size: int = 1000):
+                 max_sample_size: int = 1000) -> None:
         self.threshold = threshold
         self.min_std_deviation_ms = min_std_deviation_ms
         self.acceptable_heartbeat_pause_ms = acceptable_heartbeat_pause_ms

@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
-from typing import List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import pyarrow.flight as flight
 
@@ -25,7 +25,7 @@ from .service import (
 
 class FlightMetaServer(flight.FlightServerBase):
     def __init__(self, srv: MetaSrv, location: str = "grpc://127.0.0.1:0",
-                 raft_node=None):
+                 raft_node: object = None) -> None:
         super().__init__(location)
         self.srv = srv
         self.raft_node = raft_node    # replication RPCs when clustered
@@ -43,7 +43,8 @@ class FlightMetaServer(flight.FlightServerBase):
         t.start()
         return t
 
-    def do_action(self, context, action):
+    def do_action(self, context: object, action: "flight.Action"
+                  ) -> Iterator["flight.Result"]:
         body = json.loads(action.body.to_pybytes() or b"{}")
         kind = action.type
         # popped (not just read): raft_* handlers splat **body, and the
@@ -52,7 +53,8 @@ class FlightMetaServer(flight.FlightServerBase):
         with remote_context(body.pop("traceparent", None)):
             yield from self._do_action_inner(kind, body)
 
-    def _do_action_inner(self, kind, body):
+    def _do_action_inner(self, kind: str, body: dict
+                         ) -> Iterator["flight.Result"]:
         try:
             if kind == "register":
                 self.srv.register_datanode(Peer.from_dict(body["peer"]))
@@ -202,7 +204,7 @@ class FlightMetaServer(flight.FlightServerBase):
 class FlightMetaClient:
     """MetaClient surface over a FlightMetaServer."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str) -> None:
         self.address = address
         self._conn: Optional[flight.FlightClient] = None
 
@@ -298,7 +300,7 @@ class FlightMetaClient:
             "name": full_name, "region": region, "to_node": to_node})["op"]
 
     def admin_split_region(self, full_name: str, region: int,
-                           at_value=None) -> dict:
+                           at_value: object = None) -> dict:
         return self._action("admin_split_region", {
             "name": full_name, "region": region,
             "at_value": at_value})["op"]
@@ -307,7 +309,7 @@ class FlightMetaClient:
                         ) -> List[dict]:
         return self._action("admin_rebalance", {"name": full_name})["ops"]
 
-    def balancer_configure(self, knob: str, value) -> None:
+    def balancer_configure(self, knob: str, value: object) -> None:
         self._action("balancer_configure", {"knob": knob, "value": value})
 
     def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
@@ -329,7 +331,7 @@ class FlightMetaClient:
         v = self._action("kv_get", {"key": key}).get("value")
         return base64.b64decode(v) if v is not None else None
 
-    def kv_range(self, prefix: str):
+    def kv_range(self, prefix: str) -> List[Tuple[str, bytes]]:
         # eager, not a generator: the RPC must fire inside this call so
         # FailoverFlightMetaClient's replica-walking wrapper (and any
         # caller try block) sees a connection failure, not the iterator
@@ -346,12 +348,12 @@ class PeerClientRegistry(dict):
     the meta service and dials their Flight address on demand (the
     frontend's view of an elastic cluster)."""
 
-    def __init__(self, meta: FlightMetaClient):
+    def __init__(self, meta: FlightMetaClient) -> None:
         super().__init__()
         self.meta = meta
         self._lock = threading.Lock()
 
-    def _resolve(self, node_id: int):
+    def _resolve(self, node_id: int) -> Optional[object]:
         from ..client.flight import FlightDatanodeClient
         for peer in self.meta.list_datanodes(alive_only=False):
             if peer.id == node_id and peer.addr:
@@ -360,13 +362,13 @@ class PeerClientRegistry(dict):
                     return self.setdefault(node_id, client)
         return None
 
-    def __missing__(self, node_id: int):
+    def __missing__(self, node_id: int) -> object:
         client = self._resolve(node_id)
         if client is None:
             raise KeyError(node_id)
         return client
 
-    def get(self, node_id, default=None):
+    def get(self, node_id: int, default: object = None) -> object:
         try:
             return self[node_id]
         except KeyError:
@@ -380,7 +382,7 @@ class FailoverFlightMetaClient:
     callers can always construct it from --metasrv-addr."""
 
     def __init__(self, addresses: List[str], *, retry_delay: float = 0.2,
-                 max_rounds: int = 25):
+                 max_rounds: int = 25) -> None:
         self.clients = [FlightMetaClient(a) for a in addresses]
         # the leader pin lives in a shared cell so advisory() copies
         # write the leader they discover back to the parent client
@@ -417,11 +419,11 @@ class FailoverFlightMetaClient:
         for c in self.clients:
             c.close()
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> object:
         if name.startswith("_"):
             raise AttributeError(name)
 
-        def call(*args, **kwargs):
+        def call(*args: object, **kwargs: object) -> object:
             from .replication import NotLeaderError
             import time as _time
             last: Optional[Exception] = None

@@ -16,7 +16,7 @@ _fp.register("meta_kv_put")
 
 
 class MemKv:
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._data: Dict[str, bytes] = {}
 
@@ -77,7 +77,8 @@ class MemKv:
             self._apply_batch_locked(ops)
             return True
 
-    def _apply_batch_locked(self, ops) -> None:
+    def _apply_batch_locked(
+            self, ops: List[Tuple[str, str, Optional[bytes]]]) -> None:
         # validate before mutating: a bad op mid-list must not leave the
         # batch half-applied (all-or-nothing contract)
         for op, key, value in ops:
@@ -97,7 +98,7 @@ class FileKv(MemKv):
     for single-meta deployments (reference deploys etcd; route/peer state
     must survive a metasrv restart either way)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str) -> None:
         super().__init__()
         import base64
         import json
@@ -120,19 +121,20 @@ class FileKv(MemKv):
         # promote an empty/short snapshot
         atomic_write(self._path, self._json.dumps(doc), tmp_prefix=".kv-")
 
-    def put(self, key, value):
+    def put(self, key: str, value: bytes) -> None:
         with self._lock:
             self._data[key] = value
             self._persist_locked()
 
-    def delete(self, key):
+    def delete(self, key: str) -> bool:
         with self._lock:
             existed = self._data.pop(key, None) is not None
             if existed:
                 self._persist_locked()
             return existed
 
-    def compare_and_put(self, key, expect, value):
+    def compare_and_put(self, key: str, expect: Optional[bytes],
+                        value: bytes) -> bool:
         with self._lock:
             cur = self._data.get(key)
             if cur != expect:
@@ -141,7 +143,8 @@ class FileKv(MemKv):
             self._persist_locked()
             return True
 
-    def compare_and_delete(self, key, expect):
+    def compare_and_delete(self, key: str,
+                           expect: Optional[bytes]) -> bool:
         with self._lock:
             if self._data.get(key) != expect:
                 return False
@@ -149,7 +152,7 @@ class FileKv(MemKv):
             self._persist_locked()
             return True
 
-    def incr(self, key, start=0):
+    def incr(self, key: str, start: int = 0) -> int:
         with self._lock:
             cur = int(self._data.get(key, str(start).encode()))
             nxt = cur + 1
@@ -157,7 +160,9 @@ class FileKv(MemKv):
             self._persist_locked()
             return nxt
 
-    def batch(self, ops, guard=None):
+    def batch(self, ops: List[Tuple[str, str, Optional[bytes]]],
+              guard: Optional[Tuple[str, Optional[bytes]]] = None
+              ) -> bool:
         with self._lock:
             if guard is not None and self._data.get(guard[0]) != guard[1]:
                 return False

@@ -25,7 +25,7 @@ class DistributedLock:
     """Lease-based mutual exclusion over the shared KV."""
 
     def __init__(self, kv: MemKv, name: str, *, lease_secs: float = 10.0,
-                 holder: Optional[str] = None):
+                 holder: Optional[str] = None) -> None:
         self.kv = kv
         self.key = f"{LOCK_PREFIX}{name}"
         self.lease_secs = lease_secs
@@ -77,12 +77,12 @@ class DistributedLock:
         doc = json.loads(current)
         return doc["holder"] if doc["expires"] >= now else None
 
-    def __enter__(self):
+    def __enter__(self) -> "DistributedLock":
         if not self.acquire():
             raise TimeoutError(f"could not acquire lock {self.key}")
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.release()
 
 
@@ -94,7 +94,7 @@ class Election:
 
     def __init__(self, kv: MemKv, candidate_id: str,
                  *, lease_secs: float = 10.0,
-                 renew_interval: float = 3.0):
+                 renew_interval: float = 3.0) -> None:
         self._lock = DistributedLock(kv, "__leader__",
                                      lease_secs=lease_secs,
                                      holder=candidate_id)

@@ -43,7 +43,7 @@ FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 
 class NotLeaderError(GreptimeError):
-    def __init__(self, leader_id: Optional[int]):
+    def __init__(self, leader_id: Optional[int]) -> None:
         super().__init__(f"not the meta leader (leader hint: {leader_id})")
         self.leader_id = leader_id
 
@@ -52,7 +52,7 @@ class ProposeUncertainError(GreptimeError):
     """Commit could not be confirmed before the deadline. The entry may
     still commit later; retrying a non-idempotent op can double-apply."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         super().__init__("meta propose result unknown (no quorum ack "
                          "within the deadline); retry only idempotent ops")
 
@@ -64,7 +64,7 @@ class RaftNode:
                  *, store_path: Optional[str] = None,
                  election_timeout: Tuple[float, float] = (1.5, 3.0),
                  heartbeat_interval: float = 0.5,
-                 compact_threshold: int = 256):
+                 compact_threshold: int = 256) -> None:
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
         self.transports: Dict[int, Any] = {}   # peer id -> transport
@@ -199,7 +199,7 @@ class RaftNode:
     def _upgrade_entry(entry: dict) -> dict:
         """Re-encode a legacy (utf-8-bridged) log entry's value strings
         into the latin-1 byte-preserving representation."""
-        def bridge(s):
+        def bridge(s: object) -> object:
             return s.encode("utf-8").decode("latin-1") \
                 if isinstance(s, str) else s
 
@@ -493,7 +493,7 @@ class RaftNode:
         self._persist_snapshot_locked()
         self._persist_locked()
 
-    def _apply_op(self, op: dict):
+    def _apply_op(self, op: dict) -> object:
         kind = op["kind"]
         key = op.get("key")
         if kind == "put":
@@ -540,7 +540,7 @@ class RaftNode:
         raise GreptimeError(f"unknown raft op {kind!r}")
 
     # ---- client entry ----
-    def propose(self, op: dict, timeout: float = 10.0):
+    def propose(self, op: dict, timeout: float = 10.0) -> object:
         """Append on the leader, replicate to a majority, apply, return
         the op result. Raises NotLeaderError elsewhere, and
         ProposeUncertainError when commit cannot be confirmed in time —
@@ -605,16 +605,16 @@ class RaftNode:
 class LocalTransport:
     """Direct in-process transport (the MemKv of transports)."""
 
-    def __init__(self, node: RaftNode):
+    def __init__(self, node: RaftNode) -> None:
         self.node = node
 
-    def request_vote(self, **kw) -> dict:
+    def request_vote(self, **kw: object) -> dict:
         return self.node.handle_request_vote(**kw)
 
-    def append_entries(self, **kw) -> dict:
+    def append_entries(self, **kw: object) -> dict:
         return self.node.handle_append_entries(**kw)
 
-    def install_snapshot(self, **kw) -> dict:
+    def install_snapshot(self, **kw: object) -> dict:
         return self.node.handle_install_snapshot(**kw)
 
 
@@ -629,7 +629,7 @@ class FlightTransport:
     """Raft RPCs over the meta Flight plane (meta/flight.py actions
     raft_request_vote / raft_append_entries) for multi-process meta."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str) -> None:
         self.address = address
         self._client = None
 
@@ -646,13 +646,13 @@ class FlightTransport:
             raise GreptimeError(resp.get("error", "meta raft rpc failed"))
         return resp
 
-    def request_vote(self, **kw) -> dict:
+    def request_vote(self, **kw: object) -> dict:
         return self._action("raft_request_vote", kw)
 
-    def append_entries(self, **kw) -> dict:
+    def append_entries(self, **kw: object) -> dict:
         return self._action("raft_append_entries", kw)
 
-    def install_snapshot(self, **kw) -> dict:
+    def install_snapshot(self, **kw: object) -> dict:
         return self._action("raft_install_snapshot", kw)
 
 
@@ -661,19 +661,19 @@ class HaMetaClient:
     every call retries across servers until it lands on the leader
     (reference clients iterate etcd endpoints the same way)."""
 
-    def __init__(self, srvs, *, retry_delay: float = 0.15,
-                 max_rounds: int = 40):
+    def __init__(self, srvs: "List[object]", *, retry_delay: float = 0.15,
+                 max_rounds: int = 40) -> None:
         from .service import MetaClient
         self.clients = [MetaClient(s) for s in srvs]
         self._cur = 0
         self._delay = retry_delay
         self._rounds = max_rounds
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> object:
         if name.startswith("_"):
             raise AttributeError(name)
 
-        def call(*args, **kwargs):
+        def call(*args: object, **kwargs: object) -> object:
             last: Optional[Exception] = None
             for _ in range(self._rounds):
                 client = self.clients[self._cur % len(self.clients)]
@@ -692,7 +692,7 @@ class ReplicatedKv:
     """MemKv-interface facade over a RaftNode, so MetaSrv mounts a
     replicated store exactly like MemKv/FileKv (meta/kv.py)."""
 
-    def __init__(self, node: RaftNode):
+    def __init__(self, node: RaftNode) -> None:
         self.node = node
 
     # reads (leader-local, linearizable after majority-committed writes)
@@ -729,7 +729,9 @@ class ReplicatedKv:
         return int(self.node.propose({"kind": "incr", "key": key,
                                       "start": start}))
 
-    def batch(self, ops, guard=None) -> bool:
+    def batch(self, ops: List[Tuple[str, str, Optional[bytes]]],
+              guard: Optional[Tuple[str, Optional[bytes]]] = None
+              ) -> bool:
         for op, k, v in ops:        # reject bad ops BEFORE they hit the log
             if op not in ("put", "delete"):
                 raise ValueError(f"unknown batch op {op!r}")

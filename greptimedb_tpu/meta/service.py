@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..errors import GreptimeError
 from .failure_detector import PhiAccrualFailureDetector
@@ -123,25 +123,42 @@ class MetaSrv:
     def __init__(self, kv: Optional[MemKv] = None, *,
                  datanode_lease_secs: float = 15.0,
                  selector: str = "load_based",
-                 phi_threshold: float = 8.0):
+                 phi_threshold: float = 8.0) -> None:
+        from ..common.locks import TrackedRLock
+        from ..common.tracking import tracked_state
         self.kv = kv if kv is not None else MemKv()
         self.datanode_lease_secs = datanode_lease_secs
         self.selector = selector
-        self._stats: Dict[int, DatanodeStat] = {}
+        #: guards ALL the in-memory heartbeat state below. Heartbeats
+        #: arrive on one server thread per datanode while cluster_info /
+        #: region_heat / the balancer tick / failover_check read
+        #: concurrently — greptsan flagged the unguarded dicts the round
+        #: they were wrapped (a half-updated rate map could feed the
+        #: selector). kv reads/writes stay OUTSIDE the lock.
+        self._state_lock = TrackedRLock("meta.srv_state")
+        self._stats: Dict[int, DatanodeStat] = tracked_state(
+            {}, "meta.srv.stats")
         #: (approximate_rows, t) of the previous stat-bearing heartbeat,
         #: so consecutive reports yield a per-node ingest rate
-        self._prev_ingest: Dict[int, tuple] = {}
-        self._ingest_rate: Dict[int, float] = {}
+        self._prev_ingest: Dict[int, tuple] = tracked_state(
+            {}, "meta.srv.prev_ingest")
+        self._ingest_rate: Dict[int, float] = tracked_state(
+            {}, "meta.srv.ingest_rate")
         #: per-REGION twins of the above: {node: {region: rows}} at the
         #: previous full beat and the derived {node: {region: rps}} —
         #: the cluster-wide region-heat feed the self-monitoring
         #: scraper persists into greptime_private.region_heat
-        self._prev_region_rows: Dict[int, tuple] = {}
-        self._region_rates: Dict[int, Dict[str, float]] = {}
-        self._last_seen: Dict[int, float] = {}
-        self._detectors: Dict[int, PhiAccrualFailureDetector] = {}
+        self._prev_region_rows: Dict[int, tuple] = tracked_state(
+            {}, "meta.srv.prev_region_rows")
+        self._region_rates: Dict[int, Dict[str, float]] = tracked_state(
+            {}, "meta.srv.region_rates")
+        self._last_seen: Dict[int, float] = tracked_state(
+            {}, "meta.srv.last_seen")
+        self._detectors: Dict[int, PhiAccrualFailureDetector] = \
+            tracked_state({}, "meta.srv.detectors")
         self._phi_threshold = phi_threshold
-        self._mailboxes: Dict[int, List[dict]] = {}
+        self._mailboxes: Dict[int, List[dict]] = tracked_state(
+            {}, "meta.srv.mailboxes")
         # Startup grace: peers persist in the KV but _last_seen does not.
         # After a metasrv restart every persisted peer would read seen=None
         # and a single failover tick would reassign ALL healthy nodes'
@@ -158,9 +175,11 @@ class MetaSrv:
     def register_datanode(self, peer: Peer) -> None:
         self.kv.put(f"{PEER_PREFIX}{peer.id}",
                     json.dumps(peer.to_dict()).encode())
-        self._last_seen[peer.id] = time.time()
-        self._detectors.setdefault(
-            peer.id, PhiAccrualFailureDetector(threshold=self._phi_threshold))
+        with self._state_lock:
+            self._last_seen[peer.id] = time.time()
+            self._detectors.setdefault(
+                peer.id,
+                PhiAccrualFailureDetector(threshold=self._phi_threshold))
 
     def peers(self) -> List[Peer]:
         return [Peer.from_dict(json.loads(v))
@@ -173,13 +192,16 @@ class MetaSrv:
     def alive_datanodes(self, now: Optional[float] = None) -> List[Peer]:
         now = time.time() if now is None else now
         out = []
-        for p in self.peers():
-            seen = self._last_seen.get(p.id)
-            if seen is not None and now - seen <= self.datanode_lease_secs:
-                det = self._detectors.get(p.id)
-                if det is None or det.sample_count == 0 or \
-                        det.is_available(now * 1000.0):
-                    out.append(p)
+        peers = self.peers()               # kv read outside the lock
+        with self._state_lock:
+            for p in peers:
+                seen = self._last_seen.get(p.id)
+                if seen is not None and \
+                        now - seen <= self.datanode_lease_secs:
+                    det = self._detectors.get(p.id)
+                    if det is None or det.sample_count == 0 or \
+                            det.is_available(now * 1000.0):
+                        out.append(p)
         return out
 
     def failed_datanodes(self, now: Optional[float] = None) -> List[Peer]:
@@ -187,11 +209,13 @@ class MetaSrv:
         the action itself is still TODO in the reference too)."""
         now = time.time() if now is None else now
         out = []
-        for p in self.peers():
-            det = self._detectors.get(p.id)
-            if det is not None and det.sample_count > 0 and \
-                    not det.is_available(now * 1000.0):
-                out.append(p)
+        peers = self.peers()
+        with self._state_lock:
+            for p in peers:
+                det = self._detectors.get(p.id)
+                if det is not None and det.sample_count > 0 and \
+                        not det.is_available(now * 1000.0):
+                    out.append(p)
         return out
 
     # ---- heartbeat ----
@@ -203,46 +227,49 @@ class MetaSrv:
             # first contact registers the peer (reference: heartbeats are
             # the registration channel, keep_lease_handler.rs)
             self.register_datanode(Peer(node_id))
-        self._last_seen[node_id] = now
-        det = self._detectors.setdefault(
-            node_id, PhiAccrualFailureDetector(threshold=self._phi_threshold))
-        det.heartbeat(now * 1000.0)
-        if stat is not None and stat.full:
-            prev = self._prev_ingest.get(node_id)
-            if prev is not None and now > prev[1]:
-                self._ingest_rate[node_id] = max(
-                    0.0, (stat.approximate_rows - prev[0]) /
-                    (now - prev[1]))
-            self._prev_ingest[node_id] = (stat.approximate_rows, now)
-            # per-region rate across consecutive FULL beats (light beats
-            # carry no region rows, so the divisor is the true elapsed
-            # time between stat walks, same rule as the node rate)
-            by_region = {rs["region"]: int(rs["rows"])
-                         for rs in stat.region_stats}
-            prev_r = self._prev_region_rows.get(node_id)
-            if prev_r is not None and now > prev_r[1]:
-                dt = now - prev_r[1]
-                self._region_rates[node_id] = {
-                    region: max(0.0,
-                                (rows - prev_r[0].get(region, 0)) / dt)
-                    for region, rows in by_region.items()}
-            self._prev_region_rows[node_id] = (by_region, now)
-            self._stats[node_id] = stat
-        elif stat is not None:
-            # light beat: region_count only (selector freshness); keep
-            # the last full stat's rows/region heat intact
-            kept = self._stats.get(node_id)
-            if kept is not None:
-                kept.region_count = stat.region_count
-            else:
+        with self._state_lock:
+            self._last_seen[node_id] = now
+            det = self._detectors.setdefault(
+                node_id,
+                PhiAccrualFailureDetector(threshold=self._phi_threshold))
+            det.heartbeat(now * 1000.0)
+            if stat is not None and stat.full:
+                prev = self._prev_ingest.get(node_id)
+                if prev is not None and now > prev[1]:
+                    self._ingest_rate[node_id] = max(
+                        0.0, (stat.approximate_rows - prev[0]) /
+                        (now - prev[1]))
+                self._prev_ingest[node_id] = (stat.approximate_rows, now)
+                # per-region rate across consecutive FULL beats (light
+                # beats carry no region rows, so the divisor is the true
+                # elapsed time between stat walks, same as the node rate)
+                by_region = {rs["region"]: int(rs["rows"])
+                             for rs in stat.region_stats}
+                prev_r = self._prev_region_rows.get(node_id)
+                if prev_r is not None and now > prev_r[1]:
+                    dt = now - prev_r[1]
+                    self._region_rates[node_id] = {
+                        region: max(0.0,
+                                    (rows - prev_r[0].get(region, 0)) / dt)
+                        for region, rows in by_region.items()}
+                self._prev_region_rows[node_id] = (by_region, now)
                 self._stats[node_id] = stat
-        msgs = self._mailboxes.pop(node_id, [])
+            elif stat is not None:
+                # light beat: region_count only (selector freshness);
+                # keep the last full stat's rows/region heat intact
+                kept = self._stats.get(node_id)
+                if kept is not None:
+                    kept.region_count = stat.region_count
+                else:
+                    self._stats[node_id] = stat
+            msgs = self._mailboxes.pop(node_id, [])
         return HeartbeatResponse(mailbox=msgs)
 
     def send_mailbox(self, node_id: int, message: dict) -> None:
         """Reverse control: meta→datanode messages ride the next heartbeat
         response (reference handler.rs:244-302)."""
-        self._mailboxes.setdefault(node_id, []).append(message)
+        with self._state_lock:
+            self._mailboxes.setdefault(node_id, []).append(message)
 
     # ---- sequences ----
     def allocate_table_id(self) -> int:
@@ -257,8 +284,10 @@ class MetaSrv:
             raise NoAliveDatanodeError("no alive datanode to place regions")
         if self.selector == "load_based":
             # fewest-regions-first (reference load_based.rs:27-80)
-            load = {p.id: self._stats.get(p.id, DatanodeStat()).region_count
-                    for p in alive}
+            with self._state_lock:
+                load = {p.id: self._stats.get(p.id,
+                                              DatanodeStat()).region_count
+                        for p in alive}
             order = sorted(alive, key=lambda p: (load[p.id], p.id))
         else:
             order = sorted(alive, key=lambda p: p.id)
@@ -352,36 +381,39 @@ class MetaSrv:
         for route in self.all_table_routes():
             for rr in route.region_routes:
                 placed[rr.leader.id] = placed.get(rr.leader.id, 0) + 1
-        for p in self.peers():
-            seen = self._last_seen.get(p.id)
-            if seen is None:
-                state = "unknown"
-            elif now - seen <= self.datanode_lease_secs:
-                state = "alive"
-                det = self._detectors.get(p.id)
-                if det is not None and det.sample_count > 0 and \
-                        not det.is_available(now * 1000.0):
-                    state = "suspect"
-            else:
-                state = "expired"
-            stat = self._stats.get(p.id, DatanodeStat())
-            rows.append({
-                "peer_id": p.id, "peer_type": "datanode",
-                "peer_addr": p.addr, "lease_state": state,
-                "last_seen_ms": int(seen * 1000)
-                if seen is not None else None,
-                "region_count": placed.get(p.id, 0),
-                "approximate_rows": int(stat.approximate_rows),
-                # rate is a derivative: a node that stopped heartbeating
-                # isn't ingesting, so don't let its last-known rate read
-                # as the hottest ingester forever (approximate_rows is
-                # cumulative and stays as the last-known fact)
-                "ingest_rate_rps": round(
-                    self._ingest_rate.get(p.id, 0.0), 3)
-                if state == "alive" else 0.0,
-                "region_stats": json.dumps(stat.region_stats,
-                                           separators=(",", ":")),
-            })
+        peers = self.peers()               # kv read outside the lock
+        with self._state_lock:
+            for p in peers:
+                seen = self._last_seen.get(p.id)
+                if seen is None:
+                    state = "unknown"
+                elif now - seen <= self.datanode_lease_secs:
+                    state = "alive"
+                    det = self._detectors.get(p.id)
+                    if det is not None and det.sample_count > 0 and \
+                            not det.is_available(now * 1000.0):
+                        state = "suspect"
+                else:
+                    state = "expired"
+                stat = self._stats.get(p.id, DatanodeStat())
+                rows.append({
+                    "peer_id": p.id, "peer_type": "datanode",
+                    "peer_addr": p.addr, "lease_state": state,
+                    "last_seen_ms": int(seen * 1000)
+                    if seen is not None else None,
+                    "region_count": placed.get(p.id, 0),
+                    "approximate_rows": int(stat.approximate_rows),
+                    # rate is a derivative: a node that stopped
+                    # heartbeating isn't ingesting, so don't let its
+                    # last-known rate read as the hottest ingester
+                    # forever (approximate_rows is cumulative and stays
+                    # as the last-known fact)
+                    "ingest_rate_rps": round(
+                        self._ingest_rate.get(p.id, 0.0), 3)
+                    if state == "alive" else 0.0,
+                    "region_stats": json.dumps(stat.region_stats,
+                                               separators=(",", ":")),
+                })
         return rows
 
     def region_heat(self, now: Optional[float] = None) -> List[dict]:
@@ -393,19 +425,20 @@ class MetaSrv:
         now = time.time() if now is None else now
         alive = {p.id for p in self.alive_datanodes(now)}
         rows: List[dict] = []
-        for node_id in sorted(self._stats):
-            stat = self._stats[node_id]
-            rates = self._region_rates.get(node_id, {})
-            for rs in stat.region_stats:
-                rows.append({
-                    "node": f"dn{node_id}",
-                    "region": rs["region"],
-                    "rows": int(rs["rows"]),
-                    "size_bytes": int(rs["size_bytes"]),
-                    "ingest_rate_rps": round(
-                        rates.get(rs["region"], 0.0), 3)
-                    if node_id in alive else 0.0,
-                })
+        with self._state_lock:
+            for node_id in sorted(self._stats):
+                stat = self._stats[node_id]
+                rates = self._region_rates.get(node_id, {})
+                for rs in stat.region_stats:
+                    rows.append({
+                        "node": f"dn{node_id}",
+                        "region": rs["region"],
+                        "rows": int(rs["rows"]),
+                        "size_bytes": int(rs["size_bytes"]),
+                        "ingest_rate_rps": round(
+                            rates.get(rs["region"], 0.0), 3)
+                        if node_id in alive else 0.0,
+                    })
         return rows
 
     # ---- elastic region admin (ADMIN MIGRATE/SPLIT/REBALANCE route
@@ -416,7 +449,7 @@ class MetaSrv:
         return self.balancer.migrate(full_table_name, region, to_node)
 
     def admin_split_region(self, full_table_name: str, region: int,
-                           at_value=None) -> dict:
+                           at_value: object = None) -> dict:
         return self.balancer.split(full_table_name, region,
                                    at_value=at_value)
 
@@ -472,18 +505,22 @@ class MetaSrv:
         mail open_regions to the new leaders. Returns the moves."""
         now_t = time.time() if now is None else now
         dead = {p.id for p in self.failed_datanodes(now_t)}
-        for p in self.peers():
-            seen = self._last_seen.get(p.id, self._start_time)
-            if now_t - seen > 2 * self.datanode_lease_secs:
-                dead.add(p.id)
+        peers = self.peers()
+        with self._state_lock:
+            for p in peers:
+                seen = self._last_seen.get(p.id, self._start_time)
+                if now_t - seen > 2 * self.datanode_lease_secs:
+                    dead.add(p.id)
         if not dead:
             return []
         alive = [p for p in self.alive_datanodes(now_t)
                  if p.id not in dead]
         if not alive:
             return []
-        load = {p.id: self._stats.get(p.id, DatanodeStat()).region_count
-                for p in alive}
+        with self._state_lock:
+            load = {p.id: self._stats.get(p.id,
+                                          DatanodeStat()).region_count
+                    for p in alive}
         # tables mid-balancer-op are off limits: re-placing a region the
         # balancer is migrating would dual-own it (both paths rewrite the
         # route); the op finishes or times out into a rollback first, and
@@ -527,7 +564,7 @@ class MetaClient:
     """Client SDK facade (reference: src/meta-client). In-process it calls
     the service directly; the wire version keeps the same surface."""
 
-    def __init__(self, srv: MetaSrv):
+    def __init__(self, srv: MetaSrv) -> None:
         self._srv = srv
 
     def register(self, peer: Peer) -> None:
@@ -568,14 +605,14 @@ class MetaClient:
         return self._srv.admin_migrate_region(full_name, region, to_node)
 
     def admin_split_region(self, full_name: str, region: int,
-                           at_value=None) -> dict:
+                           at_value: object = None) -> dict:
         return self._srv.admin_split_region(full_name, region, at_value)
 
     def admin_rebalance(self, full_name: Optional[str] = None
                         ) -> List[dict]:
         return self._srv.admin_rebalance(full_name)
 
-    def balancer_configure(self, knob: str, value) -> None:
+    def balancer_configure(self, knob: str, value: object) -> None:
         self._srv.balancer.configure(knob, value)
 
     def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
@@ -599,7 +636,7 @@ class MetaClient:
     def kv_get(self, key: str) -> Optional[bytes]:
         return self._srv.kv.get(key)
 
-    def kv_range(self, prefix: str):
+    def kv_range(self, prefix: str) -> List[Tuple[str, bytes]]:
         return self._srv.kv.range(prefix)
 
     def kv_delete(self, key: str) -> bool:

@@ -482,13 +482,17 @@ class MitoEngine(TableEngine):
         self.state_prefix = state_prefix
         self.storage = storage
         self.store = storage.store
-        self._tables: Dict[tuple, MitoTable] = {}
-        self._lock = threading.Lock()
+        from ..common.locks import TrackedLock
+        from ..common.tracking import tracked_state
+        self._tables: Dict[tuple, MitoTable] = tracked_state(
+            {}, "mito.engine.tables")
+        self._lock = TrackedLock("mito.engine")
         self._registry = self._load_registry()
         #: split-in-flight child regions, keyed (catalog, schema, table):
         #: hosted on disk but invisible to reads until apply_split swaps
         #: them into the table's served region set
-        self._pending_splits: Dict[tuple, Dict[int, Region]] = {}
+        self._pending_splits: Dict[tuple, Dict[int, Region]] = \
+            tracked_state({}, "mito.engine.pending_splits")
 
     # ---- engine registry (next id + table dirs) ----
     def _registry_key(self) -> str:

@@ -70,16 +70,18 @@ class SelfMonitor:
         self.catalog = instance.catalog
         self.node_label = node_label
         self.meta = meta
-        self._lock = threading.Lock()
+        from ..common.locks import TrackedLock
+        from ..common.tracking import tracked_state
+        self._lock = TrackedLock("monitor.scraper")
         self._task = None
         #: (node, region) -> (rows, monotonic_t) of the previous tick,
         #: for the locally-derived per-region ingest rate
         self._prev_heat: Dict[Tuple[str, str], Tuple[int, float]] = {}
-        self.stats: Dict[str, object] = {
+        self.stats: Dict[str, object] = tracked_state({
             "ticks": 0, "metric_rows": 0, "heat_rows": 0,
             "rows_written": 0, "retention_deleted": 0,
             "last_tick_ms": 0.0, "last_error": None,
-        }
+        }, "monitor.scraper.stats")
 
     # ---- lifecycle ----
     def start_background(self, interval_s: float = 30.0) -> None:

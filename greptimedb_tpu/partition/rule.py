@@ -26,14 +26,14 @@ from typing import Any, List, Optional, Sequence, Tuple
 class _MaxValue:
     """Sorts above every concrete value (singleton MAXVALUE sentinel)."""
 
-    def __repr__(self):
+    def __repr__(self) -> str:
         return "MAXVALUE"
 
 
 MAXVALUE = _MaxValue()
 
 
-def _lt(a, b) -> bool:
+def _lt(a: Any, b: Any) -> bool:
     """value < bound, where bound may be MAXVALUE."""
     if b is MAXVALUE:
         return True
@@ -64,7 +64,7 @@ class PartitionRule:
     def region_numbers(self) -> List[int]:
         raise NotImplementedError
 
-    def find_regions_by_filters(self, filters) -> List[int]:
+    def find_regions_by_filters(self, filters: Sequence) -> List[int]:
         """Prune regions by simple predicates (reference:
         src/partition/src/manager.rs:192). May return an empty list when
         the predicates are contradictory. Default: no pruning."""
@@ -95,7 +95,7 @@ class RangePartitionRule(PartitionRule):
             f"value {v!r} above all partition bounds of {self.column!r} "
             f"(missing MAXVALUE partition)")
 
-    def find_regions_by_filters(self, filters) -> List[int]:
+    def find_regions_by_filters(self, filters: Sequence) -> List[int]:
         from ..sql.ast import BinaryOp, Column, Literal
         cand = _equality_candidates(filters, [self.column])
         if self.column in cand:
@@ -113,7 +113,7 @@ class RangePartitionRule(PartitionRule):
         hi: Optional[Any] = None
         hi_strict = False              # v < hi (True) vs v <= hi (False)
 
-        def visit(e):
+        def visit(e: Any) -> None:
             nonlocal lo, hi, hi_strict
             if isinstance(e, BinaryOp):
                 if e.op == "and":
@@ -182,7 +182,7 @@ class RangeColumnsPartitionRule(PartitionRule):
             f"value {tuple(values)!r} above all partition bounds "
             f"(missing MAXVALUE partition)")
 
-    def find_regions_by_filters(self, filters) -> List[int]:
+    def find_regions_by_filters(self, filters: Sequence) -> List[int]:
         if len(self.columns) == 1:
             return RangePartitionRule(
                 self.columns[0], [b[0] for b in self.bounds],
@@ -190,7 +190,8 @@ class RangeColumnsPartitionRule(PartitionRule):
         return self.region_numbers()
 
 
-def _equality_candidates(filters, columns: Sequence[str]):
+def _equality_candidates(filters: Sequence,
+                         columns: Sequence[str]) -> dict:
     """Per-column candidate value sets proven by the filters' equality /
     IN conjuncts: {col: set(values)} — a column absent means the filters
     do not pin it. Conservative AND-only walk; OR and non-literal shapes
@@ -203,7 +204,7 @@ def _equality_candidates(filters, columns: Sequence[str]):
         cur = cand.get(name)
         cand[name] = values if cur is None else (cur & values)
 
-    def visit(e) -> None:
+    def visit(e: Any) -> None:
         if isinstance(e, BinaryOp):
             if e.op == "and":
                 visit(e.left)
@@ -289,7 +290,7 @@ class HashPartitionRule(PartitionRule):
                 f"hash rule over {self.columns} got {len(values)} values")
         return self.regions[self._bucket(values)]
 
-    def find_regions_by_filters(self, filters) -> List[int]:
+    def find_regions_by_filters(self, filters: Sequence) -> List[int]:
         import itertools
         cand = _equality_candidates(filters, self.columns)
         if any(c in cand and not cand[c] for c in self.columns):
@@ -362,7 +363,9 @@ def refine_range_rule(rule: PartitionRule, region: int, at_value: Any,
     return refined
 
 
-def rule_from_partitions(partitions, region_numbers=None) -> PartitionRule:
+def rule_from_partitions(partitions: Any,
+                         region_numbers: Optional[List[int]] = None
+                         ) -> PartitionRule:
     """Build a rule from a parsed `sql.ast.Partitions` clause."""
     if getattr(partitions, "kind", "range") == "hash":
         n = int(partitions.num_partitions or 0)

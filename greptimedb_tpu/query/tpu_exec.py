@@ -161,8 +161,11 @@ class _ScanCache:
                  budget_bytes: int = 4 << 30):
         self.capacity = capacity
         self.budget_bytes = budget_bytes
-        self._lock = threading.Lock()
-        self._entries: Dict[str, _CacheEntry] = {}   # insertion = LRU order
+        from ..common.locks import TrackedLock
+        from ..common.tracking import tracked_state
+        self._lock = TrackedLock("query.scan_cache")
+        self._entries: Dict[str, _CacheEntry] = tracked_state(
+            {}, "query.scan_cache.entries")          # insertion = LRU order
         # per-thread outcome of the most recent get(): "hit" /
         # "incremental" / "full" — read by the resident scan profiler
         self._last = threading.local()

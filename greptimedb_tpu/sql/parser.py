@@ -22,11 +22,16 @@ from .ast import (
     ShowFlows, ShowProcessList, ShowTables, ShowVariable, Star, Statement,
     Subquery, TableRef, Tql, TruncateTable, UnaryOp, Use,
 )
+from ..errors import SyntaxError_
 from .tokenizer import EOF, IDENT, NUMBER, OP, QIDENT, STRING, Token, tokenize
 
 
-class ParserError(ValueError):
-    pass
+class ParserError(SyntaxError_, ValueError):
+    """SQL parse failure. Joins the errors.* taxonomy (INVALID_SYNTAX)
+    so a parse error crossing any protocol boundary carries a real
+    status code (HTTP 400, not a generic 500 — the greptlint GL10
+    burn-down); still a ValueError for the pre-taxonomy `except
+    ValueError` call sites."""
 
 
 # keywords that terminate a SELECT item list's expression context

@@ -5,6 +5,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ..errors import SyntaxError_
+
 IDENT = "ident"
 QIDENT = "qident"     # "quoted" or `backticked` identifier
 STRING = "string"
@@ -29,8 +31,10 @@ class Token:
         return self.value.upper()
 
 
-class TokenizeError(ValueError):
-    pass
+class TokenizeError(SyntaxError_, ValueError):
+    """SQL tokenize failure: taxonomy-typed (INVALID_SYNTAX) for the
+    wire, ValueError for pre-taxonomy call sites — same dual contract
+    as ParserError (greptlint GL10)."""
 
 
 import re as _re

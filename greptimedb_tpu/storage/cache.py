@@ -33,7 +33,9 @@ class LruCacheLayer(ObjectStore):
         # NOT io_ok=False: _admit/_evict write and unlink blob files
         # while holding this lock (admission is serialized by design)
         self._lock = TrackedLock("storage.cache")
-        self._entries: "OrderedDict[str, int]" = OrderedDict()  # key→bytes
+        from ..common.tracking import tracked_state
+        self._entries: "OrderedDict[str, int]" = tracked_state(
+            OrderedDict(), "storage.cache.entries")       # key→bytes
         self._size = 0
         self.hits = 0
         self.misses = 0

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..common.locks import TrackedLock
+from ..common.tracking import tracked_state
 from ..datatypes import Schema
 from ..errors import RegionNotFoundError
 from .object_store import FsObjectStore, ObjectStore
@@ -64,7 +65,8 @@ class StorageEngine:
         self.store = store
         self.wal_home = config.wal_home or \
             os.path.join(config.data_home, "wal")
-        self._regions: Dict[str, Region] = {}
+        self._regions: Dict[str, Region] = tracked_state(
+            {}, "storage.engine.regions")
         self._lock = TrackedLock("storage.engine")
         self.scheduler = LocalScheduler(max_inflight=config.bg_workers,
                                         name="storage-bg")

@@ -59,8 +59,11 @@ class LocalScheduler:
         self.max_inflight = max(1, max_inflight)
         self.name = name
         self._lock = TrackedLock("storage.scheduler", io_ok=False)
-        self._queue: "OrderedDict[str, tuple]" = OrderedDict()
-        self._running: Dict[str, bool] = {}
+        from ..common.tracking import tracked_state
+        self._queue: "OrderedDict[str, tuple]" = tracked_state(
+            OrderedDict(), "storage.scheduler.queue")
+        self._running: Dict[str, bool] = tracked_state(
+            {}, "storage.scheduler.running")
         self._workers: list = []
         self._wake = threading.Condition(self._lock)
         self._stopped = False

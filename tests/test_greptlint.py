@@ -189,6 +189,139 @@ def test_rule_catalog_has_unique_ids_and_titles():
     assert all(r.title for r in ALL_RULES)
 
 
+# ---- interprocedural tier (GL10-GL12, ISSUE 10) ---------------------
+
+def test_callgraph_resolves_calls_and_drops_hubs(tmp_path):
+    """Name-based resolution with the hub cutoff: a unique callee links,
+    a name with more defs than hub_limit resolves to nothing (precision
+    over reach — the documented bias)."""
+    from greptimedb_tpu.devtools.greptlint.core import (build_context,
+                                                        collect_files)
+    mod = tmp_path / "m.py"
+    many = tmp_path / "many.py"
+    many.write_text("\n".join(
+        f"class C{i}:\n    def common(self):\n        pass"
+        for i in range(12)))
+    mod.write_text("def caller():\n    unique()\n    common()\n"
+                   "def unique():\n    pass\n"
+                   "def common():\n    pass\n")
+    files = collect_files([str(tmp_path)])
+    ctx = build_context(files, str(tmp_path))
+    cg = ctx.callgraph
+    [caller] = [f for f in cg.functions if f.name == "caller"]
+    assert {t.name for t in cg.targets("unique")} == {"unique"}
+    assert cg.targets("common") == []        # 13 defs > hub_limit: cut
+    assert "unique" in caller.calls
+    reach = cg.reachable([caller])
+    assert any(f.name == "unique" for f in reach)
+    assert not any(f.name == "common" for f in reach)
+
+
+def test_gl10_taxonomy_and_factory_raises_stay_clean(tmp_path):
+    """Raising a GreptimeError subclass (defined ANYWHERE, found by the
+    fixpoint) or the result of a lowercase converter factory must not
+    flag; an untyped class two calls up must."""
+    srv = tmp_path / "servers"
+    srv.mkdir()
+    (srv / "__init__.py").write_text("")
+    (srv / "flight.py").write_text(
+        "class GreptimeError(Exception):\n    pass\n"
+        "class MyTyped(GreptimeError):\n    pass\n"
+        "class Untyped(Exception):\n    pass\n"
+        "class Srv:\n"
+        "    def do_get(self, t):\n"
+        "        remote_context(None)\n"
+        "        a()\n"
+        "        b()\n"
+        "        c()\n"
+        "        d()\n"
+        "        e()\n"
+        "def a():\n    raise MyTyped('fine')\n"
+        "def b():\n    raise _convert('fine')\n"
+        "def c():\n    raise Untyped('flagged')\n"
+        "def d():\n    raise RuntimeError\n"        # bare class, no parens
+        "def e(exc=None):\n"
+        "    try:\n        a()\n"
+        "    except Exception as err:\n        raise err\n"
+        "def _convert(m):\n    return MyTyped(m)\n")
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    gl10 = [f for f in fresh if f.rule == "GL10"]
+    msgs = sorted(f.msg.split(" ")[1] for f in gl10)
+    assert msgs == ["RuntimeError", "Untyped"], gl10
+
+
+def test_gl11_fires_without_check_and_clears_with_it(tmp_path):
+    """The cancellation check can live in a CALLEE (interprocedural
+    coverage): adding check_cancelled anywhere on the loop's call path
+    clears the finding; removing it brings it back."""
+    q = tmp_path / "query"
+    q.mkdir()
+    (q / "__init__.py").write_text("")
+    bad = (
+        "register('objstore_read')\n"
+        "def do_query(files):\n"
+        "    for f in files:\n"
+        "        _read(f)\n"
+        "def _read(f):\n"
+        "    fail_point('objstore_read')\n")
+    (q / "exec.py").write_text(bad)
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    assert [f.rule for f in fresh if f.rule == "GL11"] == ["GL11"]
+    # the fix: a cancellation point inside the callee
+    (q / "exec.py").write_text(bad.replace(
+        "def _read(f):\n",
+        "def _read(f):\n    check_cancelled()\n"))
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    assert [f for f in fresh if f.rule == "GL11"] == []
+
+
+def test_gl12_flags_never_evaluated_and_unreachable_sites(tmp_path):
+    """Both death modes: a registered name with no fail_point site at
+    all, and one whose only site sits in an uncalled function; a site
+    reachable through a caller chain stays clean."""
+    mod = tmp_path / "sites.py"
+    mod.write_text(
+        "register('never_evaluated')\n"
+        "register('orphan_site')\n"
+        "register('live_site')\n"
+        "def _orphan():\n    fail_point('orphan_site')\n"
+        "def _live():\n    fail_point('live_site')\n"
+        "def flush():\n    _live()\n"
+        "def entry():\n    flush()\n")
+    fresh, _a, _e = lint_paths([str(mod)])
+    gl12 = sorted(f.msg.split("'")[1] for f in fresh
+                  if f.rule == "GL12")
+    assert gl12 == ["never_evaluated", "orphan_site"]
+
+
+def test_gl10_repo_burn_down_parser_errors_are_taxonomy_typed():
+    """Regression for the ISSUE 10 burn-down: ParserError/TokenizeError
+    joined the errors.* taxonomy, so a parse error crossing HTTP carries
+    INVALID_SYNTAX/400 instead of a generic 500."""
+    from greptimedb_tpu.errors import GreptimeError, StatusCode
+    from greptimedb_tpu.sql.parser import ParserError
+    from greptimedb_tpu.sql.tokenizer import TokenizeError
+    for cls in (ParserError, TokenizeError):
+        assert issubclass(cls, GreptimeError)
+        assert issubclass(cls, ValueError)       # pre-taxonomy catches
+        assert cls("x").status_code == StatusCode.INVALID_SYNTAX
+        assert cls("x").to_http_status() == 400
+
+
+def test_greptsan_baseline_only_shrinks():
+    """The baseline-only-shrinks assertion, extended to the greptsan
+    suppression file (ISSUE 10 satellite): burned to zero this PR, and
+    zero is a floor it can never rise from."""
+    import json
+    path = os.path.join(REPO, ".greptsan-baseline.json")
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc.get("version") == 1
+    assert doc.get("suppressions") == {}, (
+        "the greptsan suppression baseline only ever shrinks, and it "
+        "reached zero in ISSUE 10 — fix races, don't suppress them")
+
+
 def test_mypy_scoped_modules_are_green():
     """Scoped type check (mypy.ini: common/, errors.py, utils/,
     devtools/). Skips where mypy isn't installed (the build image);
