@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check lint typecheck test test-slow race baseline bench
+.PHONY: check lint typecheck test test-slow race baseline bench bench-qps
 
 check: lint typecheck test
 
@@ -50,3 +50,8 @@ baseline:
 
 bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py
+
+# only the ISSUE 12 front-door metric: 1000-logical-client mixed
+# workload QPS × p99 + the WAL group-commit on/off differential
+bench-qps:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=concurrent_qps $(PY) bench.py

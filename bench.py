@@ -380,6 +380,200 @@ def bench_self_monitoring_overhead(n_rows: int):
     return len(ts) / dt_on, overhead, ticks_seen
 
 
+def bench_concurrent_qps(n_clients: int = 1000):
+    """Eighth driver metric (ISSUE 12): the missing dimension — sustained
+    QPS × tail latency under a 1000-logical-client MIXED workload (small
+    point scans + remote-write bursts through the ingest coalescer)
+    against a persisted region, plus the WAL group-commit on/off
+    differential on fsync-enabled concurrent ingest.
+
+    The differential is published twice: `raw` on this box's fsync (a
+    VM write cache makes fsync ~0.15 ms, cheaper than the Python write
+    path, so raw barely moves), and `fsync2ms` with a modeled 2 ms
+    device sync via the existing wal_fsync delay failpoint — the
+    hardware-independent number (same technique as the dist-scatter
+    metric's modeled 10 ms RPC hop). The assert keeps the modeled
+    differential honest; BASELINE.md publishes both."""
+    import shutil
+    import tempfile
+    import threading
+    import timeit
+    from queue import Queue
+
+    from greptimedb_tpu.common import failpoint as fp
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    from greptimedb_tpu.servers.coalesce import COALESCER
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.storage.wal import Wal, configure_group_commit
+    from greptimedb_tpu.storage.write_batch import WriteBatch
+
+    # ---- (a) raw Wal.append cost (the hoisted-import satellite) ----
+    wal_dir = tempfile.mkdtemp(prefix="bench-qps-wal-")
+    w = Wal(wal_dir, sync_on_write=False)
+    seq_box = [0]
+
+    def one_append():
+        seq_box[0] += 1
+        w.append(seq_box[0], b"x" * 64)
+
+    append_ns = timeit.timeit(one_append, number=50_000) / 50_000 * 1e9
+    w.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+
+    # ---- (b) group-commit differential on fsync-enabled ingest ----
+    from greptimedb_tpu.datatypes import Schema
+    from greptimedb_tpu.datatypes.data_type import (
+        FLOAT64, STRING, TIMESTAMP_MILLISECOND)
+    from greptimedb_tpu.datatypes.schema import ColumnSchema, SemanticType
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+    from greptimedb_tpu.storage.region import Region, RegionDescriptor
+
+    schema = Schema([
+        ColumnSchema("host", STRING, nullable=False,
+                     semantic_type=SemanticType.TAG),
+        ColumnSchema("ts", TIMESTAMP_MILLISECOND, nullable=False,
+                     semantic_type=SemanticType.TIMESTAMP),
+        ColumnSchema("v", FLOAT64),
+    ])
+    n_threads, per, rows_per = 16, 8, 20
+
+    def sync_ingest_once(group_on: bool, delay_ms: int) -> float:
+        configure_group_commit(enabled=group_on)
+        home = tempfile.mkdtemp(prefix="bench-qps-gc-")
+        try:
+            region = Region.create(
+                RegionDescriptor("gc", schema, "gc",
+                                 os.path.join(home, "wal")),
+                FsObjectStore(os.path.join(home, "data")),
+                wal=Wal(os.path.join(home, "wal"), sync_on_write=True))
+            errs = []
+
+            def writer(i):
+                try:
+                    for j in range(per):
+                        wb = WriteBatch(region.schema)
+                        base = (i * per + j) * rows_per
+                        wb.put({"host": [f"h{i}"] * rows_per,
+                                "ts": list(range(base, base + rows_per)),
+                                "v": [1.0] * rows_per})
+                        region.write(wb)
+                except Exception as e:  # noqa: BLE001 — assert below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=writer, args=(i,))
+                       for i in range(n_threads)]
+            import contextlib
+            ctx = fp.cfg("wal_fsync", f"delay({delay_ms})") if delay_ms \
+                else contextlib.nullcontext()
+            with ctx:
+                t0 = time.perf_counter()
+                [t.start() for t in threads]
+                [t.join() for t in threads]
+                dt = time.perf_counter() - t0
+            assert not errs, errs
+            got = region.snapshot().read_merged().num_rows
+            assert got == n_threads * per * rows_per, got
+            region.close()
+            return dt
+        finally:
+            shutil.rmtree(home, ignore_errors=True)
+
+    sync_ingest_once(True, 0)                 # absorb one-time costs
+    ratios = {}
+    for label, delay in (("raw", 0), ("fsync2ms", 2)):
+        dt_on = min(sync_ingest_once(True, delay) for _ in range(2))
+        dt_off = min(sync_ingest_once(False, delay) for _ in range(2))
+        ratios[label] = dt_off / dt_on
+    configure_group_commit(enabled=True)
+    assert ratios["fsync2ms"] > 1.5, (
+        f"group commit only {ratios['fsync2ms']:.2f}x on modeled-fsync "
+        f"concurrent ingest — the shared fsync is not being shared")
+
+    # ---- (c) 1000-logical-client mixed workload over a persisted
+    # region: sustained QPS and p50/p95/p99 ----
+    home = tempfile.mkdtemp(prefix="bench-qps-")
+    try:
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=home, register_numbers_table=False))
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        fe.do_query("CREATE TABLE qps (host STRING, ts TIMESTAMP "
+                    "TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+        table = fe.catalog.table("greptime", "public", "qps")
+        hosts = 64
+        per_host = 512
+        host_col = np.repeat(
+            np.array([f"h{i}" for i in range(hosts)]), per_host
+        ).astype(object)
+        ts_col = np.tile(
+            np.arange(per_host, dtype=np.int64) * 1000, hosts)
+        table.bulk_load({"host": host_col, "ts": ts_col,
+                         "v": np.random.default_rng(7).random(
+                             hosts * per_host)})
+        table.flush()                          # persisted region
+
+        ops_per_client = 4                     # 3 point scans + 1 burst
+        latencies = []
+        lat_lock = threading.Lock()
+        work: "Queue[int]" = Queue()
+        for c in range(n_clients):
+            work.put(c)
+        errs = []
+
+        def client_ops(c: int):
+            ctx = QueryContext()
+            local = []
+            for k in range(ops_per_client):
+                t0 = time.perf_counter()
+                if k < 3:
+                    fe.do_query(
+                        f"SELECT v FROM qps WHERE host = "
+                        f"'h{(c * 7 + k) % hosts}' LIMIT 5")
+                else:
+                    COALESCER.ingest(
+                        fe, "qps_rw",
+                        {"ts": [int(time.time() * 1000) + c],
+                         "host": [f"h{c % hosts}"],
+                         "v": [float(c)]},
+                        tag_columns=("host",), timestamp_column="ts",
+                        ctx=ctx)
+                local.append(time.perf_counter() - t0)
+            with lat_lock:
+                latencies.extend(local)
+
+        def worker():
+            while True:
+                try:
+                    c = work.get_nowait()
+                except Exception:  # noqa: BLE001 — queue drained
+                    return
+                try:
+                    client_ops(c)
+                except Exception as e:  # noqa: BLE001 — assert below
+                    errs.append(e)
+
+        n_workers = 32
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_workers)]
+        t0 = time.perf_counter()
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        wall = time.perf_counter() - t0
+        assert not errs, errs[:3]
+        assert len(latencies) == n_clients * ops_per_client
+        lat_ms = np.sort(np.array(latencies)) * 1e3
+        qps = len(latencies) / wall
+        p50, p95, p99 = (float(np.percentile(lat_ms, p))
+                         for p in (50, 95, 99))
+        fe.shutdown()
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
+    return qps, p50, p95, p99, ratios, append_ns
+
+
 def bench_lock_overhead():
     """Sixth driver metric (ISSUE 7): the lock-order detector's
     inactive-mode cost, same methodology as the failpoint ~190ns/call
@@ -770,7 +964,30 @@ def bench_region_migration_availability(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def emit_concurrent_qps():
+    """The ISSUE 12 metric, runnable alone via `make bench-qps`
+    (GREPTIME_BENCH_ONLY=concurrent_qps)."""
+    n_clients = int(os.environ.get("GREPTIME_BENCH_QPS_CLIENTS", 1000))
+    qps, p50, p95, p99, ratios, append_ns = \
+        bench_concurrent_qps(n_clients)
+    print(json.dumps({
+        "metric": "concurrent_qps_p99",
+        "value": round(qps, 0),
+        "unit": "qps",
+        "clients": n_clients,
+        "p50_ms": round(p50, 2),
+        "p95_ms": round(p95, 2),
+        "p99_ms": round(p99, 2),
+        "group_commit_speedup_fsync2ms": round(ratios["fsync2ms"], 2),
+        "group_commit_speedup_raw": round(ratios["raw"], 2),
+        "wal_append_ns": round(append_ns, 0),
+    }))
+
+
 def main():
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "concurrent_qps":
+        emit_concurrent_qps()
+        return
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
 
@@ -892,6 +1109,8 @@ def main():
         "inactive_ratio": round(san_ratio, 3),
         "active_mode_ns_per_get": round(san_active_ns, 1),
     }))
+
+    emit_concurrent_qps()
 
 
 if __name__ == "__main__":

@@ -59,13 +59,17 @@ def _to_greptime_error(e: flight.FlightError) -> GreptimeError:
     distributed fan-out's retry loop recognizes real network hops; the
     'stale route' marker maps to StaleRouteError so the DistTable's
     route-refresh retry works across real sockets too."""
-    from ..errors import StaleRouteError, TransientRpcError
+    from ..errors import OverloadedError, StaleRouteError, TransientRpcError
     msg = str(e).split(". gRPC client debug context:")[0]
     if isinstance(e, (flight.FlightUnavailableError,
                       flight.FlightTimedOutError)):
         return TransientRpcError(msg)
     if StaleRouteError.WIRE_MARKER in msg:
         return StaleRouteError(msg)
+    if OverloadedError.WIRE_MARKER in msg:
+        # admission rejection crossing the wire: keep the type so a
+        # routing frontend re-maps it to 429/server-busy, not 500
+        return OverloadedError(msg)
     if "not found" in msg or "not on datanode" in msg:
         return TableNotFoundError(msg)
     return GreptimeError(msg)
@@ -101,6 +105,9 @@ class _FlightBase:
             if resp.get("error_type") == "StaleRouteError":
                 from ..errors import StaleRouteError
                 raise StaleRouteError(err)
+            if resp.get("error_type") == "OverloadedError":
+                from ..errors import OverloadedError
+                raise OverloadedError(err)
             raise GreptimeError(err)
         return resp
 

@@ -35,11 +35,9 @@ _pools = {}
 _SIZES = {"bg": 4, "read": 8, "write": 8, "dist": 16}
 
 
-def env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+from ..utils import env_flag, env_float, env_int  # noqa: F401 — canonical
+# impl in the utils leaf module (storage/ imports it too); re-exported
+# here because runtime is where knob readers historically find env_int
 
 
 #: per-query bound on concurrently in-flight datanode RPCs (the pool

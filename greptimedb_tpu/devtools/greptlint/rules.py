@@ -601,16 +601,27 @@ class UncancellableLoop(Rule):
     id = "GL11"
     title = ("cancellation reachability: every loop over SST files / "
              "regions / RPC futures / streamed slices reachable from "
-             "statement execution must pass through check_cancelled() — "
-             "KILL <id> otherwise cannot interrupt it")
+             "statement execution must pass through check_cancelled(), "
+             "and every cohort-wait loop (WAL group commit, ingest "
+             "coalescer, scan fusion) must bound its waits or reach "
+             "check_cancelled() — KILL <id> otherwise cannot interrupt "
+             "it, and a dead leader wedges the cohort")
 
     #: loops are only *scanned* in the read/execution layers — write-side
     #: and background loops (flush, compaction, purge) must NOT be
-    #: cancellable mid-flight, their atomicity is the crash-safety story
+    #: cancellable mid-flight, their atomicity is the crash-safety story.
+    #: wal.py and coalesce.py join the scope for their group-commit /
+    #: coalescer cohort-wait loops (requests park there mid-statement)
     SCAN_DIRS = ("query", "promql", "selftest")
-    SCAN_MODULES = ("storage/region.py", "frontend/distributed.py")
+    SCAN_MODULES = ("storage/region.py", "frontend/distributed.py",
+                    "storage/wal.py", "servers/coalesce.py")
     #: RPC leaf calls that make a loop iteration remote-heavy
     RPC_CALLS = frozenset({"_dist_rpc"})
+    #: leaf calls that PARK the thread (Event.wait / Condition.wait):
+    #: inside a loop they must carry a timeout or the loop must reach a
+    #: cancellation point — an unbounded park can neither be KILLed nor
+    #: outlive a dead group-commit/coalesce leader
+    WAIT_CALLS = frozenset({"wait"})
 
     def _roots(self, ctx: ProjectContext) -> Iterator:
         for fn in ctx.callgraph.functions:
@@ -673,13 +684,15 @@ class UncancellableLoop(Rule):
                 stack.extend(ast.iter_child_nodes(node))
 
         for fn in cg.functions:
-            if fn.mod is not mod or fn not in reach:
+            if fn.mod is not mod:
                 continue
+            in_reach = fn in reach
             for loop in _shallow_nodes(fn.node):
                 if not isinstance(loop, (ast.For, ast.While)):
                     continue
                 io_heavy = False
                 covered = False
+                unbounded_wait = False
                 for node in body_nodes(loop):
                     if not isinstance(node, ast.Call):
                         continue
@@ -691,6 +704,15 @@ class UncancellableLoop(Rule):
                             _str_arg0(node) in IO_FAILPOINT_SITES:
                         io_heavy = True
                         continue
+                    if leaf in self.WAIT_CALLS and \
+                            isinstance(node.func, ast.Attribute) and \
+                            not node.args and \
+                            not any(kw.arg == "timeout"
+                                    for kw in node.keywords):
+                        # x.wait() with neither a positional nor a
+                        # timeout= bound: the park can outlive its waker
+                        unbounded_wait = True
+                        continue
                     targets = cg.targets(leaf)
                     if any(t in can_reach for t in targets):
                         covered = True
@@ -698,7 +720,9 @@ class UncancellableLoop(Rule):
                     if leaf in self.RPC_CALLS or \
                             any(t in io_reach for t in targets):
                         io_heavy = True
-                if io_heavy and not covered:
+                if covered:
+                    continue
+                if io_heavy and in_reach:
                     yield mod.finding(
                         self.id, loop,
                         f"loop in {fn.qual} does per-iteration I/O or "
@@ -706,6 +730,18 @@ class UncancellableLoop(Rule):
                         f"execution, and never passes through "
                         f"check_cancelled() — KILL cannot interrupt it "
                         f"at a batch boundary")
+                elif unbounded_wait:
+                    # cohort-wait loops are flagged regardless of the
+                    # do_query reach set: protocol-ingest waits (the
+                    # coalescer) park request threads do_query never sees
+                    yield mod.finding(
+                        self.id, loop,
+                        f"wait loop in {fn.qual} parks without a "
+                        f"timeout and never passes through "
+                        f"check_cancelled() — a dead group-commit/"
+                        f"coalesce leader (or a KILL on the waiting "
+                        f"statement) wedges it forever; bound the wait "
+                        f"(timeout=...) or add a cancellation point")
 
 
 class DeadFailpoint(Rule):

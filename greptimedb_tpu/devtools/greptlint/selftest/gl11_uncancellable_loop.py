@@ -1,19 +1,12 @@
-"""Seeded GL11 violation: a per-file I/O loop reachable from statement
-execution (`do_query` is a root) that never passes through
-check_cancelled() — a KILL could not interrupt it at a batch boundary.
-The failpoint name is registered here so GL04 stays quiet; the site's
-enclosing function has a caller so GL12 stays quiet too."""
-
-register("objstore_read")  # noqa: F821 — parsed, never run
-
-
-def do_query(sst_files):
-    out = []
-    for f in sst_files:            # the uncancellable batch loop
-        out.append(_read_one(f))
-    return out
+"""Seeded GL11 violation: a cohort-wait loop (the WAL group-commit /
+ingest-coalescer shape) that parks on an event with neither a bounded
+timeout nor a cancellation point — a dead leader wedges every follower
+forever and KILL cannot interrupt the park. The interprocedural
+I/O-loop form of GL11 is seeded by
+tests/test_greptlint.py::test_gl11_fires_without_check_and_clears_with_it."""
 
 
-def _read_one(f):
-    fail_point("objstore_read")  # noqa: F821 — blocking-I/O site
-    return f
+def follow_cohort(batch):
+    while not batch.done.is_set():     # the unbounded cohort wait
+        batch.done.wait()
+    return batch.result

@@ -158,6 +158,25 @@ class TransientRpcError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class OverloadedError(GreptimeError):
+    """The frontend's admission gate rejected new work: in-flight
+    statements or queued ingest bytes are past the configured limits.
+    Reject-with-retry-after, never collapse: HTTP maps it to 429 with a
+    ``Retry-After`` header (`to_http_status` → RATE_LIMITED → 429),
+    MySQL to a clean server-busy error (1040), Postgres to SQLSTATE
+    53300. Carries the ``overloaded`` wire marker so Flight's
+    string-flattened errors rebuild the type client-side."""
+
+    status_code = StatusCode.RATE_LIMITED
+    WIRE_MARKER = "server overloaded"
+
+    def __init__(self, msg: str, *, retry_after_s: int = 1):
+        if self.WIRE_MARKER not in msg:
+            msg = f"{self.WIRE_MARKER}: {msg}"
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class QueryCancelledError(GreptimeError):
     """The statement was killed (`KILL <id>`): cooperative cancellation
     fired at a batch boundary in the streamed scan / scatter-gather

@@ -919,8 +919,19 @@ class DistInstance:
                 catalog_name=catalog, schema_name=schema_name)
             table = DistTable(info, None, route, self.clients,
                               meta=self.meta)
-            self.catalog.register_table(catalog, schema_name, table_name,
-                                        table)
+            from ..errors import TableAlreadyExistsError
+            try:
+                self.catalog.register_table(catalog, schema_name,
+                                            table_name, table)
+            except TableAlreadyExistsError:
+                # concurrent protocol auto-create race (coalesced ingest
+                # makes first-write storms normal): adopt the winner's
+                # registration — the datanode-side create was already
+                # if-not-exists
+                existing = self._resolve_table(catalog, schema_name,
+                                               table_name)
+                if existing is not None:
+                    table = existing
         else:
             missing = [n for n in columns
                        if not table.schema.contains(n)]
@@ -1003,9 +1014,13 @@ class DistInstance:
             increment_counter, observe_latency, slow_query_threshold_ms,
             span, timer)
         from ..sql import parse_statements
+        from ..common.admission import GATE as _admission
         ctx = ctx or QueryContext()
         outs = []
         for stmt in parse_statements(sql):
+            # same admission gate as the standalone frontend: reject
+            # past the in-flight limit, KILL/SET always admitted
+            _admission.admit_statement(type(stmt).__name__)
             t0 = _time.perf_counter()
             prev_stats = getattr(self.query_engine, "last_exec_stats",
                                  None)

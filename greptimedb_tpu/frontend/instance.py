@@ -90,8 +90,12 @@ class FrontendInstance:
         from ..common.telemetry import (
             increment_counter, observe_latency, slow_query_threshold_ms,
             span, timer)
+        from ..common.admission import GATE as _admission
         outputs = []
         for s in stmts:
+            # admission gate: reject-with-retry-after past the in-flight
+            # limit (KILL/SET stay admitted — the operator's way out)
+            _admission.admit_statement(type(s).__name__)
             if interceptor is not None:
                 interceptor.pre_execute(s, ctx)
             t0 = _time.perf_counter()
@@ -221,10 +225,20 @@ class FrontendInstance:
             table = self._create_on_demand(
                 catalog, schema_name, table_name, columns, tag_columns,
                 timestamp_column, types)
+            # a concurrent protocol auto-create may have won the race
+            # with a NARROWER shape (coalesced ingest makes first-write
+            # storms normal): fall through to alter-on-demand against
+            # the adopted table so this request's field columns exist
+            self._alter_on_demand(table, catalog, schema_name, table_name,
+                                  columns, types, tag_columns)
         else:
             self._alter_on_demand(table, catalog, schema_name, table_name,
                                   columns, types, tag_columns)
-            table = self.catalog.table(catalog, schema_name, table_name)
+        # re-fetch for the post-alter schema; a concurrent DROP may have
+        # emptied the slot — keep the handle we hold (its closed region
+        # raises a clean taxonomy error, not AttributeError on None)
+        table = self.catalog.table(catalog, schema_name, table_name) \
+            or table
         return table.insert(columns)
 
     def handle_bulk_load(
@@ -268,7 +282,18 @@ class FrontendInstance:
             table_name, schema, catalog_name=catalog,
             schema_name=schema_name, primary_key_indices=pk,
             create_if_not_exists=True))
-        self.catalog.register_table(catalog, schema_name, table_name, table)
+        from ..errors import TableAlreadyExistsError
+        try:
+            self.catalog.register_table(catalog, schema_name, table_name,
+                                        table)
+        except TableAlreadyExistsError:
+            # concurrent auto-create race: a sibling protocol request
+            # registered first — adopt its table (the engine-level create
+            # was already if-not-exists, only the catalog insert raced)
+            existing = self.catalog.table(catalog, schema_name, table_name)
+            if existing is not None:
+                return existing
+            raise
         return table
 
     def _alter_on_demand(self, table, catalog, schema_name, table_name,

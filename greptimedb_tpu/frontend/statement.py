@@ -312,6 +312,52 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
             from ..query import tpu_exec
             tpu_exec.TPU_DISPATCH_MIN_ROWS = value
             tpu_exec._observed_min_dt[0] = None
+    elif name in ("wal_group_commit", "wal_group_max_wait_us",
+                  "wal_group_max_batch"):
+        # WAL group-commit knobs: concurrent sync_on_write writers share
+        # one fsync; the toggle is the bench differential's kill switch
+        from ..storage.wal import configure_group_commit
+        value = _int_setting(stmt)
+        try:
+            if name == "wal_group_commit":
+                configure_group_commit(enabled=bool(value))
+            elif name == "wal_group_max_wait_us":
+                configure_group_commit(max_wait_us=value)
+            else:
+                configure_group_commit(max_batch=value)
+        except ValueError as e:
+            raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+    elif name in ("ingest_coalesce", "ingest_coalesce_window_ms"):
+        # protocol-ingest coalescer (servers/coalesce.py): merge
+        # concurrent small same-table writes into shared bulk batches
+        from ..servers.coalesce import configure_coalescer
+        value = _int_setting(stmt)
+        try:
+            if name == "ingest_coalesce":
+                configure_coalescer(enabled=bool(value))
+            else:
+                configure_coalescer(window_ms=value)
+        except ValueError as e:
+            raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+    elif name == "scan_fusion":
+        # single-flight fusion of concurrent identical small scans of
+        # one region (query/tpu_exec.py); 0 = every scan solo
+        from ..query import tpu_exec
+        tpu_exec.configure_scan_fusion(enabled=bool(_int_setting(stmt)))
+    elif name in ("admission_max_inflight", "admission_max_queued_bytes",
+                  "admission_retry_after_s"):
+        # admission gate (common/admission.py): 0 disables a dimension
+        from ..common.admission import GATE
+        value = _int_setting(stmt)
+        try:
+            if name == "admission_max_inflight":
+                GATE.configure(max_inflight=value)
+            elif name == "admission_max_queued_bytes":
+                GATE.configure(max_queued_bytes=value)
+            else:
+                GATE.configure(retry_after_s=value)
+        except ValueError as e:
+            raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
     elif name == "self_monitor_retention_ms":
         # retention window for greptime_private.node_metrics /
         # region_heat (monitor/scraper.py sweeps on each tick;

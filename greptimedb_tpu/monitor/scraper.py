@@ -112,7 +112,11 @@ class SelfMonitor:
                 # not appear in the samples it persists
                 samples = registry_snapshot()
                 heat = self._heat_rows()
-                with suppress_metrics():
+                from ..common import admission
+                with suppress_metrics(), admission.exempt():
+                    # admission-exempt like the metrics suppression:
+                    # shedding the observer during overload would blind
+                    # the operator exactly when they need the data
                     written = self._write_metrics(samples, now_ms)
                     written += self._write_heat(heat, now_ms)
                     deleted = self._enforce_retention(now_ms)

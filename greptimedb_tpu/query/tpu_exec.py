@@ -391,6 +391,131 @@ SCAN_CACHE = _ScanCache()
 
 
 # ---------------------------------------------------------------------------
+# concurrent scan fusion: single-flight over identical resident scans
+# ---------------------------------------------------------------------------
+
+#: SET scan_fusion toggles; single-slot swap (no lock needed for a read)
+from ..utils import env_flag as _env_flag  # noqa: E402
+
+_FUSION_ENABLED = [_env_flag("GREPTIME_SCAN_FUSION", True)]
+#: bounded park for a follower on the leader's pass — a dead leader
+#: degrades to a solo scan, never a hang
+_FUSION_WAIT_TIMEOUT_S = 30.0
+
+
+def configure_scan_fusion(*, enabled: Optional[bool] = None) -> None:
+    if enabled is not None:
+        _FUSION_ENABLED[0] = bool(enabled)
+
+
+class _FlightEntry:
+    """One in-flight region reduction shared by its cohort."""
+
+    __slots__ = ("done", "frame", "failed")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.frame: Optional[pd.DataFrame] = None
+        self.failed = False
+
+
+class _ScanFlightMap:
+    """Single-flight map keyed on (region identity, visible data state,
+    plan fingerprint): concurrent identical-shape small scans of the
+    same region fuse into ONE shared pass — the leader decodes, the
+    cohort adopts its moment frame. The data-state component of the key
+    (committed sequence + retraction epoch, sampled at request start)
+    keeps read-your-writes intact: a scan that begins after a write is
+    acked can never fuse onto a pass that predates the write."""
+
+    def __init__(self) -> None:
+        from ..common.locks import TrackedLock
+        from ..common.tracking import tracked_state
+        self._lock = TrackedLock("query.scan_fusion")
+        self._inflight: Dict[tuple, _FlightEntry] = tracked_state(
+            {}, "query.scan_fusion.inflight")
+
+    def execute(self, region, table, plan: "TpuPlan"):
+        from ..common import exec_stats, process_list
+        from ..common.telemetry import increment_counter
+        if not _FUSION_ENABLED[0]:
+            # check BEFORE fingerprinting: the opt-out must not pay the
+            # plan serialization on every region of every scan
+            return _execute_region(region, table, plan)
+        key = self._key(region, plan)
+        if key is None:
+            return _execute_region(region, table, plan)
+        with self._lock:
+            entry = self._inflight.get(key)
+            leader = entry is None
+            if leader:
+                entry = _FlightEntry()
+                self._inflight[key] = entry
+        if leader:
+            try:
+                entry.frame = _execute_region(region, table, plan)
+            except BaseException:
+                # cohort members fall back to their own solo scans: the
+                # leader's failure may be leader-specific (a KILL on its
+                # statement must not kill nine bystanders)
+                entry.failed = True
+                raise
+            finally:
+                entry.done.set()
+                with self._lock:
+                    self._inflight.pop(key, None)
+            increment_counter("scan_fusion_leader")
+            return entry.frame
+        # follower: bounded park on the leader's shared pass
+        import time as _time
+        t0 = _time.perf_counter()
+        deadline = _time.monotonic() + _FUSION_WAIT_TIMEOUT_S
+        while not entry.done.wait(timeout=0.05):
+            process_list.check_cancelled()    # killed mid-wait: bail out
+            if _time.monotonic() > deadline:
+                break
+        if not entry.done.is_set() or entry.failed:
+            return _execute_region(region, table, plan)
+        increment_counter("scan_fusion_follower")
+        # EXPLAIN ANALYZE surfaces the fusion: this statement's region
+        # pass was adopted from a concurrent leader, not re-decoded
+        exec_stats.record(
+            "fused-follower",
+            rows=0 if entry.frame is None else len(entry.frame),
+            elapsed_s=_time.perf_counter() - t0, region=region.name)
+        # hand back a copy: cohort members' downstream folds must never
+        # share mutable frames (small scans — the copy is cheap)
+        return None if entry.frame is None else entry.frame.copy()
+
+    @staticmethod
+    def _key(region, plan: "TpuPlan") -> Optional[tuple]:
+        vc = getattr(region, "version_control", None)
+        if vc is None:
+            return None
+        # fingerprint once per PLAN object, not once per region: a
+        # multi-region scan serializes the identical plan only once
+        fp = getattr(plan, "_fusion_fp", None)
+        if fp is None:
+            try:
+                from .plan_codec import plan_to_dict
+                import json
+                fp = json.dumps(plan_to_dict(plan), sort_keys=True,
+                                default=str)
+            except Exception:  # noqa: BLE001 — unshippable: no fusion
+                from ..common.telemetry import increment_counter
+                increment_counter("scan_fusion_unfingerprintable")
+                fp = False
+            plan._fusion_fp = fp
+        if fp is False:
+            return None
+        return (region.uid, vc.committed_sequence,
+                getattr(region, "retraction_epoch", 0), fp)
+
+
+SCAN_FLIGHTS = _ScanFlightMap()
+
+
+# ---------------------------------------------------------------------------
 # plan
 # ---------------------------------------------------------------------------
 
@@ -933,7 +1058,9 @@ def region_moment_frames(table, plan: TpuPlan,
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))
             continue
-        part = _execute_region(region, table, plan)
+        # single-flight: identical concurrent scans of this region fuse
+        # into one shared pass (followers adopt the leader's frame)
+        part = SCAN_FLIGHTS.execute(region, table, plan)
         if part is not None and len(part):
             frames.append(part)
     return frames

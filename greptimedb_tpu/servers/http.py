@@ -136,11 +136,20 @@ class HttpServer:
                 {"code": int(StatusCode.USER_PASSWORD_MISMATCH),
                  "error": str(e)}, status=401)
         except GreptimeError as e:
+            code = getattr(e, "status_code", StatusCode.INTERNAL)
+            headers = None
+            status = 400
+            if code == StatusCode.RATE_LIMITED:
+                # admission rejection: reject-with-retry-after, the
+                # load-shedding contract (errors.py maps the code → 429)
+                status = e.to_http_status()
+                headers = {"Retry-After":
+                           str(getattr(e, "retry_after_s", 1))}
             return web.json_response(
-                {"code": int(getattr(e, "status_code", StatusCode.INTERNAL)),
+                {"code": int(code),
                  "error": str(e),
                  "execution_time_ms": int((time.perf_counter() - start) * 1e3)},
-                status=400)
+                status=status, headers=headers)
         except web.HTTPException:
             raise
         except Exception as e:  # pragma: no cover - defensive
@@ -309,14 +318,23 @@ class HttpServer:
         loop = asyncio.get_running_loop()
 
         def work():
-            parsed = influx_mod.parse_lines(body, precision)
-            inserts, tag_cols = influx_mod.lines_to_inserts(parsed)
-            n = 0
-            for table, cols in inserts.items():
-                n += self.frontend.handle_row_insert(
-                    table, cols, tag_columns=tag_cols[table],
-                    timestamp_column=influx_mod.GREPTIME_TIMESTAMP, ctx=ctx)
-            return n
+            from ..common.admission import GATE
+            from .coalesce import COALESCER
+            with GATE.admit_ingest(len(body)):
+                inserts, tag_cols = influx_mod.body_to_inserts(body,
+                                                               precision)
+                n = 0
+                for table, cols in inserts.items():
+                    # concurrent small bodies for the same measurement
+                    # merge into one shared bulk insert (one WAL record,
+                    # one group-commit fsync) — the ack still covers
+                    # exactly this request's rows
+                    n += COALESCER.ingest(
+                        self.frontend, table, cols,
+                        tag_columns=tag_cols[table],
+                        timestamp_column=influx_mod.GREPTIME_TIMESTAMP,
+                        ctx=ctx)
+                return n
 
         await loop.run_in_executor(None, self._traced_call(request, work))
         return web.Response(status=204)
@@ -343,17 +361,25 @@ class HttpServer:
 
     async def handle_opentsdb_put(self, request):
         ctx = self._ctx(request)
-        body = await request.json()
+        raw = await request.read()
         loop = asyncio.get_running_loop()
 
         def work():
-            points = tsdb_mod.parse_http_put(body)
-            inserts, tag_cols = tsdb_mod.points_to_inserts(points)
-            for table, cols in inserts.items():
-                self.frontend.handle_row_insert(
-                    table, cols, tag_columns=tag_cols[table],
-                    timestamp_column=tsdb_mod.GREPTIME_TIMESTAMP, ctx=ctx)
-            return len(points)
+            from ..common.admission import GATE
+            from .coalesce import COALESCER
+            # reserve the RAW body size like the influx/prom handlers —
+            # a short-metric-name flood must not slip a big JSON body
+            # past the byte gate
+            with GATE.admit_ingest(len(raw)):
+                points = tsdb_mod.parse_http_put(json.loads(raw))
+                inserts, tag_cols = tsdb_mod.points_to_inserts(points)
+                for table, cols in inserts.items():
+                    COALESCER.ingest(
+                        self.frontend, table, cols,
+                        tag_columns=tag_cols[table],
+                        timestamp_column=tsdb_mod.GREPTIME_TIMESTAMP,
+                        ctx=ctx)
+                return len(points)
 
         n = await loop.run_in_executor(None,
                                        self._traced_call(request, work))
@@ -365,12 +391,16 @@ class HttpServer:
         loop = asyncio.get_running_loop()
 
         def work():
-            series = prom_mod.decode_write_request(body)
-            inserts, tag_cols = prom_mod.series_to_inserts(series)
-            for table, cols in inserts.items():
-                self.frontend.handle_row_insert(
-                    table, cols, tag_columns=tag_cols[table],
-                    timestamp_column=prom_mod.GREPTIME_TIMESTAMP, ctx=ctx)
+            from ..common.admission import GATE
+            from .coalesce import COALESCER
+            with GATE.admit_ingest(len(body)):
+                inserts, tag_cols = prom_mod.write_request_to_inserts(body)
+                for table, cols in inserts.items():
+                    COALESCER.ingest(
+                        self.frontend, table, cols,
+                        tag_columns=tag_cols[table],
+                        timestamp_column=prom_mod.GREPTIME_TIMESTAMP,
+                        ctx=ctx)
 
         await loop.run_in_executor(None, self._traced_call(request, work))
         return web.Response(status=204)
@@ -503,8 +533,10 @@ class HttpServer:
             if errs:
                 background_errors[r.name] = errs
         from ..common import failpoint
+        from ..common.admission import GATE
         return web.json_response({
             "version": __version__,
+            "admission": GATE.snapshot(),
             "uptime_s": round(time.time() - self._start_time, 3),
             "region_count": len(regions),
             "read_cache_hit_ratio": ratio,

@@ -101,6 +101,13 @@ def parse_lines(body: str, precision: str = "ns"
     return out
 
 
+def body_to_inserts(body: str, precision: str = "ns"):
+    """Line-protocol body → (per-measurement column dicts, per-
+    measurement tag names) — the one-call shape the HTTP handler and
+    the ingest coalescer share."""
+    return lines_to_inserts(parse_lines(body, precision))
+
+
 def lines_to_inserts(parsed) -> Dict[str, Dict[str, list]]:
     """Group parsed points per measurement into column dicts with aligned
     rows (missing tags/fields → None)."""

@@ -451,6 +451,12 @@ class _Connection:
         try:
             outputs = self.server.instance.do_query(sql, self.ctx)
         except GreptimeError as e:
+            from ..errors import OverloadedError
+            if isinstance(e, OverloadedError):
+                # clean server-busy: ER_CON_COUNT_ERROR is the MySQL
+                # error clients already treat as "back off and retry"
+                self.send_err(str(e), errno=1040)
+                return
             self.send_err(str(e))
             return
         except Exception as e:  # noqa: BLE001

@@ -135,6 +135,14 @@ _PG_ROW_RETURNING = {"select", "show", "describe", "desc", "tql", "explain",
                      "with", "values", "table"}
 
 
+def _sqlstate(e: GreptimeError) -> str:
+    """SQLSTATE for a taxonomy error: admission rejections map to
+    53300 (too_many_connections — the class clients retry with
+    backoff); everything else stays the generic internal_error."""
+    from ..errors import OverloadedError
+    return "53300" if isinstance(e, OverloadedError) else "XX000"
+
+
 def _returns_rows(sql: str) -> bool:
     word = sql.lstrip().split(None, 1)
     return bool(word) and word[0].lower() in _PG_ROW_RETURNING
@@ -321,7 +329,7 @@ class _PgConnection:
                     self.io.send(b"T", struct.pack("!H", 0))
             self.send_complete(sql, out)
         except GreptimeError as e:
-            self.send_error(str(e))
+            self.send_error(str(e), _sqlstate(e))
         except Exception as e:  # noqa: BLE001
             logger.exception("postgres query failed: %s", sql)
             self.send_error(str(e))
@@ -444,7 +452,7 @@ class _PgConnection:
             try:
                 portal.result = self._execute_sql(portal.sql)
             except GreptimeError as e:
-                self.ext_error(str(e))
+                self.ext_error(str(e), _sqlstate(e))
                 return
             except Exception as e:  # noqa: BLE001
                 logger.exception("postgres describe failed: %s", portal.sql)
@@ -479,7 +487,7 @@ class _PgConnection:
                     self.io.send(b"T", struct.pack("!H", 0))
             self.send_complete(sql, out)
         except GreptimeError as e:
-            self.ext_error(str(e))
+            self.ext_error(str(e), _sqlstate(e))
         except Exception as e:  # noqa: BLE001
             logger.exception("postgres execute failed: %s", sql)
             self.ext_error(str(e))

@@ -85,6 +85,13 @@ def series_to_inserts(series: List[TimeSeries]):
     return result, tag_cols
 
 
+def write_request_to_inserts(body: bytes):
+    """snappy prompb.WriteRequest body → (per-metric column dicts,
+    per-metric tag names) — the one-call shape the HTTP handler and the
+    ingest coalescer share."""
+    return series_to_inserts(decode_write_request(body))
+
+
 @dataclass
 class Matcher:
     type: int

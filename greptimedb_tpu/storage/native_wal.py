@@ -111,8 +111,10 @@ class NativeWal(Wal):
             raise StorageError(f"wal_open failed for {dir_path}")
 
     # ---- overridden hot path ----
-    def append(self, seq: int, payload: bytes,
-               schema_version: int = 0) -> None:
+    def append_async(self, seq: int, payload: bytes,
+                     schema_version: int = 0) -> int:
+        """Write one record in C++; returns the native group-commit
+        ticket for :meth:`wait_durable` (no durability wait here)."""
         from ..common.failpoint import fail_point
         fail_point("wal_append")
         handle = self._handle
@@ -124,14 +126,32 @@ class NativeWal(Wal):
             raise StorageError(f"wal_append failed: errno {-ticket}")
         from ..common.telemetry import increment_counter
         increment_counter("wal_bytes", len(payload))
+        return ticket
+
+    def append(self, seq: int, payload: bytes,
+               schema_version: int = 0) -> None:
+        ticket = self.append_async(seq, payload, schema_version)
         if self.sync_on_write:
-            from ..common.failpoint import fail_point
-            from ..common.telemetry import timer
-            fail_point("wal_fsync")
-            with timer("wal_fsync"):
-                rc = self._libref.wal_wait(handle, ticket, 30_000)
-            if rc != 0:
-                raise StorageError(f"wal_wait failed: {rc}")
+            self._wait_ticket(ticket)
+
+    def wait_durable(self, ticket: int) -> None:
+        """Wait for the native group-commit epoch covering `ticket` —
+        N concurrent writers share ONE fdatasync in C++."""
+        from ..common.failpoint import fail_point
+        fail_point("wal_group_commit")
+        self._wait_ticket(ticket)
+
+    def _wait_ticket(self, ticket: int) -> None:
+        from ..common.failpoint import fail_point
+        from ..common.telemetry import timer
+        handle = self._handle
+        if handle is None:
+            raise StorageError("wait on closed NativeWal")
+        fail_point("wal_fsync")
+        with timer("wal_fsync"):
+            rc = self._libref.wal_wait(handle, ticket, 30_000)
+        if rc != 0:
+            raise StorageError(f"wal_wait failed: {rc}")
 
     def sync(self) -> None:
         if self._handle is not None:

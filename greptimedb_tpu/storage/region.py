@@ -562,9 +562,24 @@ class Region:
 
     # ---- write path ----
     def write(self, batch: WriteBatch) -> int:
-        """WAL append → memtable insert → sequence bump. Returns rows written."""
+        """WAL append → memtable insert → sequence bump. Returns rows written.
+
+        With WAL group commit active (sync_on_write + `SET
+        wal_group_commit`), the record is appended under the writer lock
+        but the fsync wait happens OUTSIDE it: N concurrent writers
+        overlap their appends and share ONE fsync. The ack-side contract
+        is unchanged — success returns only after the shared fsync
+        covers this write's record. The FAILURE path differs from
+        per-append mode: the memtable insert precedes the durability
+        wait (visibility must precede the committed-sequence bump the
+        incremental scan cache watermarks on), so a write whose shared
+        fsync FAILS surfaces its error un-acked but leaves its rows
+        visible until restart — the same may-be-durable, never-acked
+        class recovery already legally resurfaces (torture invariant:
+        "unacked rows appear at most once, or not at all")."""
         from ..common.telemetry import increment_counter, timer
         stall = False
+        wal_ticket = None
         with timer("region_write"), self._writer_lock:
             if self.closed:
                 raise StorageError(f"region {self.name} closed")
@@ -576,8 +591,14 @@ class Region:
             seq = vc.next_sequence()
             with timer("wal_append"):
                 try:
-                    self.wal.append(seq, batch.encode(),
-                                    schema_version=vc.current.schema.version)
+                    if self.wal.group_commit_active():
+                        wal_ticket = self.wal.append_async(
+                            seq, batch.encode(),
+                            schema_version=vc.current.schema.version)
+                    else:
+                        self.wal.append(
+                            seq, batch.encode(),
+                            schema_version=vc.current.schema.version)
                 except BaseException:
                     # the record may already be durable (fsync failed AFTER
                     # the write, an injected wal_fsync fault, a torn tail):
@@ -611,6 +632,14 @@ class Region:
             stall = (self.version_control.current.memtables.total_bytes -
                      self.version_control.current.memtables.mutable_bytes
                      ) >= self.stall_bytes
+        if wal_ticket is not None:
+            # group commit: park for the shared fsync OUTSIDE the writer
+            # lock so concurrent writers can append meanwhile. A failure
+            # here reaches the caller un-acked; the sequence is already
+            # consumed and the record replays (at most once) like any
+            # other durable-but-unacked write.
+            with timer("wal_group_wait"):
+                self.wal.wait_durable(wal_ticket)
         if stall and self.scheduler is not None:
             # write stall: block (outside the writer lock so the flush
             # worker can commit) until the backlog drains

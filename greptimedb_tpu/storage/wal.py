@@ -17,11 +17,16 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from ..common import failpoint as _fp
 from ..common.locks import TrackedLock
+# hoisted to module scope: `append` runs per region write — a function-
+# local import on the hot loop re-resolves sys.modules every call
+# (matching every other storage module)
+from ..common.telemetry import increment_counter, timer
 from ..errors import StorageError
 
 logger = logging.getLogger(__name__)
@@ -31,6 +36,61 @@ _REC_HDR = struct.Struct("<IIQI")  # len, crc, seq, schema_version
 _fp.register("wal_append")
 _fp.register("wal_append_torn")
 _fp.register("wal_fsync")
+#: crash window between a cohort member's record write and the shared
+#: group-commit fsync: at most the (unacked) cohort may be lost, never
+#: an acked row (tests/torture.py drives it)
+_fp.register("wal_group_commit")
+
+
+# ---------------------------------------------------------------------------
+# group commit configuration (process-wide; SET wal_group_commit /
+# wal_group_max_wait_us / wal_group_max_batch and the matching
+# GREPTIME_WAL_GROUP_* env knobs route here)
+# ---------------------------------------------------------------------------
+
+from ..utils import env_flag as _env_flag, env_int as _env_int
+
+#: one-element lists so SET mutates in place without rebinding (the
+#: pattern telemetry/runtime knobs use; greptlint GL08 wants the
+#: mutation behind a lock — these are single-slot swaps guarded below)
+_GC_LOCK = TrackedLock("storage.wal_group_config")
+#: max_wait_us defaults to 0 — pure fsync chaining: the cohort is
+#: whatever piled up while the previous fsync was in flight, so group
+#: commit never ADDS latency on a fast device; a positive window only
+#: pays off when fsync is expensive relative to the OS sleep quantum
+_GC_ENABLED = [_env_flag("GREPTIME_WAL_GROUP_COMMIT", True)]
+_GC_MAX_WAIT_US = [_env_int("GREPTIME_WAL_GROUP_MAX_WAIT_US", 0)]
+_GC_MAX_BATCH = [_env_int("GREPTIME_WAL_GROUP_MAX_BATCH", 128)]
+#: hard bound on how long a cohort member parks for the shared fsync
+#: before surfacing a storage error (never deadlock on a dead leader)
+_GC_WAIT_TIMEOUT_S = 30.0
+
+
+def configure_group_commit(*, enabled: Optional[bool] = None,
+                           max_wait_us: Optional[int] = None,
+                           max_batch: Optional[int] = None) -> None:
+    """Process-wide group-commit knobs (SET wal_group_commit & co)."""
+    with _GC_LOCK:
+        if enabled is not None:
+            _GC_ENABLED[0] = bool(enabled)
+        if max_wait_us is not None:
+            if max_wait_us < 0:
+                raise ValueError("wal_group_max_wait_us must be >= 0")
+            _GC_MAX_WAIT_US[0] = int(max_wait_us)
+        if max_batch is not None:
+            if max_batch < 1:
+                raise ValueError("wal_group_max_batch must be >= 1")
+            _GC_MAX_BATCH[0] = int(max_batch)
+
+
+def group_commit_enabled() -> bool:
+    return _GC_ENABLED[0]
+
+
+def group_commit_settings() -> Tuple[bool, int, int]:
+    """(enabled, max_wait_us, max_batch) — one consistent read."""
+    with _GC_LOCK:
+        return _GC_ENABLED[0], _GC_MAX_WAIT_US[0], _GC_MAX_BATCH[0]
 
 
 class Wal:
@@ -48,6 +108,17 @@ class Wal:
         self._fh = None
         self._fh_path: Optional[str] = None
         self._fh_size = 0
+        # ---- group-commit cohort state (all under _gc_cond's lock) ----
+        # tickets count records written to the OS; the leader's fsync
+        # covers every ticket <= the value it sampled under _lock, so a
+        # waiter is durable once _synced_ticket reaches its own ticket.
+        self._gc_cond = threading.Condition(
+            TrackedLock("storage.wal_group"))
+        self._written_ticket = 0      # bumped under _lock per record
+        self._synced_ticket = 0       # highest ticket a good fsync covers
+        self._failed_ticket = 0       # highest ticket a failed fsync hit
+        self._sync_exc: Optional[BaseException] = None
+        self._leader_active = False
         # set when an injected torn write left garbage at the tail of the
         # OPEN segment and the process survived (the torture rig abandons
         # the object; a live server does not) — the next append must cut
@@ -69,6 +140,14 @@ class Wal:
 
     def _open_segment(self, first_seq: int) -> None:
         if self._fh is not None:
+            if self.sync_on_write:
+                # group commit fsyncs OUTSIDE the WAL lock against the
+                # current fd only: a rotation must not close a segment
+                # carrying cohort records that never saw an fsync (in
+                # per-append mode this re-syncs already-durable bytes
+                # once per 64 MiB — noise)
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
             self._fh.close()
         path = os.path.join(self.dir, f"{first_seq:020d}.wal")
         self._fh = open(path, "ab")
@@ -76,7 +155,34 @@ class Wal:
         self._fh_size = self._fh.tell()
 
     # ---- api ----
+    def group_commit_active(self) -> bool:
+        """True when this WAL's durability waits should ride the shared
+        group-commit fsync (the region writer then appends under its
+        lock and parks OUTSIDE it, so concurrent writers overlap)."""
+        return self.sync_on_write and group_commit_enabled()
+
     def append(self, seq: int, payload: bytes, schema_version: int = 0) -> None:
+        """Write one record; when `sync_on_write`, return only after an
+        fsync covers it — per-append (group commit off) or shared
+        (group commit on)."""
+        group = self.group_commit_active()
+        ticket = self._append_locked(
+            seq, payload, schema_version,
+            inline_sync=self.sync_on_write and not group)
+        if group:
+            self.wait_durable(ticket)
+
+    def append_async(self, seq: int, payload: bytes,
+                     schema_version: int = 0) -> int:
+        """Write one record WITHOUT waiting for durability; returns the
+        commit ticket to pass to :meth:`wait_durable`. The region writer
+        uses this under its writer lock so the (slow) fsync wait happens
+        after the lock is released."""
+        return self._append_locked(seq, payload, schema_version,
+                                   inline_sync=False)
+
+    def _append_locked(self, seq: int, payload: bytes, schema_version: int,
+                       *, inline_sync: bool) -> int:
         with self._lock:
             _fp.fail_point("wal_append")
             if self._fh is not None and self._fh_dirty_tail:
@@ -104,21 +210,114 @@ class Wal:
             # account the record before the fsync: it is in the file now,
             # so a failed fsync must not leave segment rotation blind to it
             self._fh_size += len(rec)
-            if self.sync_on_write:
-                from ..common.telemetry import timer
+            self._written_ticket += 1
+            ticket = self._written_ticket
+            if inline_sync:
                 _fp.fail_point("wal_fsync")
                 with timer("wal_fsync"):
                     os.fsync(self._fh.fileno())
-            from ..common.telemetry import increment_counter
             increment_counter("wal_bytes", len(rec))
+        return ticket
+
+    # ---- group commit ----
+    def wait_durable(self, ticket: int) -> None:
+        """Park until a shared fsync covers `ticket`. The first waiter of
+        a cohort elects itself leader, batches the flush+fsync, and wakes
+        everyone; followers re-check on a bounded wait so a dead leader
+        (or a KILL on the waiting statement) can never wedge the cohort."""
+        from ..common.process_list import check_cancelled
+        _fp.fail_point("wal_group_commit")
+        deadline = time.monotonic() + _GC_WAIT_TIMEOUT_S
+        while True:
+            lead = False
+            with self._gc_cond:
+                if self._synced_ticket >= ticket:
+                    return                     # a shared fsync covered us
+                if self._failed_ticket >= ticket:
+                    raise StorageError(
+                        f"wal group fsync failed for ticket {ticket}: "
+                        f"{self._sync_exc}", cause=self._sync_exc
+                        if isinstance(self._sync_exc, Exception) else None)
+                if not self._leader_active:
+                    self._leader_active = True
+                    lead = True
+                else:
+                    self._gc_cond.wait(timeout=0.05)
+            if lead:
+                self._lead_sync()              # re-loop to check coverage
+                continue
+            check_cancelled()                  # killed mid-wait: bail out
+            if time.monotonic() > deadline:
+                raise StorageError(
+                    f"wal group commit wait timed out after "
+                    f"{_GC_WAIT_TIMEOUT_S:.0f}s (ticket {ticket})")
+
+    def _lead_sync(self) -> None:
+        """Leader duties: give the cohort a short window to pile on, then
+        pay ONE fsync for every record written so far and publish the
+        covered ticket. Any fsync failure (or injected crash) is recorded
+        for the cohort and re-raised in the leader's own thread."""
+        _enabled, max_wait_us, max_batch = group_commit_settings()
+        if max_wait_us > 0:
+            with self._gc_cond:
+                backlog = self._written_ticket - self._synced_ticket
+            if backlog < max_batch:
+                # the accumulation window — bounded, microseconds-scale
+                time.sleep(max_wait_us / 1e6)
+        target = 0
+        try:
+            dup_fd = -1
+            with self._lock:
+                target = self._written_ticket
+                if self._fh is not None and target > self._synced_ticket:
+                    # flush userspace buffers under the lock, then fsync
+                    # a dup'd fd OUTSIDE it: the whole point of group
+                    # commit is that appends keep landing while the
+                    # device syncs (the dup survives a concurrent
+                    # rotation, and rotation itself fsyncs the old
+                    # segment before closing it — see _open_segment)
+                    self._fh.flush()
+                    dup_fd = os.dup(self._fh.fileno())
+            if dup_fd >= 0:
+                try:
+                    _fp.fail_point("wal_fsync")
+                    with timer("wal_fsync"):
+                        os.fsync(dup_fd)
+                finally:
+                    os.close(dup_fd)
+        except BaseException as e:
+            # the cohort (including this thread's own caller) must see
+            # the failure; the ORIGINAL exception propagates here so an
+            # injected SimulatedCrash stays a crash in the leader
+            with self._gc_cond:
+                self._failed_ticket = max(self._failed_ticket,
+                                          target or self._written_ticket)
+                self._sync_exc = e
+                self._leader_active = False
+                self._gc_cond.notify_all()
+            raise
+        with self._gc_cond:
+            cohort = target - self._synced_ticket
+            self._synced_ticket = max(self._synced_ticket, target)
+            self._leader_active = False
+            self._gc_cond.notify_all()
+        if cohort > 0:
+            increment_counter("wal_group_commit_fsyncs")
+            increment_counter("wal_group_commit_records", cohort)
 
     def sync(self) -> None:
         with self._lock:
+            target = self._written_ticket
             if self._fh is not None:
-                from ..common.telemetry import timer
                 self._fh.flush()
                 with timer("wal_fsync"):
                     os.fsync(self._fh.fileno())
+        # an explicit full sync covers every written record: release any
+        # parked cohort members up to the sampled ticket
+        with self._gc_cond:
+            if target > self._synced_ticket:
+                self._synced_ticket = target
+                self._gc_cond.notify_all()
 
     def read_from(self, start_seq: int) -> Iterator[Tuple[int, int, bytes]]:
         """Yield (seq, schema_version, payload) for all records with
@@ -221,10 +420,21 @@ class Wal:
 class NoopWal(Wal):
     """WAL-less mode for tests/benchmarks (reference: src/log-store/src/noop.rs)."""
 
+    sync_on_write = False
+
     def __init__(self):  # noqa: super-init-not-called
         self._lock = TrackedLock("storage.wal")
 
+    def group_commit_active(self):
+        return False
+
     def append(self, seq, payload, schema_version=0):
+        pass
+
+    def append_async(self, seq, payload, schema_version=0):
+        return 0
+
+    def wait_durable(self, ticket):
         pass
 
     def sync(self):

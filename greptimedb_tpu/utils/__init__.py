@@ -6,6 +6,34 @@ import os
 import tempfile
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer env knob; malformed values fall back to the default."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob; malformed values fall back to the default."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean env knob: unset → default; '0'/'false'/'off'/'no'/''
+    (any case) → False; anything else → True. THE parser for on/off
+    env twins — per-module copies drift on the accepted false-strings.
+    Lives in this leaf module so storage/ can import it without pulling
+    the runtime→scheduler→storage import cycle."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no", "")
+
+
 def atomic_write(path: str, data: "bytes | str", *, fsync: bool = True,
                  tmp_prefix: str = ".tmp-") -> None:
     """Write `data` (bytes or str) to `path` atomically: temp file in the

@@ -586,7 +586,12 @@ class TestMigrationTorture:
                     # a write failing INSIDE the crash window is legal
                     # (it was never acked); anything else is recorded
                     errors.append(e)
-                time.sleep(0.01)
+                # cadence must beat the snapshot→fence window (~10ms on
+                # a slow box): the wal_tail_replay point only fires if
+                # at least one write lands between the snapshot flush
+                # and the fence, so a 10ms sleep made capture a coin
+                # flip that depended on how warmed-up the process was
+                time.sleep(0.002)
 
         t = threading.Thread(target=ingest, daemon=True)
         t.start()

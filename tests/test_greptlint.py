@@ -275,6 +275,35 @@ def test_gl11_fires_without_check_and_clears_with_it(tmp_path):
     assert [f for f in fresh if f.rule == "GL11"] == []
 
 
+def test_gl11_wait_loops_must_bound_or_cancel(tmp_path):
+    """ISSUE 12 scope extension: a cohort-wait loop (group commit /
+    ingest coalescer) parking on an un-bounded Event/Condition wait is
+    flagged even when do_query cannot reach it; a timeout= bound OR a
+    check_cancelled() in the loop clears it."""
+    q = tmp_path / "query"
+    q.mkdir()
+    (q / "__init__.py").write_text("")
+    bad = (
+        "def follow(batch):\n"
+        "    while not batch.done.is_set():\n"
+        "        batch.done.wait()\n"
+        "    return batch.result\n")
+    (q / "cohort.py").write_text(bad)
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    assert [f.rule for f in fresh if f.rule == "GL11"] == ["GL11"]
+    # fix 1: a bounded wait
+    (q / "cohort.py").write_text(bad.replace(
+        "batch.done.wait()", "batch.done.wait(timeout=0.05)"))
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    assert [f for f in fresh if f.rule == "GL11"] == []
+    # fix 2: a cancellation point in the loop
+    (q / "cohort.py").write_text(bad.replace(
+        "batch.done.wait()",
+        "check_cancelled()\n        batch.done.wait()"))
+    fresh, _a, _e = lint_paths([str(tmp_path)])
+    assert [f for f in fresh if f.rule == "GL11"] == []
+
+
 def test_gl12_flags_never_evaluated_and_unreachable_sites(tmp_path):
     """Both death modes: a registered name with no fail_point site at
     all, and one whose only site sits in an uncalled function; a site

@@ -243,6 +243,10 @@ CRASH_POINTS: Dict[str, Tuple[str, bool]] = {
     "wal_append":           ("write", False),
     "wal_append_torn":      ("write", False),
     "wal_fsync":            ("write", True),   # record written pre-fsync
+    # crash between a cohort's record write and the SHARED group-commit
+    # fsync: the cohort (unacked) may be lost or may surface once —
+    # never an acked row (the group-commit durability contract)
+    "wal_group_commit":     ("write", True),
     "region_write_memtable": ("write", True),  # WAL holds it already
     "sst_write":            ("flush", False),
     "sst_write_after":      ("flush", False),
@@ -263,8 +267,13 @@ def run_crash_case(home: str, point: str, *,
     """One cell of the crash matrix; raises AssertionError on any
     invariant violation. Returns a small result dict for reporting."""
     kind, durable_ok = CRASH_POINTS[point]
-    if point == "wal_fsync":
-        sync_wal = True                   # the point only exists then
+    if point in ("wal_fsync", "wal_group_commit"):
+        sync_wal = True                   # the points only exist then
+    if point == "wal_group_commit":
+        # the cohort wait only runs with group commit on (the default;
+        # pinned here so the case survives knob-twiddling tests)
+        from greptimedb_tpu.storage.wal import configure_group_commit
+        configure_group_commit(enabled=True)
     checkpoint_margin = 1 if point == "manifest_checkpoint" else 10
     fp.clear_all()
     rig = TortureRig(home, sync_wal=sync_wal,
