@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: check lint typecheck test test-slow race baseline bench bench-qps
+.PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
+	bench-index
 
 check: lint typecheck test
 
@@ -55,3 +56,9 @@ bench:
 # workload QPS × p99 + the WAL group-commit on/off differential
 bench-qps:
 	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=concurrent_qps $(PY) bench.py
+
+# only the ISSUE 13 metric: high-cardinality point/IN query throughput
+# on a ~100k-series, >=16-SST region with the per-SST secondary index
+# on vs `SET sst_index = 0` (asserts the >=3x differential)
+bench-index:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=index $(PY) bench.py

@@ -964,6 +964,163 @@ def bench_region_migration_availability(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_index_point_query(n_series: int = 100_000, files: int = 16):
+    """Seventh driver metric (ISSUE 13): high-cardinality point-query
+    throughput against a persisted many-SST region, with the per-SST
+    secondary index on vs off (`SET sst_index = 0`).
+
+    Layout is the shape the index exists for: the series dictionary is
+    primed once (so sids are host-ordered), then each of `files` bulk
+    batches carries a SCATTERED 1/files-th of the series — every SST's
+    coarse sid_range spans nearly the whole keyspace (stats-only file
+    pruning keeps everything) while its bloom holds only its own sids
+    (index pruning drops ~(files-1)/files of the files). Point + IN(8)
+    queries alternate; the scan cache is cleared per query on both sides
+    so the differential measures the cold read path, not cache warmth.
+
+    Asserts: answers identical on/off (zero drift), differential >= 3x,
+    and `files pruned by index` visible in the EXPLAIN ANALYZE profile
+    (index_files_pruned / index_files_checked on the prune stage)."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.instance import FrontendInstance
+    from greptimedb_tpu.query import tpu_exec
+    from greptimedb_tpu.session import QueryContext
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-index-")
+    fe = None
+    rows_per = 16
+    try:
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=tmpdir, register_numbers_table=False))
+        dn.start()
+        fe = FrontendInstance(dn)
+        fe.start()
+        ctx = QueryContext()
+        fe.do_query("CREATE TABLE idx (host STRING, ts TIMESTAMP "
+                    "TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+        table = fe.catalog.table("greptime", "public", "idx")
+        for region in table.regions.values():
+            # keep the scattered L0 layout: auto-compaction would merge
+            # the batches into per-window files that genuinely contain
+            # every series (nothing left for any index to prune)
+            region.max_l0_files = 1 << 30
+        rng = np.random.default_rng(17)
+        hosts_all = np.array([f"h{i:06d}" for i in range(n_series)],
+                             dtype=object)
+        # values are dyadic rationals (multiples of 1/8, < 512): exactly
+        # representable in BOTH float64 and the index-off resident
+        # path's f32 device mirrors, so the zero-drift assertion below
+        # compares semantics, not float rounding regimes
+        def vals(n: int) -> np.ndarray:
+            return rng.integers(0, 4096, n).astype(np.float64) / 8.0
+
+        # prime the dictionary in host order: one row per series
+        table.bulk_load({"host": hosts_all,
+                         "ts": np.zeros(n_series, dtype=np.int64),
+                         "v": vals(n_series)})
+        total = n_series
+        for k in range(files):
+            sel = hosts_all[k::files]
+            host_col = np.repeat(sel, rows_per)
+            ts_col = np.tile(
+                (np.arange(rows_per, dtype=np.int64) + 1) * 1000 + k,
+                len(sel))
+            table.bulk_load({"host": host_col, "ts": ts_col,
+                             "v": vals(len(host_col))})
+            total += len(host_col)
+        n_ssts = sum(len(r.version_control.current.ssts.all_files())
+                     for r in table.regions.values())
+        assert n_ssts >= files, f"expected >= {files} SSTs, got {n_ssts}"
+        fe.do_query("SET tpu_dispatch_min_rows = 131072", ctx)
+
+        def point_sql(i: int) -> str:
+            return (f"SELECT host, max(v), count(v) FROM idx WHERE "
+                    f"host = '{hosts_all[i % n_series]}' GROUP BY host")
+
+        def in8_sql(i: int) -> str:
+            picks = ", ".join(
+                f"'{hosts_all[(i * 131 + j * 977) % n_series]}'"
+                for j in range(8))
+            return (f"SELECT host, avg(v) FROM idx WHERE host IN "
+                    f"({picks}) GROUP BY host ORDER BY host")
+
+        def run(sql: str):
+            out = fe.do_query(sql, ctx)[-1]
+            return sorted(tuple(r) for b in out.batches
+                          for r in b.rows())
+
+        def timed(iters: int) -> float:
+            t0 = time.perf_counter()
+            for i in range(iters):
+                tpu_exec.SCAN_CACHE._entries.clear()
+                run(point_sql(i * 7919))
+                tpu_exec.SCAN_CACHE._entries.clear()
+                run(in8_sql(i))
+            return (time.perf_counter() - t0) / (2 * iters)
+
+        # zero answer drift on vs off, for both shapes
+        for sql in (point_sql(42), in8_sql(3)):
+            tpu_exec.SCAN_CACHE._entries.clear()
+            on_rows = run(sql)
+            fe.do_query("SET sst_index = 0", ctx)
+            tpu_exec.SCAN_CACHE._entries.clear()
+            off_rows = run(sql)
+            fe.do_query("SET sst_index = 1", ctx)
+            assert on_rows == off_rows, sql
+
+        timed(1)                               # absorb one-time costs
+        dt_on = timed(6)
+        fe.do_query("SET sst_index = 0", ctx)
+        dt_off = timed(2)
+        fe.do_query("SET sst_index = 1", ctx)
+
+        # EXPLAIN ANALYZE profile: files pruned by index must be visible
+        tpu_exec.SCAN_CACHE._entries.clear()
+        run(point_sql(123))
+        st = fe.query_engine.last_exec_stats
+        prune = st.stages["prune"].detail
+        pruned = int(prune.get("index_files_pruned", 0))
+        checked = int(prune.get("index_files_checked", 0))
+        assert pruned >= files - 2, (pruned, checked)
+        speedup = dt_off / dt_on
+        assert speedup >= 3.0, (
+            f"index differential only {speedup:.2f}x on the many-SST "
+            f"region (on={dt_on * 1e3:.1f}ms off={dt_off * 1e3:.1f}ms)")
+        return (1.0 / dt_on, speedup, total, n_ssts,
+                {"dispatch": st.dispatch,
+                 "files_pruned_by_index": f"{pruned}/{checked}",
+                 "query_ms_index_on": round(dt_on * 1e3, 2),
+                 "query_ms_index_off": round(dt_off * 1e3, 2)})
+    finally:
+        if fe is not None:
+            fe.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def emit_index_point_query():
+    """The ISSUE 13 metric, runnable alone via `make bench-index`
+    (GREPTIME_BENCH_ONLY=index)."""
+    n_series = int(os.environ.get("GREPTIME_BENCH_INDEX_SERIES",
+                                  100_000))
+    n_files = int(os.environ.get("GREPTIME_BENCH_INDEX_FILES", 16))
+    qps, speedup, rows, n_ssts, profile = \
+        bench_index_point_query(n_series, n_files)
+    print(json.dumps({
+        "metric": "high_cardinality_point_query_throughput",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "series": n_series,
+        "rows": rows,
+        "sst_files": n_ssts,
+        "vs_index_off": round(speedup, 2),
+        "profile": profile,
+    }))
+
+
 def emit_concurrent_qps():
     """The ISSUE 12 metric, runnable alone via `make bench-qps`
     (GREPTIME_BENCH_ONLY=concurrent_qps)."""
@@ -987,6 +1144,9 @@ def emit_concurrent_qps():
 def main():
     if os.environ.get("GREPTIME_BENCH_ONLY") == "concurrent_qps":
         emit_concurrent_qps()
+        return
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "index":
+        emit_index_point_query()
         return
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
@@ -1074,6 +1234,8 @@ def main():
         "failpoint_inactive_ratio": round(fp_ratio, 3),
         "failpoint_inactive_ns_per_call": round(fp_ns, 1),
     }))
+
+    emit_index_point_query()
 
     mon_rows = int(os.environ.get("GREPTIME_BENCH_MONITOR_ROWS",
                                   2_000_000))

@@ -1104,6 +1104,9 @@ class DistInstance:
         design (the reference's migrate_region returns a procedure id):
         the returned op id tracks progress in region_peers."""
         from .statement import admin_ops_output
+        if stmt.kind in ("flush_table", "compact_table"):
+            from .statement import apply_admin_maintenance
+            return apply_admin_maintenance(self.catalog, stmt, ctx)
         if stmt.kind == "rebalance":
             full = None
             if stmt.table is not None:

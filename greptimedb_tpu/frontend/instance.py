@@ -180,6 +180,9 @@ class FrontendInstance:
             from .statement import apply_kill
             return apply_kill(stmt)
         if isinstance(stmt, ast.Admin):
+            if stmt.kind in ("flush_table", "compact_table"):
+                from .statement import apply_admin_maintenance
+                return apply_admin_maintenance(self.catalog, stmt, ctx)
             # region placement is a cluster concept: standalone's single
             # implicit node has nothing to migrate/split between
             from ..errors import UnsupportedError

@@ -224,6 +224,32 @@ def apply_kill(stmt: ast.Kill) -> Output:
     return Output.rows(1)
 
 
+def apply_admin_maintenance(catalog: CatalogManager, stmt: ast.Admin,
+                            ctx: QueryContext) -> Output:
+    """Shared ADMIN FLUSH/COMPACT TABLE handler: force the table's
+    regions through a flush (memtables → indexed L0 SSTs) or a manual
+    compaction. One function for both frontends; the sqlness goldens
+    and the index bench use it to pin the on-disk SST layout."""
+    catalog_name, schema_name, name = ctx.resolve(stmt.table)
+    table = catalog.table(catalog_name, schema_name, name)
+    if table is None:
+        raise TableNotFoundError(f"table {name!r} not found")
+    if stmt.kind == "flush_table":
+        table.flush()
+        return Output.rows(0)
+    regions = getattr(table, "regions", None)
+    if not regions:
+        # a DistTable over remote datanodes reports an EMPTY region
+        # dict, not a missing attribute — silently compacting nothing
+        # must not read as success
+        raise UnsupportedError(
+            "ADMIN COMPACT TABLE needs locally-hosted regions (on a "
+            "cluster, run it against the datanodes)")
+    for region in regions.values():
+        region.compact()
+    return Output.rows(0)
+
+
 #: session variables wire clients set as connection boilerplate (mysql
 #: connectors, psql, JDBC). Accepted as no-ops — erroring would break
 #: every driver handshake — but ONLY these: any other unknown name is a
@@ -344,6 +370,13 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
         # one region (query/tpu_exec.py); 0 = every scan solo
         from ..query import tpu_exec
         tpu_exec.configure_scan_fusion(enabled=bool(_int_setting(stmt)))
+    elif name == "sst_index":
+        # per-SST secondary indexes (storage/index.py): 0 disables both
+        # sidecar writes and every index consult — point/IN queries then
+        # take the pre-index stats-only read path (the bench
+        # differential's kill switch; env twin GREPTIME_SST_INDEX)
+        from ..storage.index import configure_sst_index
+        configure_sst_index(enabled=bool(_int_setting(stmt)))
     elif name in ("admission_max_inflight", "admission_max_queued_bytes",
                   "admission_retry_after_s"):
         # admission gate (common/admission.py): 0 disables a dimension

@@ -195,6 +195,48 @@ def pushable_tag_filter(e, tag_names) -> bool:
     return False
 
 
+def sid_candidates_for_filters(series_dict, tag_names,
+                               filters) -> Optional[np.ndarray]:
+    """Sorted candidate series-id set from the point (`tag = literal`)
+    and non-negated `tag IN (...)` conjuncts of `filters`, resolved
+    through the series dictionary — the sid sets the per-SST secondary
+    index (storage/index.py) prunes files and row groups with.
+
+    Returns None when no such conjunct exists (nothing selective to
+    prune on: `!=`, ranges and regex-shaped predicates are deliberately
+    EXCLUDED — their sid sets are near-total, so consulting blooms would
+    cost without shedding). The result is a SUPERSET guarantee, not a
+    filter: every row matching ALL conjuncts has a sid in the set, so
+    callers still apply the full predicate downstream and answers cannot
+    drift. An equality on a never-seen value resolves to the empty set —
+    exact, and it prunes every file."""
+    from ..sql.ast import BinaryOp, Column, InList, Literal
+    tags = set(tag_names)
+    cand: Optional[np.ndarray] = None
+    for e in filters:
+        col = None
+        vals = None
+        if isinstance(e, BinaryOp) and e.op == "=":
+            for c, lit in ((e.left, e.right), (e.right, e.left)):
+                if isinstance(c, Column) and c.name in tags and \
+                        isinstance(lit, Literal) and lit.value is not None:
+                    col, vals = c.name, [lit.value]
+                    break
+        elif isinstance(e, InList) and not e.negated and \
+                isinstance(e.expr, Column) and e.expr.name in tags and \
+                e.items and all(isinstance(i, Literal) and
+                                i.value is not None for i in e.items):
+            col, vals = e.expr.name, [i.value for i in e.items]
+        if col is None:
+            continue
+        sids = series_dict.sids_for_tag_values(tag_names.index(col), vals)
+        cand = sids if cand is None else \
+            np.intersect1d(cand, sids, assume_unique=True)
+        if cand is not None and len(cand) == 0:
+            break                       # provably empty: nothing matches
+    return cand
+
+
 def _tag_series_keep(series_dict, tag_names, filters) -> np.ndarray:
     """Per-series keep mask for pushable tag filters: predicates evaluate
     once per SERIES (via the dictionary), not once per row, then broadcast
@@ -403,9 +445,19 @@ class MitoTable(Table):
                     f"{self.info.name} are not hosted here")
         hosted = self.regions if regions is None else \
             {rn: r for rn, r in self.regions.items() if rn in set(regions)}
+        from ..storage.index import sst_index_enabled
         for region in hosted.values():
+            # point/IN conjuncts resolve to sid sets per REGION (series
+            # dictionaries are region-local) so the scan prunes whole
+            # SSTs through their index sidecars — this is the datanode
+            # side of the wire-pushed tag filters too
+            sid_set = None
+            if usable and sst_index_enabled():
+                sid_set = sid_candidates_for_filters(
+                    region.series_dict, tag_names, usable)
             data = region.snapshot().read_merged(
-                projection=projection, time_range=time_range)
+                projection=projection, time_range=time_range,
+                sid_set=sid_set)
             if usable and data.num_rows:
                 keep = _tag_series_keep(data.series_dict, tag_names,
                                         usable)

@@ -326,8 +326,14 @@ class PromqlEngine:
         glabels: List[Dict[str, str]] = []
         parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
+        eq_matchers = [m for m in sel.matchers
+                       if m.op == "=" and m.name in tagset and m.value]
         for region in table.regions.values():
-            scan = self._region_scan(region, fields, lo_ms, hi_ms)
+            sid_set = self._matcher_sids(region, tag_names, eq_matchers)
+            if sid_set is not None and len(sid_set) == 0:
+                continue                 # no series of this region match
+            scan = self._region_scan(region, fields, lo_ms, hi_ms,
+                                     sid_set=sid_set)
             if scan is None or scan.num_rows == 0:
                 continue
             sd = scan.series_dict
@@ -395,8 +401,43 @@ class PromqlEngine:
         sm = SeriesMatrix.build(gids, ts, vals, len(glabels))
         return _Selection(glabels, sm, int(ts.min()), int(ts.max()))
 
+    @staticmethod
+    def _matcher_sids(region, tag_names, eq_matchers):
+        """Sorted candidate sid superset for the selector's equality
+        matchers in one region, or None when there is nothing selective
+        to resolve — what lets the cold selector path prune whole SSTs
+        through their index sidecars. Label values are matched on the
+        same string rendering the keep-mask uses, so numeric tags
+        resolve identically on both paths."""
+        from ..storage.index import sst_index_enabled
+        if not eq_matchers or not sst_index_enabled():
+            return None
+        sd = getattr(region, "series_dict", None)
+        if sd is None or not sd.tag_names:
+            return None
+        cand = None
+        for m in eq_matchers:
+            ti = tag_names.index(m.name)
+            # O(1) dictionary hit for string tags (the common case);
+            # the O(values) rendered-label scan only runs for tags whose
+            # stored values are not strings
+            vid = sd.tag_dicts[ti].get(m.value)
+            if vid is not None:
+                ids = [vid]
+            else:
+                ids = [i for i, v in
+                       enumerate(sd.tag_dicts[ti].values())
+                       if v is not None and not isinstance(v, str) and
+                       _label_str(v) == m.value]
+            sids = sd.sids_for_value_ids(ti, ids)
+            cand = sids if cand is None else \
+                np.intersect1d(cand, sids, assume_unique=True)
+            if len(cand) == 0:
+                break
+        return cand
+
     def _region_scan(self, region, fields: List[str], lo_ms: int,
-                     hi_ms: int):
+                     hi_ms: int, sid_set=None):
         """Rows for one region: the device-resident scan cache for warm
         regions; a window-bounded streamed cold read for regions past the
         streaming threshold (VERDICT gap: the PromQL path was hard-wired
@@ -416,9 +457,12 @@ class PromqlEngine:
         increment_counter("promql_select_streamed")
         from ..common import exec_stats
         with exec_stats.stage("promql_cold_scan", region=region.name):
+            # equality matchers ride the SST index: whole files whose
+            # blooms exclude every candidate series never decode
             data = region.snapshot().read_merged(
                 projection=list(fields),
-                time_range=TimestampRange(lo_ms, hi_ms + 1))
+                time_range=TimestampRange(lo_ms, hi_ms + 1),
+                sid_set=sid_set)
         exec_stats.record("promql_cold_scan", rows=data.num_rows)
         return data
 

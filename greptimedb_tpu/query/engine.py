@@ -143,7 +143,8 @@ class QueryEngine:
                     # rows OR decoded-bytes vs the scan-cache budget)
                     lines.append("TpuAggregateExec: " + plan.describe())
                     lines.append("  Dispatch: " +
-                                 tpu_exec.local_dispatch_decision(table))
+                                 tpu_exec.local_dispatch_decision(
+                                     table, plan=plan))
             elif a.is_aggregate:
                 lines.append("CpuAggregateExec: groups=" + ", ".join(
                     expr_name(g) for g in a.group_exprs))

@@ -403,7 +403,8 @@ class _LeanChunk:
 
 def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
                        needed_fields, plan, sd, need_ts: bool,
-                       sid_keys: bool = False):
+                       sid_keys: bool = False,
+                       sid_set: Optional[np.ndarray] = None):
     """Decode→reduce fast path for a fully-covered, dedup-free slice:
     stream each SST's row groups as arrow record batches and reduce each
     batch straight into a partial moment frame over zero-copy column
@@ -438,6 +439,18 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
         if any(f.sid_range is None or f.sid_range[0] < lo or
                f.sid_range[1] >= hi for f in files):
             return None
+    sid_idxes = {}
+    if sid_set is not None:
+        # drop whole certified files (and then row groups) through the
+        # index tier: a pruned file's rows would all be masked out by
+        # the tag predicates anyway, so the lean proof still holds on
+        # the subset
+        from ..storage.index import prune_files
+        files = prune_files(access.load_index, files, sid_set)[0]
+        for meta in files:
+            idx = access.load_index(meta)
+            if idx is not None:
+                sid_idxes[meta.file_name] = idx
     cols = list(needed_fields) + ["__series_id"]
     if need_ts:
         cols.append(ts_name)
@@ -457,7 +470,16 @@ def _lean_chunk_frames(snap, access, files, dim: str, lo: int, hi: int,
         present = set(pf.schema_arrow.names)
         if any(c not in present for c in cols):
             return None                      # pre-ALTER file: general path
+        sidx = sid_idxes.get(meta.file_name)
+        # same alignment guard as read_sst: a sidecar whose group count
+        # disagrees with the parquet layout (version skew) must degrade
+        # to reading every group, never skip the wrong ones
+        gk = sidx.row_groups_for(sid_set) \
+            if sidx is not None and \
+            len(sidx.rg_lo) == pf.metadata.num_row_groups else None
         for g in range(pf.metadata.num_row_groups):
+            if gk is not None and not gk[g]:
+                continue                     # no candidate sid in group
             # one row group at a time: the decode high-water mark stays
             # one group per prefetch worker, not the whole decoded file,
             # and each group reduces while the next one decodes
@@ -780,7 +802,8 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
                 series_dict, row_bucket_min: int,
                 time_range: Optional[TimestampRange],
                 plan=None, reduce: str = "device",
-                sid_keys: bool = False):
+                sid_keys: bool = False,
+                sid_set: Optional[np.ndarray] = None):
     """Read + merge + dedup one slice; reduce it on the host (returning
     partial moment frames) or prepare it for the device kernel
     (returning a padded transient MergedScan).
@@ -817,7 +840,7 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
             lean = _lean_chunk_frames(
                 snap, snap._region.access_layer, lean_files, dim, lo, hi,
                 needed_fields, plan, series_dict, need_ts,
-                sid_keys=sid_keys)
+                sid_keys=sid_keys, sid_set=sid_set)
             if lean is not None:
                 frames, rows_read = lean
                 return ("frames", frames,
@@ -825,12 +848,13 @@ def _load_slice(snap, dim: str, lo: int, hi: int, unit, needed_fields,
                          "dedup_skip_slices": 1})
     if dim == "series":
         data = snap.scan(projection=needed_fields, series_range=(lo, hi),
-                         time_range=time_range, synthetic_seq=True,
+                         time_range=time_range, sid_set=sid_set,
+                         synthetic_seq=True,
                          need_ts=need_ts, need_mvcc=not skip_dedup)
     else:
         data = snap.scan(projection=needed_fields,
                          time_range=TimestampRange(lo, hi, unit),
-                         synthetic_seq=True,
+                         sid_set=sid_set, synthetic_seq=True,
                          need_ts=need_ts, need_mvcc=not skip_dedup)
     if data.num_rows == 0:
         return None
@@ -973,6 +997,23 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
                     | {ff.column for ff in plan.field_filters})
     sd = region.series_dict
 
+    # point/IN tag conjuncts resolve to a candidate sid set so every
+    # slice prunes SSTs through their index sidecars before decoding
+    # (superset semantics: the per-slice reductions still apply the
+    # full predicate set)
+    sid_set = None
+    if plan.tag_predicates and sd is not None and sd.tag_names:
+        from ..storage.index import sst_index_enabled
+        if sst_index_enabled():
+            from ..mito.engine import sid_candidates_for_filters
+            sid_set = sid_candidates_for_filters(sd, sd.tag_names,
+                                                 plan.tag_predicates)
+            if sid_set is not None and len(sid_set) == 0:
+                # the point predicate matches no series of this region
+                prof.total_s = _time.perf_counter() - _t_start
+                region.last_scan_profile = prof
+                return []
+
     mode = _COLD_REDUCE[0]
     sid_keys = mode == "host" and _sid_keyed(plan)
     launched = []
@@ -991,7 +1032,7 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
             transient_executor(depth, "stream-scan") as pool:
         futs = [pool.submit(load, snap, dim, lo, hi, unit, needed,
                             sd, _ROW_BUCKET_MIN, clip, plan, mode,
-                            sid_keys)
+                            sid_keys, sid_set)
                 for dim, lo, hi, clip in jobs[:depth]]
         try:
             for i in range(len(jobs)):
@@ -1005,7 +1046,8 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
                     dim, lo, hi, clip = jobs[i + depth]
                     futs.append(pool.submit(
                         load, snap, dim, lo, hi, unit, needed,
-                        sd, _ROW_BUCKET_MIN, clip, plan, mode, sid_keys))
+                        sd, _ROW_BUCKET_MIN, clip, plan, mode, sid_keys,
+                        sid_set))
                 futs[i] = None               # free the slice as we go
                 if res is None:
                     prof.bump("empty_slices")

@@ -240,6 +240,14 @@ class _ScanCache:
             self._entries.pop(uid)
             used -= total[uid]
 
+    def cached(self, region) -> bool:
+        """Whether this region has a resident entry (any freshness):
+        the indexed-point planner prefers a warm cache — incremental
+        maintenance beats re-reading even one SST — and only routes
+        around the cache when the region would be scanned cold."""
+        with self._lock:
+            return region.uid in self._entries
+
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(e.scan.nbytes for e in self._entries.values())
@@ -979,19 +987,34 @@ def dispatch_decision_for_pushdown(table, plan) -> str:
     return "aggregate-pushdown (datanodes reduce, frontend folds)"
 
 
-def local_dispatch_decision(table, cold=None, regions=None) -> str:
-    """The resident / streamed / mixed decision string for a local
-    region-backed table — the ONE source both EXPLAIN (query/engine.py)
-    and execution (region_moment_frames → ExecStats) print, so the two
-    views cannot drift. `cold` lets a caller that already evaluated
-    region_streams_cold per region pass the answers in; `regions` the
-    (possibly pruned) region list those answers correspond to."""
+def local_dispatch_decision(table, cold=None, regions=None, plan=None,
+                            point_sids=None) -> str:
+    """The resident / streamed / indexed-point / mixed decision string
+    for a local region-backed table — the ONE source both EXPLAIN
+    (query/engine.py) and execution (region_moment_frames → ExecStats)
+    print, so the two views cannot drift. `cold` lets a caller that
+    already evaluated region_streams_cold per region pass the answers
+    in; `regions` the (possibly pruned) region list those answers
+    correspond to; `plan` (or a pre-computed `point_sids` vector) routes
+    point/IN tag queries through the SST secondary index."""
     from . import stream_exec
     if regions is None:
         regions = list(table.regions.values())
+    if point_sids is None:
+        point_sids = [region_point_sids(r, plan) for r in regions] \
+            if plan is not None else [None] * len(regions)
+    n_idx = sum(1 for s in point_sids if s is not None)
+    if regions and n_idx == len(regions):
+        k = max((len(s) for s in point_sids if s is not None), default=0)
+        return (f"indexed-point (sst index, {k} candidate series; "
+                f"bloom/sid-summary file pruning)")
     if cold is None:
         cold = [region_streams_cold(r) for r in regions]
-    n_stream = sum(cold)
+    n_stream = sum(1 for c, s in zip(cold, point_sids)
+                   if c and s is None)
+    if n_idx:
+        return (f"mixed ({n_idx}/{len(regions)} regions indexed-point, "
+                f"{n_stream} streamed-cold)")
     if n_stream == 0:
         return "device-resident (scan cache)"
     if n_stream == len(regions):
@@ -999,6 +1022,87 @@ def local_dispatch_decision(table, cold=None, regions=None) -> str:
                 f"stream_threshold_rows="
                 f"{stream_exec.stream_threshold_rows()})")
     return f"mixed ({n_stream}/{len(regions)} regions streamed-cold)"
+
+
+def region_point_sids(region, plan) -> Optional[np.ndarray]:
+    """Sorted candidate series ids for an indexed point/IN scan of this
+    region, or None when the standard resident/streamed paths win.
+
+    Eligible when the plan carries at least one point (`tag = lit`) or
+    `IN` tag conjunct (resolved per region through its series dict —
+    ROADMAP item 4's 'point and IN predicates prune files'), the sid
+    set is selective, the index tier is enabled, and the region is not
+    already resident in the scan cache (a warm cache beats any IO).
+    The set is a SUPERSET: the host reduction re-applies every tag
+    predicate exactly, so `!=`/range conjuncts riding along cannot
+    drift answers."""
+    from ..storage.index import sst_index_enabled
+    if plan is None or not plan.tag_predicates or not sst_index_enabled():
+        return None
+    sd = getattr(region, "series_dict", None)
+    if sd is None or not sd.tag_names:
+        return None
+    from ..mito.engine import sid_candidates_for_filters
+    sids = sid_candidates_for_filters(sd, sd.tag_names,
+                                      plan.tag_predicates)
+    if sids is None:
+        return None
+    S = sd.num_series
+    if S and len(sids) > max(64, S // 16):
+        return None                       # not selective: scan normally
+    if SCAN_CACHE.cached(region):
+        return None
+    return sids
+
+
+def _indexed_point_frames(region, table, plan: "TpuPlan",
+                          sids: np.ndarray) -> List[pd.DataFrame]:
+    """Partial moment frames for one region via the SST secondary
+    index: scan only the files/row groups that may hold the candidate
+    series (RegionSnapshot.scan's sid_set tier), merge-dedup the
+    surviving rows (exact MVCC), and reduce on the host with the same
+    segment arithmetic the streamed path uses — so _finalize folds
+    these partials like any others. Never touches the scan cache: a
+    point query on a cold many-SST region must not pay (or pin) full
+    residency for a handful of series."""
+    import time as _time
+
+    from ..common import exec_stats
+    from ..common.time import TimestampRange
+    from ..storage.region import ScanProfile
+    from . import stream_exec
+
+    prof = ScanProfile(path="indexed-point")
+    _t0 = _time.perf_counter()
+    snap = region.snapshot()
+    schema = snap.schema
+    tc = schema.timestamp_column
+    trange = None
+    if tc is not None and (plan.time_lo is not None or
+                           plan.time_hi is not None):
+        trange = TimestampRange(plan.time_lo, plan.time_hi,
+                                tc.dtype.time_unit)
+    needed = sorted({m.column for m in plan.moments
+                     if m.column is not None}
+                    | {ff.column for ff in plan.field_filters})
+    data = snap.scan(projection=needed, time_range=trange, sid_set=sids)
+    prof.rows = data.num_rows
+    prof.bump("candidate_sids", len(sids))
+    prof.mark("scan", _time.perf_counter() - _t0)
+    frames: List[pd.DataFrame] = []
+    if data.num_rows:
+        _t1 = _time.perf_counter()
+        kept = stream_exec._slice_dedup(data)
+        frame = stream_exec._host_partial_frame(data, kept, plan,
+                                                region.series_dict)
+        prof.mark("reduce", _time.perf_counter() - _t1)
+        exec_stats.record("reduce", rows=data.num_rows,
+                          elapsed_s=prof.stages["reduce"])
+        if frame is not None and len(frame):
+            frames.append(frame)
+    prof.total_s = _time.perf_counter() - _t0
+    region.last_scan_profile = prof
+    return frames
 
 
 def region_streams_cold(region) -> bool:
@@ -1048,12 +1152,22 @@ def region_moment_frames(table, plan: TpuPlan,
         regions = [r for rn, r in table.regions.items() if rn in want]
     if not regions:
         return []
-    cold = [region_streams_cold(r) for r in regions]
-    exec_stats.set_dispatch(local_dispatch_decision(table, cold, regions))
+    # indexed point/IN queries bypass both the cache and the slicer:
+    # the SST index resolves the predicate to candidate series and the
+    # scan opens only the files that may hold them
+    point_sids = [region_point_sids(r, plan) for r in regions]
+    cold = [False if s is not None else region_streams_cold(r)
+            for r, s in zip(regions, point_sids)]
+    exec_stats.set_dispatch(local_dispatch_decision(
+        table, cold, regions, point_sids=point_sids))
     frames = []
     from ..common import process_list
-    for region, streams in zip(regions, cold):
+    for region, streams, sids in zip(regions, cold, point_sids):
         process_list.check_cancelled()     # per-region batch boundary
+        if sids is not None:
+            frames.extend(_indexed_point_frames(region, table, plan,
+                                                sids))
+            continue
         if streams:
             frames.extend(stream_exec.stream_region_moment_frames(
                 region, table, plan))

@@ -415,8 +415,15 @@ class Admin(Statement):
     - ``ADMIN MIGRATE REGION <table> <region> TO <node_id>``
     - ``ADMIN SPLIT REGION <table> <region> [AT <literal>]``
     - ``ADMIN REBALANCE [TABLE <table>]``
+
+    Table maintenance (storage surface; works standalone too):
+
+    - ``ADMIN FLUSH TABLE <table>``
+    - ``ADMIN COMPACT TABLE <table>``
     """
-    kind: str = ""                  # migrate_region | split_region | rebalance
+    #: migrate_region | split_region | rebalance | flush_table |
+    #: compact_table
+    kind: str = ""
     table: Optional[ObjectName] = None
     region: Optional[int] = None
     target_node: Optional[int] = None

@@ -284,8 +284,21 @@ class Parser:
         - ADMIN MIGRATE REGION <table> <region> TO <node_id>
         - ADMIN SPLIT REGION <table> <region> [AT <literal>]
         - ADMIN REBALANCE [TABLE <table>]
+
+        Plus table maintenance (storage surface, both deployments):
+
+        - ADMIN FLUSH TABLE <table>
+        - ADMIN COMPACT TABLE <table>
         """
         self.expect_kw("ADMIN")
+        if self.match_kw("FLUSH"):
+            self.expect_kw("TABLE")
+            return Admin(kind="flush_table",
+                         table=self.parse_object_name())
+        if self.match_kw("COMPACT"):
+            self.expect_kw("TABLE")
+            return Admin(kind="compact_table",
+                         table=self.parse_object_name())
         if self.match_kw("REBALANCE"):
             table = None
             if self.match_kw("TABLE"):
@@ -313,8 +326,9 @@ class Parser:
                          at_value=at_value)
         t = self.peek()
         raise ParserError(
-            f"expected MIGRATE REGION / SPLIT REGION / REBALANCE after "
-            f"ADMIN, found {t.value!r} at {t.pos}")
+            f"expected MIGRATE REGION / SPLIT REGION / REBALANCE / "
+            f"FLUSH TABLE / COMPACT TABLE after ADMIN, found "
+            f"{t.value!r} at {t.pos}")
 
     def parse_kill(self) -> Kill:
         """KILL [QUERY] <id> — the id is the `id` column of

@@ -158,6 +158,7 @@ class RegionSnapshot:
     def scan(self, *, projection: Optional[Sequence[str]] = None,
              time_range: Optional[TimestampRange] = None,
              series_range: Optional[Tuple[int, int]] = None,
+             sid_set: Optional[np.ndarray] = None,
              synthetic_seq: bool = False,
              need_ts: bool = True,
              need_mvcc: bool = True) -> ScanData:
@@ -167,7 +168,15 @@ class RegionSnapshot:
         fast path) skip decoding and materializing those columns; the
         returned arrays are 0-stride placeholders. need_ts=False also
         skips the per-file time-range mask: the caller asserts every
-        selected row group lies inside its requested range."""
+        selected row group lies inside its requested range.
+
+        `sid_set` is a SORTED candidate series-id array (a point/IN tag
+        predicate resolved through the series dictionary): whole SSTs
+        are dropped through their index sidecars (bloom over the file's
+        sid set — storage/index.py) before any parquet footer is read,
+        surviving files prune row groups through the sidecar's per-group
+        sid summary, and rows are masked to exact membership. Files
+        without a usable index degrade to stats-only pruning."""
         region = self._region
         v = self._version
         schema = v.schema
@@ -193,6 +202,8 @@ class RegionSnapshot:
             if series_range is not None:
                 sel &= (snap.series_ids >= series_range[0]) & \
                        (snap.series_ids < series_range[1])
+            if sid_set is not None:
+                sel &= np.isin(snap.series_ids, sid_set)
             if not sel.any():
                 continue
             fields = {}
@@ -210,12 +221,23 @@ class RegionSnapshot:
         # in-order streaming consumption keeps at most the decoded-but-
         # unprocessed files alive, not the whole region)
         from ..common.runtime import parallel_imap
+        candidates = v.ssts.files_in_range(time_range)
+        if sid_set is not None and candidates:
+            # the index pruning tier: drop whole files through their
+            # sid blooms before any footer is opened (stats-only
+            # degrade keeps un-indexed files); the prune stage reports
+            # files pruned by index as index_files_pruned/_checked
+            from .index import prune_files, sst_index_enabled
+            if sst_index_enabled():
+                candidates, _, _ = prune_files(
+                    region.access_layer.load_index, candidates, sid_set)
         for sst in parallel_imap(
                 lambda m: region.access_layer.read_sst(
                     m, projection=field_names, time_range=time_range,
-                    series_range=series_range, synthetic_seq=synthetic_seq,
+                    series_range=series_range, sid_set=sid_set,
+                    synthetic_seq=synthetic_seq,
                     need_ts=need_ts),
-                v.ssts.files_in_range(time_range)):
+                candidates):
             process_list.check_cancelled()     # per-file batch boundary
             if sst.num_rows == 0:
                 continue
@@ -235,6 +257,10 @@ class RegionSnapshot:
                 smax = int(sst.series_ids.max())
                 need_mask |= smin < series_range[0] or \
                     smax >= series_range[1]
+            sid_mask = None
+            if sid_set is not None:
+                sid_mask = np.isin(sst.series_ids, sid_set)
+                need_mask |= not sid_mask.all()
             if need_mask:
                 sel = np.ones(sst.num_rows, dtype=bool)
                 if time_range is not None and need_ts:
@@ -245,6 +271,8 @@ class RegionSnapshot:
                 if series_range is not None:
                     sel &= (sst.series_ids >= series_range[0]) & \
                            (sst.series_ids < series_range[1])
+                if sid_mask is not None:
+                    sel &= sid_mask
                 if not sel.any():
                     continue
             def take(a):
@@ -511,8 +539,11 @@ class Region:
         or a compaction victim whose purger delete never ran. Sweeping here
         keeps crashes from leaking storage forever (nothing else ever
         revisits unreferenced files)."""
-        referenced = {f.file_name for f in
-                      self.version_control.current.ssts.all_files()}
+        referenced = set()
+        for f in self.version_control.current.ssts.all_files():
+            referenced.add(f.file_name)
+            if f.index_file is not None:
+                referenced.add(f.index_file)
         prefix = f"{self.descriptor.region_dir}/sst/"
         removed = 0
         try:

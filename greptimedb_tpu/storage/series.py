@@ -162,6 +162,28 @@ class SeriesDict:
     def tag_value_id(self, tag_index: int, value) -> Optional[int]:
         return self.tag_dicts[tag_index].get(value)
 
+    def sids_for_value_ids(self, tag_index: int,
+                           value_ids: Sequence[int]) -> np.ndarray:
+        """Sorted series ids whose tag at `tag_index` takes any of the
+        given dictionary value ids — the inverted (tag value → series)
+        lookup behind per-SST index pruning: one vectorized pass over
+        the [num_series] staging column, no per-row work."""
+        if not value_ids or not self._series_rows:
+            return np.zeros(0, dtype=np.int32)
+        col, _ = self._decode_staging(tag_index)
+        hits = np.isin(col, np.asarray(list(value_ids), dtype=np.int32))
+        return np.nonzero(hits)[0].astype(np.int32)
+
+    def sids_for_tag_values(self, tag_index: int,
+                            values: Sequence) -> np.ndarray:
+        """Sorted series ids whose tag equals any of `values` exactly —
+        values absent from the dictionary match nothing (a point query
+        for a never-seen tag value resolves to the empty set, which
+        prunes every file)."""
+        ids = [self.tag_dicts[tag_index].get(v) for v in values]
+        return self.sids_for_value_ids(
+            tag_index, [i for i in ids if i is not None])
+
     # ---- persistence ----
     def to_dict(self) -> dict:
         return {

@@ -27,6 +27,8 @@ from ..common import failpoint as _fp
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
 from ..datatypes.vector import compat_column, null_column
+from .index import (SstIndex, index_file_name, load_sst_index,
+                    sst_index_enabled)
 from .object_store import ObjectStore
 
 _fp.register("sst_write")
@@ -66,6 +68,12 @@ class FileMeta:
     #: comparison pass (and the ts decode, when the query never reads
     #: time) on that proof.
     num_dup_keys: Optional[int] = None
+    #: secondary-index sidecar (storage/index.py: sid bloom + per-row-
+    #: group sid summaries) in the same sst/ dir; None = pre-upgrade
+    #: file or index disabled at write time — stats-only pruning then.
+    #: Set only AFTER the sidecar is durable, so the manifest can never
+    #: reference a sidecar that was not written (torture point 16).
+    index_file: Optional[str] = None
 
     def to_dict(self) -> dict:
         return {
@@ -76,6 +84,7 @@ class FileMeta:
             "sid_range": list(self.sid_range)
             if self.sid_range is not None else None,
             "num_dup_keys": self.num_dup_keys,
+            "index_file": self.index_file,
         }
 
     @staticmethod
@@ -85,7 +94,8 @@ class FileMeta:
                         d.get("max_sequence", 0), d.get("num_deletes"),
                         tuple(d["sid_range"])
                         if d.get("sid_range") is not None else None,
-                        d.get("num_dup_keys"))
+                        d.get("num_dup_keys"),
+                        d.get("index_file"))
 
     def keys_overlap(self, other: "FileMeta") -> bool:
         """Whether the two files' key rectangles intersect — i.e. some
@@ -178,9 +188,33 @@ class AccessLayer:
         self.compression = compression
         #: per-file row-group time stats, keyed by (immutable) file name
         self._rg_stats: Dict[str, List[Tuple[int, int, int]]] = {}
+        #: parsed index sidecars, keyed by file name; the None sentinel
+        #: pins a missing/corrupt verdict so a poisoned sidecar is not
+        #: re-read (and re-logged) on every query — reopening the region
+        #: (a fresh layer) retries
+        self._sst_index: Dict[str, Optional[SstIndex]] = {}
 
     def _key(self, file_name: str) -> str:
         return f"{self.sst_dir}/{file_name}"
+
+    # ---- secondary index sidecars ----
+    def _cache_index(self, file_name: str, idx: Optional[SstIndex]) -> None:
+        if len(self._sst_index) > 4096:      # bound like the footer cache
+            self._sst_index.clear()
+        self._sst_index[file_name] = idx
+
+    def load_index(self, meta: FileMeta) -> Optional[SstIndex]:
+        """The file's parsed index sidecar, or None (stats-only pruning:
+        pre-upgrade file, index disabled, or corrupt/missing sidecar —
+        the degrade path, counted by greptime_sst_index_degrade_total)."""
+        if meta.index_file is None or not sst_index_enabled():
+            return None
+        if meta.file_name in self._sst_index:
+            return self._sst_index[meta.file_name]
+        idx = load_sst_index(self.store.read, self._key(meta.index_file),
+                             meta.num_rows)
+        self._cache_index(meta.file_name, idx)
+        return idx
 
     # ---- write ----
     def write_sst(self, *, level: int, series_ids: np.ndarray, ts: np.ndarray,
@@ -284,6 +318,32 @@ class AccessLayer:
         # the parquet file is durable but unreferenced: a crash HERE
         # leaves an orphan SST for the reopen sweep to collect
         _fp.fail_point("sst_write_after")
+        index_file = None
+        if sst_index_enabled():
+            try:
+                # crash HERE = SST data durable, index sidecar not:
+                # neither is referenced yet (the manifest edit commits
+                # later), so the reopen sweep collects both — a committed
+                # FileMeta can never name a sidecar that is not on disk
+                # (torture point 16). A SimulatedCrash is a BaseException
+                # and propagates; an injected err degrades below.
+                _fp.fail_point("sst_index_write")
+                sidx = SstIndex.build(series_ids, self.row_group_size)
+                candidate = index_file_name(file_name)
+                self.store.write(self._key(candidate), sidx.to_bytes())
+                index_file = candidate
+                # the freshly built object serves reads until evicted —
+                # no reason to re-parse our own bytes on first consult
+                self._cache_index(file_name, sidx)
+            except Exception as e:  # noqa: BLE001 — the index is an
+                # optimization: a failed sidecar write degrades this
+                # file to stats-only pruning, it must not fail the flush
+                from ..common.telemetry import increment_counter
+                increment_counter("sst_index_degrade")
+                import logging
+                logging.getLogger(__name__).warning(
+                    "SST %s: index sidecar write failed (%s); file "
+                    "stays stats-only", file_name, e)
         dups = 0
         if n > 1:
             # rows are (sid, ts, seq)-sorted: duplicate keys are adjacent
@@ -296,19 +356,27 @@ class AccessLayer:
             max_sequence=int(seq.max()) if n else 0,
             num_deletes=int(np.count_nonzero(op_types)),
             sid_range=(int(series_ids.min()), int(series_ids.max())),
-            num_dup_keys=dups)
+            num_dup_keys=dups, index_file=index_file)
 
     # ---- read ----
     def read_sst(self, meta: FileMeta, *,
                  projection: Optional[Sequence[str]] = None,
                  time_range: Optional[TimestampRange] = None,
                  series_range: Optional[Tuple[int, int]] = None,
+                 sid_set: Optional[np.ndarray] = None,
                  synthetic_seq: bool = False,
                  need_ts: bool = True) -> SstData:
         """Read an SST with column projection and row-group pruning on
         the time index and/or the series id (`series_range` is a
         half-open [lo, hi) over __series_id — the storage sort order,
         so series pruning is tight on every file layout).
+
+        `sid_set` is a SORTED array of candidate series ids (a resolved
+        point/IN tag predicate): row groups are selected through the
+        index sidecar's per-group sid summary when present — exact
+        membership, no footer stats consulted — and through footer
+        min/max otherwise. Row-level filtering stays with the caller
+        (RegionSnapshot.scan masks by membership).
 
         synthetic_seq=True skips decoding the 8-byte __sequence column
         and fills meta.max_sequence instead: per-file sequence ranges
@@ -346,6 +414,27 @@ class AccessLayer:
                 if int(stats.max) >= s0 and int(stats.min) < s1:
                     kept.append(g)
             groups = kept
+        if sid_set is not None and groups:
+            idx = self.load_index(meta)
+            if idx is not None and \
+                    len(idx.rg_lo) == pf.metadata.num_row_groups:
+                gk = idx.row_groups_for(sid_set)
+                groups = [g for g in groups if gk[g]]
+            else:
+                # stats-only degrade: footer min/max per group
+                sid_idx = pf.schema_arrow.get_field_index(SERIES_COL)
+                s = np.asarray(sid_set, dtype=np.int64)
+                kept = []
+                for g in groups:
+                    stats = pf.metadata.row_group(g).column(
+                        sid_idx).statistics
+                    if stats is None or not stats.has_min_max:
+                        kept.append(g)
+                        continue
+                    i = int(np.searchsorted(s, int(stats.min)))
+                    if i < len(s) and int(s[i]) <= int(stats.max):
+                        kept.append(g)
+                groups = kept
         from ..common import exec_stats
         exec_stats.record("prune", files=1,
                           row_groups=pf.metadata.num_row_groups,
@@ -505,6 +594,18 @@ class AccessLayer:
 
     def delete_sst(self, file_name: str) -> None:
         self.store.delete(self._key(file_name))
+        # the sidecar lives and dies with its SST (best-effort: an
+        # index orphaned by a crash mid-delete is swept at reopen)
+        self._sst_index.pop(file_name, None)
+        try:
+            self.store.delete(self._key(index_file_name(file_name)))
+        except FileNotFoundError:
+            pass                             # stats-only file: no sidecar
+        except Exception as e:  # noqa: BLE001 — the data file is gone; a
+            # stale sidecar is harmless garbage the reopen sweep collects
+            import logging
+            logging.getLogger(__name__).warning(
+                "could not delete index sidecar of %s: %s", file_name, e)
 
 
 def _ts_stat_to_int(v, unit) -> int:

@@ -1,0 +1,55 @@
+-- Per-SST secondary index pruning (ISSUE 13): point/IN tag predicates
+-- resolve to series-id sets through the series dictionary, and the scan
+-- planner drops whole SST files through their bloom sidecars before any
+-- parquet footer is opened. The prune stage reports files pruned by
+-- index as index_files_pruned / index_files_checked; the elapsed_ms
+-- column is normalized by the runner.
+
+CREATE TABLE idx_prune (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+-- three flushed SSTs whose sid RANGES overlap (h4 appears in every
+-- batch) but whose sid SETS differ — the layout coarse min/max stats
+-- cannot prune and the bloom can
+INSERT INTO idx_prune VALUES ('h1', 1000, 1.0), ('h4', 1500, 4.0);
+
+ADMIN FLUSH TABLE idx_prune;
+
+INSERT INTO idx_prune VALUES ('h2', 2000, 2.0), ('h4', 2500, 4.5);
+
+ADMIN FLUSH TABLE idx_prune;
+
+INSERT INTO idx_prune VALUES ('h3', 3000, 3.0), ('h4', 3500, 5.0);
+
+ADMIN FLUSH TABLE idx_prune;
+
+-- pin the dispatch floor (also resets the latency-adaptive floor) so
+-- the point query takes the device path, not cpu-small-scan
+SET tpu_dispatch_min_rows = 1;
+
+-- host='h2' lives only in the second SST: the first file is dropped by
+-- its sid range, the third by its bloom (its range covers h2's sid but
+-- its sid set does not) — files pruned by index 2/3
+EXPLAIN ANALYZE SELECT host, max(v) FROM idx_prune
+    WHERE host = 'h2' GROUP BY host;
+
+-- the differential kill switch: SET sst_index = 0 restores the
+-- stats-only read path (no file pruning tier, resident scan cache)
+SET sst_index = 0;
+
+SELECT host, max(v) FROM idx_prune WHERE host = 'h2' GROUP BY host;
+
+SET sst_index = 1;
+
+-- IN(...) resolves to a multi-sid candidate set the same way
+SELECT host, max(v) FROM idx_prune
+    WHERE host IN ('h1', 'h3') GROUP BY host ORDER BY host;
+
+-- restore defaults (these knobs are process-global)
+SET tpu_dispatch_min_rows = 131072;
+
+DROP TABLE idx_prune;

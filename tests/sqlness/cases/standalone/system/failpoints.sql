@@ -25,6 +25,12 @@ INSERT INTO fpt VALUES ('c', 3000, 3.0);
 SELECT name, action, hits, fires FROM information_schema.failpoints
     WHERE name LIKE 'wal_%' ORDER BY name;
 
+-- the SST secondary-index crash/degrade points (ISSUE 13): write sits
+-- between the SST data write and the sidecar publish, read degrades a
+-- consult to stats-only pruning
+SELECT name, action FROM information_schema.failpoints
+    WHERE name LIKE 'sst_index%' ORDER BY name;
+
 -- NxM one-in-N arming renders verbatim
 SET failpoint_objstore_read = '1x3*err(transient)';
 

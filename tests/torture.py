@@ -164,14 +164,25 @@ def check_invariants(region: Region,
     for key in got:
         assert key in acked or key in maybe, \
             f"phantom row {key} appeared after recovery"
-    # 4. manifest references only existing SSTs
+    # 4. manifest references only existing SSTs — and only existing
+    #    index sidecars: a committed FileMeta must NEVER name a sidecar
+    #    that is not on disk (matrix point 16: the sidecar is written
+    #    before the manifest edit that references it, so a crash between
+    #    SST data write and index publish leaves both unreferenced)
     for f in region.version_control.current.ssts.all_files():
         key = f"{region.descriptor.region_dir}/sst/{f.file_name}"
         assert region.store.exists(key), \
             f"manifest references missing SST {f.file_name}"
-    # 5. no orphan SSTs survive the reopen sweep
-    referenced = {f.file_name for f in
-                  region.version_control.current.ssts.all_files()}
+        if f.index_file is not None:
+            ikey = f"{region.descriptor.region_dir}/sst/{f.index_file}"
+            assert region.store.exists(ikey), \
+                f"dangling index sidecar ref {f.index_file}"
+    # 5. no orphan SSTs (or index sidecars) survive the reopen sweep
+    referenced = set()
+    for f in region.version_control.current.ssts.all_files():
+        referenced.add(f.file_name)
+        if f.index_file is not None:
+            referenced.add(f.index_file)
     on_disk = {k.rsplit("/", 1)[-1]
                for k in region.store.list(
                    f"{region.descriptor.region_dir}/sst/")}
@@ -250,6 +261,11 @@ CRASH_POINTS: Dict[str, Tuple[str, bool]] = {
     "region_write_memtable": ("write", True),  # WAL holds it already
     "sst_write":            ("flush", False),
     "sst_write_after":      ("flush", False),
+    # matrix point 16: crash between the SST data write and the index-
+    # sidecar publish — reopen must see both or neither (the data file
+    # is an unreferenced orphan the sweep collects; a committed manifest
+    # can never carry a dangling index ref)
+    "sst_index_write":      ("flush", False),
     "dict_persist":         ("flush", False),
     "flush_commit":         ("flush", False),
     "manifest_commit":      ("flush", False),
