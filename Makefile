@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
-	bench-index
+	bench-index bench-distagg
 
 check: lint typecheck test
 
@@ -62,3 +62,10 @@ bench-qps:
 # on vs `SET sst_index = 0` (asserts the >=3x differential)
 bench-index:
 	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=index $(PY) bench.py
+
+# only the ISSUE 14 metric: 4-datanode GROUP BY with
+# count/count-distinct/p95 through the sketch partial pushdown vs the
+# raw-row fallback (`SET dist_partial_agg = 0`); asserts the >=3x
+# wire-byte reduction
+bench-distagg:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=distagg $(PY) bench.py

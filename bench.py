@@ -825,6 +825,141 @@ def bench_dist_scatter(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _record_batches_bytes(batches):
+    """Bytes a raw-row scatter ships: column buffers (+ validity), with
+    object/string columns measured by their encoded text lengths."""
+    total = 0
+    for b in batches:
+        for v in b.columns:
+            data = getattr(v, "data", None)
+            if data is None:
+                continue
+            if getattr(data, "dtype", None) is not None and \
+                    data.dtype == object:
+                total += int(sum(len(str(x)) for x in data
+                                 if x is not None))
+            else:
+                total += int(getattr(data, "nbytes", 0) or 0)
+            validity = getattr(v, "validity", None)
+            if validity is not None:
+                total += int(getattr(validity, "nbytes", 0) or 0)
+    return total
+
+
+def bench_dist_partial_agg(n_rows: int):
+    """Seventh driver metric (ISSUE 14): distributed GROUP BY through
+    the sketch partial pushdown. 4 in-process datanodes host an
+    8-region hash table; the timed query is the TSBS-ish wide shape —
+    GROUP BY tag with count / count(DISTINCT) / approx_percentile(95)
+    — which before this PR fell back to pulling RAW ROWS from every
+    region. Differential: `SET dist_partial_agg = 0` (the raw-row
+    fallback). Published: rows/s through the pushdown, the speedup vs
+    raw, and the wire-byte comparison — partial frames actually folded
+    (ExecStats partial_bytes) vs the bytes a raw scatter ships
+    (projected scan batches) — asserted >= 3x smaller."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.client import LocalDatanodeClient
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.distributed import DistInstance
+    from greptimedb_tpu.meta import MemKv, MetaClient, MetaSrv, Peer
+    from greptimedb_tpu.session import QueryContext
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-distagg-")
+    datanodes = {}
+    try:
+        srv = MetaSrv(MemKv())
+        meta = MetaClient(srv)
+        clients = {}
+        for i in range(1, 5):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=f"{tmpdir}/dn{i}", node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        ctx = QueryContext()
+        fe.do_query(
+            "CREATE TABLE cpu (hostname STRING, ts TIMESTAMP TIME INDEX, "
+            "usage_user DOUBLE, uid BIGINT, PRIMARY KEY(hostname)) "
+            "PARTITION BY HASH (hostname) PARTITIONS 8", ctx)
+        table = fe.catalog.table("greptime", "public", "cpu")
+        rng = np.random.default_rng(11)
+        hosts = 256
+        per = n_rows // hosts
+        ts = np.tile(np.arange(per, dtype=np.int64) * 10_000, hosts)
+        host = np.repeat(
+            np.array([f"host_{i}" for i in range(hosts)]),
+            per).astype(object)
+        # uid: ~2000 revisiting users — the classic "distinct users per
+        # host" cardinality shape count(DISTINCT) exists for
+        table.bulk_load({"hostname": host, "ts": ts,
+                         "usage_user": rng.random(len(ts)) * 100,
+                         "uid": rng.integers(0, 2000, len(ts))})
+        table.flush()
+        n = hosts * per
+        sql = ("SELECT hostname, count(usage_user) AS c, "
+               "count(DISTINCT uid) AS cd, "
+               "approx_percentile(usage_user, 95) AS p95 "
+               "FROM cpu GROUP BY hostname")
+
+        def timed(iters=2):
+            dt = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fe.do_query(sql, ctx)
+                dt = min(dt, time.perf_counter() - t0)
+            return dt
+
+        fe.do_query(sql, ctx)              # warm caches + compiles
+        dt_partial = timed()
+        stats = fe.query_engine.last_exec_stats
+        assert "aggregate-pushdown" in (stats.dispatch or ""), \
+            stats.dispatch
+        partial_bytes = stats.totals()["partial_bytes"]
+        assert partial_bytes > 0
+
+        # the raw-row differential: what the pre-PR fallback shipped
+        raw_bytes = _record_batches_bytes(table.scan_batches(
+            projection=["hostname", "ts", "usage_user", "uid"]))
+        fe.do_query("SET dist_partial_agg = 0", ctx)
+        try:
+            fe.do_query(sql, ctx)
+            dt_raw = timed()
+        finally:
+            fe.do_query("SET dist_partial_agg = 1", ctx)
+        reduction = raw_bytes / max(partial_bytes, 1)
+        assert reduction >= 3.0, (raw_bytes, partial_bytes, reduction)
+        return (n / dt_partial, dt_raw / dt_partial, partial_bytes,
+                raw_bytes, reduction)
+    finally:
+        for dn in datanodes.values():
+            dn.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def emit_dist_partial_agg():
+    n_rows = int(os.environ.get("GREPTIME_BENCH_DISTAGG_ROWS", 2_000_000))
+    rps, vs_raw, partial_b, raw_b, reduction = \
+        bench_dist_partial_agg(n_rows)
+    print(json.dumps({
+        "metric": "dist_partial_agg_throughput",
+        "value": round(rps / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_raw_pull": round(vs_raw, 2),
+        "partial_wire_bytes": int(partial_b),
+        "raw_wire_bytes": int(raw_b),
+        "wire_byte_reduction": round(reduction, 1),
+        "rows": n_rows,
+        "datanodes": 4,
+    }))
+
+
 def bench_region_migration_availability(n_rows: int):
     """Sixth driver metric (ISSUE 9): migrate a region between datanodes
     UNDER sustained single-row ingest and measure availability:
@@ -1148,6 +1283,9 @@ def main():
     if os.environ.get("GREPTIME_BENCH_ONLY") == "index":
         emit_index_point_query()
         return
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "distagg":
+        emit_dist_partial_agg()
+        return
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
 
@@ -1207,6 +1345,8 @@ def main():
         "datanodes": 4,
         "scatter_node_ms": node_ms,
     }))
+
+    emit_dist_partial_agg()
 
     mig_rows = int(os.environ.get("GREPTIME_BENCH_MIGRATE_ROWS",
                                   1_000_000))

@@ -96,6 +96,7 @@ _PROCESSES_SCHEMA = Schema([
     ColumnSchema("rows_scanned", dt.INT64),
     ColumnSchema("bytes_read", dt.INT64),
     ColumnSchema("rpcs", dt.INT64),
+    ColumnSchema("partial_bytes", dt.INT64),
 ])
 
 _SELF_MONITOR_SCHEMA = Schema([

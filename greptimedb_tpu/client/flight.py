@@ -171,6 +171,7 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
              "regions": list(regions) if regions is not None
              else None})).encode())
         frames = []
+        wire_bytes = 0
         try:
             reader = self.conn.do_get(ticket)
             while True:
@@ -179,10 +180,16 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
                 except StopIteration:
                     break
                 if chunk.data is not None:
+                    wire_bytes += chunk.data.nbytes
                     frames.append(chunk.data.to_pandas())
             _absorb_stream_stats(reader.schema)
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
+        # actual serialized partial-frame bytes off THIS hop — lands on
+        # the per-RPC node sub-collector so the EXPLAIN ANALYZE node
+        # block shows what the wire carried instead of raw rows
+        exec_stats.record("partial_wire", bytes=wire_bytes,
+                          frames=len(frames))
         return [f for f in frames if len(f)]
 
     def scan_batches(self, catalog: str, schema: str, table: str,

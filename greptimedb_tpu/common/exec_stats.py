@@ -171,6 +171,7 @@ class ExecStats:
         distributed scan's rows live on the node blocks)."""
         resident = streamed = streamed_live = 0
         io_bytes = decode_bytes = rpcs = 0
+        partial_bytes = partial_wire = 0
         with self._lock:
             for st in self.stages.values():
                 if st.stage == "stream_scan":
@@ -179,6 +180,17 @@ class ExecStats:
                     resident += st.rows
                 if st.stage == "io_read":
                     io_bytes += int(st.detail.get("bytes", 0))
+                if st.stage == "finalize":
+                    # partial-aggregate frame bytes folded by this
+                    # statement (the wire cost aggregate pushdown pays
+                    # instead of raw rows), recorded when the fold runs
+                    partial_bytes += int(st.detail.get("partial_bytes",
+                                                       0))
+                if st.stage == "partial_wire":
+                    # per-RPC serialized partial bytes, recorded AS each
+                    # Flight stream drains — the live floor while the
+                    # statement still gathers (finalize lands at the end)
+                    partial_wire += int(st.detail.get("bytes", 0))
                 if st.stage == "decode":
                     # stream_rows = the streamed share of the decode
                     # rows (the lean reader tags them; the resident
@@ -206,8 +218,16 @@ class ExecStats:
             rows += sub["rows_scanned"]
             bytes_read += sub["bytes_read"]
             rpcs += sub["rpcs"]
+            # node sub-collectors carry the partial_wire stages their
+            # RPCs recorded — the in-flight share of the partial bytes
+            partial_wire += sub.get("partial_bytes", 0)
+        # finalize (frontend-measured, complete) and partial_wire
+        # (per-hop, live) describe the SAME frames at two moments —
+        # take the larger, never the sum, so the processes view counts
+        # partials while the gather runs without double-billing after
         return {"rows_scanned": rows, "bytes_read": bytes_read,
-                "rpcs": rpcs}
+                "rpcs": rpcs,
+                "partial_bytes": max(partial_bytes, partial_wire)}
 
     def node_elapsed_ms(self, wall_ms: float = 0.0) -> float:
         """The node-side share of a sub-collector: the remote-reported

@@ -79,7 +79,8 @@ class ProcessEntry:
     def totals(self) -> Dict[str, int]:
         stats = self.stats
         if stats is None:
-            return {"rows_scanned": 0, "bytes_read": 0, "rpcs": 0}
+            return {"rows_scanned": 0, "bytes_read": 0, "rpcs": 0,
+                    "partial_bytes": 0}
         return stats.totals()
 
     def row(self) -> Dict[str, object]:
@@ -92,6 +93,7 @@ class ProcessEntry:
             "elapsed_ms": self.elapsed_ms(),
             "rows_scanned": t["rows_scanned"],
             "bytes_read": t["bytes_read"], "rpcs": t["rpcs"],
+            "partial_bytes": t.get("partial_bytes", 0),
         }
 
 

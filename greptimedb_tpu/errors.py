@@ -186,6 +186,17 @@ class QueryCancelledError(GreptimeError):
     status_code = StatusCode.ENGINE_EXECUTE_QUERY
 
 
+class SketchCodecError(GreptimeError):
+    """A sketch partial (HLL / t-digest frame from a datanode) failed to
+    decode: corrupt, truncated, or version-skewed. The frontend counts
+    ``greptime_sketch_degrade_total`` and retries the statement through
+    the raw-row path — a bad partial must never become a wrong answer.
+    NOT transient: the same partial would re-corrupt on a plain retry of
+    the same RPC."""
+
+    status_code = StatusCode.ENGINE_EXECUTE_QUERY
+
+
 class StaleRouteError(GreptimeError):
     """The caller's region route is out of date: the region moved
     (migrate), was refined away (split), or is fenced for an in-flight

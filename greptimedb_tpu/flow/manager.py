@@ -214,6 +214,12 @@ def compile_flow(stmt: ast.CreateFlow, src_table, catalog: str,
             raise UnsupportedError(
                 "avg is not incrementally mergeable; store sum(x) and "
                 "count(x) — avg queries are rewritten from them")
+        if op in ("approx_distinct", "approx_percentile", "median"):
+            raise UnsupportedError(
+                f"{op} partials are sketches, not columns a flow sink "
+                f"can store; query the raw table — the distributed "
+                f"sketch pushdown (README 'Distributed aggregation') "
+                f"serves it without materialization")
         if op not in FLOW_OPS:
             raise UnsupportedError(
                 f"aggregate {e.name!r} is not derivable in a flow "

@@ -73,6 +73,8 @@ def _serialize_dist_rule(rule):
 
 
 
+
+
 #: stale-route retries: attempts AFTER the first try for a statement
 #: whose route moved mid-flight (migrate/split) or whose target region
 #: is fenced for an in-flight handoff. Backoff doubles from
@@ -579,12 +581,13 @@ class DistTable(Table):
                           node_ms=vector)
 
     def _plan_scatter(self, plan):
-        """(survivors, total, targets) for an aggregate plan, memoized
-        on the plan object — try_execute asks for the dispatch string
-        (scatter_describe) right before execute_tpu_plan runs the same
-        plan, and the route walk should happen once. Keyed on the route
-        version too: a stale-route refresh mid-statement must re-plan
-        instead of re-using a scatter over regions that just moved."""
+        """(survivors, total, targets, cost) for an aggregate plan,
+        memoized on the plan object — try_execute asks for the dispatch
+        string (scatter_describe) right before execute_tpu_plan runs the
+        same plan, and the route + cost walk should happen once. Keyed
+        on the route version too: a stale-route refresh mid-statement
+        must re-plan instead of re-using a scatter over regions that
+        just moved."""
         cached = getattr(plan, "_dist_scatter_cache", None)
         if cached is not None and cached[0] is self and \
                 cached[1] == self.route.version:
@@ -593,9 +596,162 @@ class DistTable(Table):
             filters=plan.tag_predicates, time_lo=plan.time_lo,
             time_hi=plan.time_hi)
         targets = self._owners_for(survivors)
-        result = (survivors, total, targets)
+        cost = self._plan_cost(plan, survivors)
+        result = (survivors, total, targets, cost)
         plan._dist_scatter_cache = (self, self.route.version, result)
         return result
+
+    # ---- cost-based dispatch (ISSUE 14) ----
+    #: heartbeat-estimate cache TTL: one meta read serves a burst of
+    #: statements; heat only moves at heartbeat cadence anyway
+    _HEAT_TTL_S = 5.0
+
+    def _region_estimates(self, wanted: Sequence[int]
+                          ) -> Dict[int, Tuple[int, int, int]]:
+        """{region_number: (rows, series, time_span)} for the cost
+        planner, restricted to `wanted` (the plan's surviving regions —
+        pruned siblings must not pay the SST-meta walk). In-process
+        datanodes are walked directly (SST/memtable stats + series-dict
+        counts); regions behind a wire client fall back to the meta
+        heartbeat's region_stats — the SAME numbers, one stat beat
+        stale, that every datanode already ships (ISSUE 14: 'SST stats
+        + series-dict counts already in the route/heartbeat'). Results
+        are TTL-cached per route version, so a statement burst pays one
+        walk. Regions neither walkable nor heartbeat-known stay absent
+        and the planner defaults to partial pushdown. Estimation must
+        never fail a query."""
+        now = time.monotonic()
+        cache = getattr(self, "_est_cache", None)
+        if cache is None or cache[0] <= now or \
+                cache[2] != self.route.version:
+            cache = (now + self._HEAT_TTL_S,
+                     {}, self.route.version)
+            self._est_cache = cache
+        est: Dict[int, Tuple[int, int, int]] = cache[1]
+        todo = [rn for rn in wanted if rn not in est]
+        if not todo:
+            return est
+        from ..query.stream_exec import (region_estimated_rows,
+                                         region_time_span)
+        by_number = {rr.region_number: rr
+                     for rr in self.route.region_routes}
+        missing: List[int] = []
+        for rn in todo:
+            rr = by_number.get(rn)
+            client = self.clients.get(rr.leader.id) \
+                if rr is not None else None
+            datanode = getattr(client, "datanode", None)
+            if datanode is None:
+                missing.append(rn)
+                continue
+            try:
+                t = datanode.catalog.table(
+                    self.info.catalog_name, self.info.schema_name,
+                    self.info.name)
+                region = t.regions.get(rn) if t is not None else None
+                if region is None:
+                    missing.append(rn)
+                    continue
+                sd = getattr(region, "series_dict", None)
+                est[rn] = (
+                    region_estimated_rows(region),
+                    int(getattr(sd, "num_series", 0) or 0),
+                    region_time_span(region))
+            except Exception:  # noqa: BLE001 — estimates are advisory:
+                # an unwalkable region leaves the map partial and the
+                # planner defaults to pushdown
+                from ..common.telemetry import increment_counter
+                increment_counter("cost_estimate_errors")
+                missing.append(rn)
+                continue
+        if missing:
+            from ..mito.engine import region_name
+            heat = self._heartbeat_estimates()
+            for rn in missing:
+                found = heat.get(region_name(self.info.ident.table_id,
+                                             rn))
+                if found is not None:
+                    est[rn] = found
+        return est
+
+    def _heartbeat_estimates(self) -> Dict[str, Tuple[int, int, int]]:
+        """{region name: (rows, series, time_span)} from the meta
+        service's heartbeat-fed region stats, TTL-cached per table so a
+        statement burst costs one meta read. Empty (and still cached,
+        bounding the retry rate) when meta is unreachable or not the
+        leader — the planner then defaults to pushdown."""
+        cached = getattr(self, "_heat_cache", None)
+        now = time.monotonic()
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        heat: Dict[str, Tuple[int, int, int]] = {}
+        if self.meta is not None and hasattr(self.meta, "region_heat"):
+            try:
+                for h in self.meta.region_heat():
+                    heat[str(h["region"])] = (
+                        int(h.get("rows", 0) or 0),
+                        int(h.get("series", 0) or 0),
+                        int(h.get("time_span", 0) or 0))
+            except Exception:  # noqa: BLE001 — advisory: a follower
+                # meta or a flaky hop degrades to pushdown-by-default
+                from ..common.telemetry import increment_counter
+                increment_counter("cost_estimate_errors")
+                heat = {}
+        self._heat_cache = (now + self._HEAT_TTL_S, heat)
+        return heat
+
+    def _plan_cost(self, plan, survivors) -> Optional[dict]:
+        """Estimated result cardinality + wire bytes for this plan over
+        the surviving regions, and the partial-pushdown vs raw-pull
+        choice. None = no estimate (remote datanodes without local
+        stats): pushdown by default.
+
+        The model: each region's GROUP BY yields at most
+        min(rows, series × buckets) partial groups; a partial group
+        costs its moment widths (8B numeric, bounded sketch frames for
+        distinct/t-digest); a raw row costs its projected columns.
+        Raw-pull wins only when the partial frames would outweigh the
+        raw rows ~2x — the GROUP BY keys are nearly unique and a
+        per-group sketch carries more than the rows it summarizes."""
+        from ..query import sketches
+        from ..query.tpu_exec import plan_scan_columns
+        est = self._region_estimates(survivors)
+        if not survivors or any(r not in est for r in survivors):
+            return None
+        rows = 0
+        groups = 0
+        stride = plan.bucket.stride_ms if plan.bucket is not None else None
+        for r in survivors:
+            n, series, span = est[r]
+            if n == 0:
+                continue
+            rows += n
+            g = max(1, series) if plan.tag_groups else 1
+            if stride:
+                g *= max(1, min(n, -(-max(span, 1) // stride)))
+            groups += min(n, g)
+        if rows == 0:
+            return {"mode": "pushdown", "est_rows": 0, "est_groups": 0}
+        rows_per_g = max(1, rows // max(groups, 1))
+        per_g = 8 * (len(plan.tag_groups) +
+                     (1 if plan.bucket else 0) + 1)   # keys + __rowcount
+        for m in plan.moments:
+            if m.op == "distinct":
+                per_g += min(
+                    8 * min(rows_per_g, sketches.EXACT_SET_LIMIT) + 40,
+                    (1 << sketches.hll_precision()) + 16)
+            elif m.op == "tdigest":
+                per_g += 16 * min(rows_per_g,
+                                  int(sketches.tdigest_delta())) + 44
+            else:
+                per_g += 8
+        partial_b = groups * per_g
+        raw_b = rows * (20 + 8 * len(plan_scan_columns(plan,
+                                                       self.schema)))
+        mode = "raw" if partial_b > 2 * raw_b else "pushdown"
+        return {"mode": mode, "est_rows": int(rows),
+                "est_groups": int(groups), "partial_bytes": int(partial_b),
+                "raw_bytes": int(raw_b)}
 
     def execute_tpu_plan(self, plan) -> List[pd.DataFrame]:
         """Aggregate pushdown: prune regions by the plan's tag/time
@@ -606,7 +762,16 @@ class DistTable(Table):
             "aggregate", lambda: self._execute_tpu_plan_once(plan))
 
     def _execute_tpu_plan_once(self, plan) -> List[pd.DataFrame]:
-        survivors, total, targets = self._plan_scatter(plan)
+        survivors, total, targets, cost = self._plan_scatter(plan)
+        if cost is not None and cost["mode"] == "raw":
+            # cost-based choice: the partial frames would outweigh the
+            # raw rows — UnsupportedError sends try_execute to the
+            # raw-row scatter, under the SAME dispatch line
+            # scatter_describe already printed
+            raise UnsupportedError(
+                f"cost-based dispatch chose raw-pull (est "
+                f"{cost['est_rows']} rows -> {cost['est_groups']} "
+                f"groups)")
         self._record_scatter(len(survivors), total, len(targets))
         frames: List[pd.DataFrame] = []
         node_ms: list = []
@@ -622,11 +787,23 @@ class DistTable(Table):
 
     def scatter_describe(self, plan) -> str:
         """The pruned-scatter dispatch line shared by EXPLAIN and
-        execution (query/tpu_exec.dispatch_decision_for_pushdown)."""
-        survivors, total, targets = self._plan_scatter(plan)
-        return (f"aggregate-pushdown (regions pruned "
-                f"{total - len(survivors)}/{total}, "
-                f"fan-out={len(targets)}; "
+        execution (query/tpu_exec.dispatch_decision_for_pushdown) —
+        including the cost-based partial-pushdown vs raw-pull choice
+        with its row estimates, so EXPLAIN, EXPLAIN ANALYZE and the
+        executed path render ONE decision."""
+        survivors, total, targets, cost = self._plan_scatter(plan)
+        prefix = (f"regions pruned {total - len(survivors)}/{total}, "
+                  f"fan-out={len(targets)}")
+        if cost is None:
+            return (f"aggregate-pushdown ({prefix}; "
+                    f"datanodes reduce, frontend folds)")
+        est = (f"est_rows={cost['est_rows']} -> "
+               f"est_groups={cost['est_groups']}")
+        if cost["mode"] == "raw":
+            return (f"raw-pull ({prefix}; {est}, partial frames would "
+                    f"outweigh raw rows; datanodes ship rows, frontend "
+                    f"aggregates)")
+        return (f"aggregate-pushdown ({prefix}; {est}; "
                 f"datanodes reduce, frontend folds)")
 
     def flush(self) -> None:

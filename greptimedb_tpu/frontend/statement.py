@@ -365,6 +365,28 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
                 configure_coalescer(window_ms=value)
         except ValueError as e:
             raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+    elif name == "exact_distinct":
+        # 1 = refuse sketch partials for count(DISTINCT): the statement
+        # takes the raw-row path, exact at any cardinality
+        from ..query import sketches
+        sketches.configure(exact_distinct=bool(_int_setting(stmt)))
+    elif name == "approx_error_target":
+        # target relative error for the approx aggregates: drives the
+        # HLL precision and the t-digest compression together
+        from ..query import sketches
+        try:
+            sketches.configure(error_target=float(stmt.value))
+        except (TypeError, ValueError):
+            raise InvalidArgumentsError(
+                f"SET {stmt.name}: expected a number in [0.001, 0.25], "
+                f"got {stmt.value!r}")
+    elif name == "dist_partial_agg":
+        # distributed partial-aggregate pushdown kill switch: 0 sends
+        # GROUP BYs over DistTables through the raw-row scatter (the
+        # bench differential compares wire bytes against it)
+        from ..query import tpu_exec
+        tpu_exec.configure_partial_pushdown(
+            enabled=bool(_int_setting(stmt)))
     elif name == "scan_fusion":
         # single-flight fusion of concurrent identical small scans of
         # one region (query/tpu_exec.py); 0 = every scan solo

@@ -435,6 +435,10 @@ class MetaSrv:
                         "region": rs["region"],
                         "rows": int(rs["rows"]),
                         "size_bytes": int(rs["size_bytes"]),
+                        # cost-planner inputs riding the heartbeat
+                        # (absent from pre-upgrade beats: .get)
+                        "series": int(rs.get("series", 0) or 0),
+                        "time_span": int(rs.get("time_span", 0) or 0),
                         "ingest_rate_rps": round(
                             rates.get(rs["region"], 0.0), 3)
                         if node_id in alive else 0.0,

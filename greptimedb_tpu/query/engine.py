@@ -1145,7 +1145,10 @@ def _result_dtype_override(expr, a: Analysis, table: Optional[Table]):
         for call in a.agg_calls:
             if call.slot != expr.name:
                 continue
-            if call.op == "count":
+            if call.op in ("count", "approx_distinct"):
+                # distinct counts are cardinalities: Int64 even when the
+                # per-group fallback frame decayed to float (a mixed
+                # int/float agg row upcasts under groupby.apply)
                 return dt.INT64
             if call.op in ("sum", "min", "max", "first", "last") and \
                     isinstance(call.arg, Column) and \

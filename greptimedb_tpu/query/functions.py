@@ -249,6 +249,40 @@ def _agg_norm_pdf(a, x=0.0):
     return math.exp(-0.5 * z * z) / (sigma * math.sqrt(2 * math.pi))
 
 
+def _agg_approx_distinct(a):
+    """Sketch-backed distinct count — the standalone twin of the
+    distributed HLL pushdown (query/sketches.py): exact below the
+    bounded set size, HLL past it, so both engines answer within the
+    same documented bound."""
+    from .sketches import DistinctSketch
+    v = _valid(a)
+    if not v.size:
+        return 0
+    return DistinctSketch.from_values(v).result()
+
+
+def _agg_approx_percentile(a, p=None):
+    if p is None:
+        raise InvalidArgumentsError(
+            "approx_percentile(x, p) needs a percentile argument")
+    p = float(p)
+    if not (0.0 <= p <= 100.0):
+        raise InvalidArgumentsError(
+            f"approx_percentile: p must be in [0, 100], got {p}")
+    from .sketches import TDigest
+    v = _valid(a)
+    if not v.size:
+        return None
+    return TDigest.from_values(v.astype(np.float64)).quantile(p)
+
+
+def _agg_median(a):
+    """t-digest median (documented approximation, same bound as
+    approx_percentile(x, 50)); use percentile(x, 50) for the exact
+    sort-based answer."""
+    return _agg_approx_percentile(a, 50.0)
+
+
 AGGREGATE_FUNCTIONS: Dict[str, Callable] = {
     "count": lambda a: int(_valid(a).size),
     "sum": lambda a: (lambda v: float(v.astype(np.float64).sum())
@@ -266,6 +300,9 @@ AGGREGATE_FUNCTIONS: Dict[str, Callable] = {
     "argmax": _agg_argmax,
     "argmin": _agg_argmin,
     "percentile": _agg_percentile,
+    "approx_distinct": _agg_approx_distinct,
+    "approx_percentile": _agg_approx_percentile,
+    "median": _agg_median,
     "diff": _agg_diff,
     "polyval": _agg_polyval,
     "scipy_stats_norm_cdf": _agg_norm_cdf,
@@ -275,6 +312,11 @@ AGGREGATE_FUNCTIONS: Dict[str, Callable] = {
 # aggregates the TPU sorted kernel executes natively (ops/kernels.py AGG_OPS)
 TPU_AGGREGATES = {"count", "sum", "avg", "min", "max", "stddev", "variance",
                   "first", "last"}
+
+# aggregates served by sketch partials in the partial-pushdown algebra
+# (query/sketches.py): datanodes build per-group sketches, the frontend
+# merges — plus count(DISTINCT x), which rides the same distinct sketch
+SKETCH_AGGREGATES = {"approx_distinct", "approx_percentile", "median"}
 
 
 # ---------------------------------------------------------------------------

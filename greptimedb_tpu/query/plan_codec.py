@@ -97,6 +97,12 @@ def plan_to_dict(plan: TpuPlan) -> dict:
         "field_filters": [{"column": f.column, "op": f.op,
                            "value": f.value}
                           for f in plan.field_filters],
+        # expression-arg moments + sketch finals (ISSUE 14): virtual
+        # moment columns each datanode evaluates from its stored
+        # fields, and per-final literal params (approx_percentile's p)
+        "field_exprs": {k: expr_to_dict(e)
+                        for k, e in plan.field_exprs.items()},
+        "agg_params": {k: list(v) for k, v in plan.agg_params.items()},
     }
 
 
@@ -116,4 +122,12 @@ def plan_from_dict(d: dict) -> TpuPlan:
         tag_predicates=[expr_from_dict(p) for p in d["tag_predicates"]],
         field_filters=[FieldFilter(f["column"], f["op"], f["value"])
                        for f in d["field_filters"]],
+        # .get: a NEW datanode tolerates a pre-sketch frontend's plans.
+        # The reverse direction is NOT degradable — a pre-sketch
+        # datanode would drop field_exprs and fail the scan — so roll
+        # datanodes before frontends when upgrading across this codec
+        field_exprs={k: expr_from_dict(e)
+                     for k, e in (d.get("field_exprs") or {}).items()},
+        agg_params={k: tuple(v)
+                    for k, v in (d.get("agg_params") or {}).items()},
     )

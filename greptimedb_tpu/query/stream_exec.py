@@ -112,19 +112,48 @@ def region_estimated_bytes(region) -> int:
     return region_estimated_rows(region) * width
 
 
+def region_time_span(region) -> int:
+    """Inclusive width of a region's time domain in its native unit,
+    from SST metas + memtable counters alone (no reads) — the bucket-
+    count input of the cost-based scatter planner."""
+    vc = getattr(region, "version_control", None)
+    if vc is None:
+        return 0
+    lo = hi = None
+    v = vc.current
+    for meta in v.ssts.all_files():
+        flo, fhi = meta.time_range
+        lo = flo if lo is None else min(lo, flo)
+        hi = fhi if hi is None else max(hi, fhi)
+    for mt in v.memtables.all_memtables():
+        ms = mt.snapshot()
+        if ms.num_rows:
+            lo = int(ms.ts.min()) if lo is None \
+                else min(lo, int(ms.ts.min()))
+            hi = int(ms.ts.max()) if hi is None \
+                else max(hi, int(ms.ts.max()))
+    return 0 if lo is None else int(hi - lo + 1)
+
+
 def region_stat_entries(regions) -> tuple:
     """(per-region stat dicts, total_rows, total_bytes) for an iterable
     of Region objects — the ONE builder behind both the datanode
     heartbeat's DatanodeStat.region_stats and the standalone
-    cluster_info row, so the two views of region heat cannot diverge."""
+    cluster_info row, so the two views of region heat cannot diverge.
+    `series` (series-dict count) and `time_span` ride along so the
+    frontend's cost-based scatter planner can estimate result
+    cardinality for REMOTE datanodes from the heartbeat alone."""
     entries, total_rows, total_bytes = [], 0, 0
     for region in sorted(regions, key=lambda r: r.name):
         rows = int(region_estimated_rows(region))
         size = int(region_estimated_bytes(region))
+        sd = getattr(region, "series_dict", None)
         total_rows += rows
         total_bytes += size
         entries.append({"region": region.name, "rows": rows,
-                        "size_bytes": size})
+                        "size_bytes": size,
+                        "series": int(getattr(sd, "num_series", 0) or 0),
+                        "time_span": region_time_span(region)})
     return entries, total_rows, total_bytes
 
 
@@ -736,13 +765,22 @@ def _host_partial_frame(data, kept: Optional[np.ndarray], plan, sd,
             buckets[starts] * plan.bucket.stride_ms + plan.bucket.origin
 
     arange = None
+    mcache: Dict[str, tuple] = {}
     for m in plan.moments:
         if m.column is None:             # plain row count
             frame[m.slot] = counts
             continue
-        d, vd = fields[m.column]
+        from .tpu_exec import SKETCH_MOMENT_OPS, moment_input, \
+            sketch_run_column
+        d, vd = moment_input(m, plan, fields, sids, ts, sd, cache=mcache)
         valid = vd if mask is None else (
             mask if vd is None else (vd & mask))
+        if m.op in SKETCH_MOMENT_OPS:
+            # per-run encoded sketch partials (distinct set / t-digest):
+            # the bytes fold downstream through the codec exactly like
+            # numeric moments fold through sums
+            frame[m.slot] = sketch_run_column(m.op, d, valid, starts, n)
+            continue
         if m.op in ("min_ts", "max_ts"):
             tsv = ts if valid is None else np.where(valid, ts, i64max
                                                     if m.op == "min_ts"
@@ -993,8 +1031,8 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
         prof.total_s = _time.perf_counter() - _t_start
         region.last_scan_profile = prof
         return []
-    needed = sorted({m.column for m in plan.moments if m.column is not None}
-                    | {ff.column for ff in plan.field_filters})
+    from .tpu_exec import plan_needs_host, plan_scan_columns
+    needed = plan_scan_columns(plan, schema)
     sd = region.series_dict
 
     # point/IN tag conjuncts resolve to a candidate sid set so every
@@ -1015,6 +1053,10 @@ def stream_region_moment_frames(region, table, plan) -> List[pd.DataFrame]:
                 return []
 
     mode = _COLD_REDUCE[0]
+    if plan_needs_host(plan):
+        # sketch / expression moments have no device kernel: every
+        # slice reduces on the host (same partial-frame algebra)
+        mode = "host"
     sid_keys = mode == "host" and _sid_keyed(plan)
     launched = []
     frames: List[pd.DataFrame] = []

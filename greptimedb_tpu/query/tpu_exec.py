@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
-from ..errors import UnsupportedError
+from ..errors import SketchCodecError, UnsupportedError
 from ..ops.kernels import merge_dedup_numpy, shape_bucket, sorted_grouped_aggregate
 from ..sql.ast import (
     Between, BinaryOp, Column, Expr, FunctionCall, InList, Interval, IsNull,
@@ -34,7 +34,7 @@ from ..sql.ast import (
 )
 from ..common.failpoint import register as _fp_register
 from .expr import Evaluator, expr_name
-from .functions import TPU_AGGREGATES, parse_interval_ms
+from .functions import SKETCH_AGGREGATES, TPU_AGGREGATES, parse_interval_ms
 from .planner import Analysis, _group_slot
 
 _fp_register("scan_cache_incremental")
@@ -554,6 +554,11 @@ class Moment:
     slot: str
 
 
+#: moment ops whose per-run partial is an encoded sketch (bytes), not a
+#: number — built on the host, merged by _finalize through the codec
+SKETCH_MOMENT_OPS = frozenset({"distinct", "tdigest"})
+
+
 @dataclass
 class TpuPlan:
     tag_groups: List[TagGroup]
@@ -564,6 +569,12 @@ class TpuPlan:
     time_hi: Optional[int]
     tag_predicates: List[Expr]
     field_filters: List[FieldFilter]
+    #: arithmetic agg-arg expressions keyed by their moment "column"
+    #: name (expr_name): `sum(a*b)` moments over a virtual column that
+    #: each region evaluates from its stored fields before momenting
+    field_exprs: Dict[str, Expr] = field(default_factory=dict)
+    #: literal extras per final slot (approx_percentile's p)
+    agg_params: Dict[str, tuple] = field(default_factory=dict)
 
     def describe(self) -> str:
         gs = [t.name for t in self.tag_groups]
@@ -571,6 +582,97 @@ class TpuPlan:
             gs.append(f"time_bucket({self.bucket.stride_ms}ms)")
         ops = [f"{op}" for _, op, _ in self.finals]
         return f"groups=[{', '.join(gs)}] aggs=[{', '.join(ops)}]"
+
+
+def plan_needs_host(plan: "TpuPlan") -> bool:
+    """Whether this plan's moments must reduce on the host: sketch
+    partials (distinct/t-digest have no device kernel) and virtual
+    expression columns both do. The partial-frame ALGEBRA is unchanged —
+    host partials fold exactly like device partials."""
+    return bool(plan.field_exprs) or \
+        any(m.op in SKETCH_MOMENT_OPS for m in plan.moments)
+
+
+def plan_scan_columns(plan: "TpuPlan", schema) -> List[str]:
+    """Base STORED columns a region scan must project for this plan:
+    plain moment columns plus every field a virtual expression column
+    references (tags ride the series ids, never the projection)."""
+    tag_names = set(schema.tag_names())
+    cols: set = set()
+    for m in plan.moments:
+        if m.column is None:
+            continue
+        if m.column in plan.field_exprs:
+            cols |= _refs(plan.field_exprs[m.column])
+        elif m.column not in tag_names:
+            cols.add(m.column)
+    cols |= {ff.column for ff in plan.field_filters}
+    return sorted(cols)
+
+
+def moment_input(m: Moment, plan: TpuPlan, fields: Dict, sids, ts, sd,
+                 cache: Optional[dict] = None):
+    """(values, validity) for one moment's input: a stored field, the
+    time index, a tag column (decoded per row), or a registered
+    arithmetic expression evaluated over the stored fields — the ONE
+    resolution both host reducers share, so streamed, resident and
+    indexed partials cannot disagree about what `sum(a*b)` means."""
+    col = m.column
+    if cache is not None and col in cache:
+        return cache[col]
+    if col in plan.field_exprs:
+        base = {}
+        for name in sorted(_refs(plan.field_exprs[col])):
+            d, vd = fields[name]
+            if d.dtype == object:
+                raise UnsupportedError(
+                    f"expression aggregate over non-numeric {name!r}")
+            arr = d.astype(np.float64, copy=vd is not None)
+            if vd is not None:
+                arr[~vd] = np.nan        # pandas null convention, so the
+            base[name] = arr             # expr semantics == the fallback
+        ev = Evaluator(pd.DataFrame(base))
+        v = ev.eval(plan.field_exprs[col])
+        vals = v.to_numpy(dtype=np.float64) if isinstance(v, pd.Series) \
+            else np.asarray(v, dtype=np.float64)
+        if vals.ndim == 0:
+            vals = np.full(len(ts), float(vals))
+        valid = ~np.isnan(vals)
+        out = (vals, None if valid.all() else valid)
+    elif col in fields:
+        out = fields[col]
+    elif sd is not None and col in tuple(getattr(sd, "tag_names", ())):
+        idx = tuple(sd.tag_names).index(col)
+        out = (sd.decode_tag_column(np.asarray(sids, dtype=np.int32),
+                                    idx), None)
+    else:
+        out = (ts, None)                 # the time index
+    if cache is not None:
+        cache[col] = out
+    return out
+
+
+def sketch_run_column(op: str, vals: np.ndarray,
+                      valid: Optional[np.ndarray],
+                      starts: np.ndarray, n: int) -> np.ndarray:
+    """Encoded sketch partial per run: object column of codec frames,
+    one per (sid [, bucket]) run — the sketch twin of a reduceat."""
+    from .sketches import DistinctSketch, TDigest, encode_sketch
+    ends = np.append(starts[1:], n)
+    out = np.empty(len(starts), dtype=object)
+    for i in range(len(starts)):
+        seg = slice(int(starts[i]), int(ends[i]))
+        v = vals[seg]
+        if valid is not None:
+            v = v[valid[seg]]
+        if op == "distinct":
+            sk = DistinctSketch.from_values(v)
+        else:
+            sk = TDigest.from_values(np.asarray(v, dtype=np.float64)) \
+                if v.dtype != object else TDigest.from_values(
+                    np.asarray(list(v), dtype=np.float64))
+        out[i] = encode_sketch(sk)
+    return out
 
 
 def _conjuncts(e: Optional[Expr]) -> List[Expr]:
@@ -596,6 +698,34 @@ def _literal_num(e: Expr):
         v = _literal_num(e.operand)
         return -v if v is not None else None
     return None
+
+
+_ARITH_OPS = frozenset({"+", "-", "*", "/"})
+
+
+def _is_expr_arg(e: Expr, field_names: set, schema) -> bool:
+    """Arithmetic over numeric FIELD columns and numeric literals, with
+    at least one operator — the agg-argument shapes each region can
+    evaluate into a virtual moment column (`sum(a*b)`, `avg(a/b)`)."""
+    if not isinstance(e, (BinaryOp, UnaryOp)):
+        return False
+
+    def ok(x: Expr) -> bool:
+        if isinstance(x, Column):
+            if x.name not in field_names:
+                return False
+            cs = schema.column_schema(x.name)
+            return not (cs.dtype.is_string or cs.dtype.is_binary)
+        if isinstance(x, Literal):
+            return isinstance(x.value, (int, float)) and \
+                not isinstance(x.value, bool)
+        if isinstance(x, UnaryOp):
+            return x.op == "-" and ok(x.operand)
+        if isinstance(x, BinaryOp):
+            return x.op in _ARITH_OPS and ok(x.left) and ok(x.right)
+        return False
+
+    return ok(e)
 
 
 def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
@@ -628,8 +758,17 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
         return None
 
     # aggregates → moments
+    from .sketches import exact_distinct_forced
+    is_pushdown = hasattr(table, "execute_tpu_plan")
+    if is_pushdown and not _PARTIAL_PUSHDOWN[0]:
+        # SET dist_partial_agg = 0: no pushdown PLAN at all, so EXPLAIN
+        # (CpuAggregateExec) and execution (raw-row scatter + CPU
+        # fallback) render the same decision
+        return None
     moments: List[Moment] = []
     finals: List[Tuple[str, str, List[str]]] = []
+    field_exprs: Dict[str, Expr] = {}
+    agg_params: Dict[str, tuple] = {}
     seen: Dict[tuple, str] = {}
 
     def moment(op: str, column: Optional[str]) -> str:
@@ -642,26 +781,63 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
         return slot
 
     for call in a.agg_calls:
-        if call.distinct or call.op not in TPU_AGGREGATES:
+        op = call.op
+        if op not in TPU_AGGREGATES and op not in SKETCH_AGGREGATES:
+            return None
+        if call.distinct and (op != "count" or not is_pushdown or
+                              exact_distinct_forced()):
+            # distinct rides the sketch partial only where it pays — the
+            # distributed pushdown (a standalone table keeps the exact
+            # fallback), and never under SET exact_distinct = 1
             return None
         if call.arg is None:
-            if call.op != "count":
+            if op != "count" or call.distinct:
                 return None
             finals.append((call.slot, "count", [moment("count", None)]))
             continue
-        if not isinstance(call.arg, Column):
-            return None
-        col = call.arg.name
-        if col == (tc.name if tc else None):
-            col_kind = "ts"
-        elif col in field_names:
-            col_kind = "field"
+        # distinct sketches take any value type (sets of strings are
+        # sets); everything else needs numbers
+        sketchy = call.distinct or op == "approx_distinct"
+        if isinstance(call.arg, Column):
+            col = call.arg.name
+            if col == (tc.name if tc else None):
+                pass                            # the time index
+            elif col in field_names:
+                cs = schema.column_schema(col)
+                if (cs.dtype.is_string or cs.dtype.is_binary) and \
+                        op != "count" and not sketchy:
+                    return None
+            elif col in tag_names and sketchy:
+                pass          # distinct over a tag: decoded per series
+            else:
+                return None
         else:
-            return None
-        cs = schema.column_schema(col)
-        if (cs.dtype.is_string or cs.dtype.is_binary) and call.op != "count":
-            return None
-        op = call.op
+            if not _is_expr_arg(call.arg, field_names, schema):
+                return None
+            col = expr_name(call.arg)
+            field_exprs[col] = call.arg
+        if call.distinct:                       # count(DISTINCT x)
+            finals.append((call.slot, "count_distinct",
+                           [moment("distinct", col)]))
+            continue
+        if op == "approx_distinct":
+            finals.append((call.slot, "approx_distinct",
+                           [moment("distinct", col)]))
+            continue
+        if op in ("approx_percentile", "median"):
+            if op == "approx_percentile":
+                if len(call.params) != 1 or \
+                        not isinstance(call.params[0], (int, float)) or \
+                        isinstance(call.params[0], bool) or \
+                        not 0 <= float(call.params[0]) <= 100:
+                    return None     # the fallback raises the typed error
+                p = float(call.params[0])
+            else:
+                p = 50.0
+            finals.append((call.slot, "approx_percentile",
+                           [moment("tdigest", col)]))
+            agg_params[call.slot] = (p,)
+            continue
         if op == "count":
             finals.append((call.slot, "count", [moment("count", col)]))
         elif op == "sum":
@@ -709,7 +885,7 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
         field_filters.append(ff)
 
     return TpuPlan(tag_groups, bucket, moments, finals, time_lo, time_hi,
-                   tag_predicates, field_filters)
+                   tag_predicates, field_filters, field_exprs, agg_params)
 
 
 def _match_bucket(e: Expr, ts_name: Optional[str]) -> Optional[BucketGroup]:
@@ -915,6 +1091,17 @@ def cached_table_frame(table) -> Optional[pd.DataFrame]:
         pd.concat(frames, ignore_index=True)
 
 
+#: SET dist_partial_agg — kill switch for the distributed partial
+#: pushdown: 0 routes aggregate statements over DistTables through the
+#: raw-row scatter instead (the bench differential + ops escape hatch)
+_PARTIAL_PUSHDOWN = [_env_flag("GREPTIME_DIST_PARTIAL_AGG", True)]
+
+
+def configure_partial_pushdown(*, enabled: Optional[bool] = None) -> None:
+    if enabled is not None:
+        _PARTIAL_PUSHDOWN[0] = bool(enabled)
+
+
 def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     from ..common import exec_stats
     from ..common.telemetry import span, timer
@@ -961,14 +1148,62 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
             return pd.DataFrame(columns=cols +
                                 [slot for slot, _, _ in plan.finals])
         # global aggregate over zero rows still yields one row
-        row = {slot: (0 if op == "count" else np.nan)
+        row = {slot: (0 if op in ("count", "count_distinct",
+                                  "approx_distinct") else np.nan)
                for slot, op, _ in plan.finals}
         return pd.DataFrame([row])
-    with exec_stats.stage("finalize", partial_frames=len(frames)):
+    with exec_stats.stage("finalize", partial_frames=len(frames),
+                          partial_bytes=frames_nbytes(frames),
+                          aggs=_aggs_desc(plan)):
         merged = pd.concat(frames, ignore_index=True)
-        out = _finalize(merged, plan)
+        try:
+            out = _finalize(merged, plan)
+        except SketchCodecError as e:
+            # a corrupt/truncated sketch partial must NEVER become a
+            # wrong answer: count the degrade and fall back to the
+            # raw-row path (the engine re-plans this statement as a
+            # plain scan + CPU aggregate)
+            import logging
+            from ..common.telemetry import increment_counter
+            increment_counter("sketch_degrade")
+            exec_stats.record("sketch_degrade", error=str(e)[:120])
+            logging.getLogger(__name__).warning(
+                "sketch partial failed to decode (%s); retrying %s via "
+                "the raw-row path", e, table.name)
+            return None
     exec_stats.record("finalize", rows=len(out))
     return out
+
+
+#: finals whose result comes out of a sketch partial, not a numeric fold
+_SKETCH_FINAL_OPS = frozenset({"count_distinct", "approx_distinct",
+                               "approx_percentile"})
+
+
+def _aggs_desc(plan: TpuPlan) -> str:
+    """sketch-vs-exact per aggregate, for the finalize stage detail."""
+    return ",".join(
+        f"{op}:{'sketch' if op in _SKETCH_FINAL_OPS else 'exact'}"
+        for _, op, _ in plan.finals)
+
+
+def frames_nbytes(frames) -> int:
+    """Byte size of partial moment frames — numeric columns by their
+    array width, sketch columns by their encoded frame lengths. This is
+    the number the wire pays (the IPC framing adds low single-digit %),
+    so EXPLAIN ANALYZE's partial_bytes and the bench's wire-byte
+    comparison measure the same thing for local and Flight datanodes."""
+    total = 0
+    for f in frames:
+        for col in f.columns:
+            s = f[col]
+            if s.dtype == object:
+                total += int(sum(
+                    len(v) if isinstance(v, (bytes, bytearray, str))
+                    else 8 for v in s))
+            else:
+                total += int(s.to_numpy().nbytes)
+    return total
 
 
 def dispatch_decision_for_pushdown(table, plan) -> str:
@@ -1003,25 +1238,30 @@ def local_dispatch_decision(table, cold=None, regions=None, plan=None,
     if point_sids is None:
         point_sids = [region_point_sids(r, plan) for r in regions] \
             if plan is not None else [None] * len(regions)
+    # sketch / expression moments reduce on the host wherever the rows
+    # come from — the suffix keeps EXPLAIN honest about the kernel
+    suffix = "; host-partial moments (sketch/expr)" \
+        if plan is not None and plan_needs_host(plan) else ""
     n_idx = sum(1 for s in point_sids if s is not None)
     if regions and n_idx == len(regions):
         k = max((len(s) for s in point_sids if s is not None), default=0)
         return (f"indexed-point (sst index, {k} candidate series; "
-                f"bloom/sid-summary file pruning)")
+                f"bloom/sid-summary file pruning{suffix})")
     if cold is None:
         cold = [region_streams_cold(r) for r in regions]
     n_stream = sum(1 for c, s in zip(cold, point_sids)
                    if c and s is None)
     if n_idx:
         return (f"mixed ({n_idx}/{len(regions)} regions indexed-point, "
-                f"{n_stream} streamed-cold)")
+                f"{n_stream} streamed-cold{suffix})")
     if n_stream == 0:
-        return "device-resident (scan cache)"
+        return f"device-resident (scan cache{suffix})"
     if n_stream == len(regions):
         return (f"streamed-cold (est_rows={_estimated_table_rows(table)}, "
                 f"stream_threshold_rows="
-                f"{stream_exec.stream_threshold_rows()})")
-    return f"mixed ({n_stream}/{len(regions)} regions streamed-cold)"
+                f"{stream_exec.stream_threshold_rows()}{suffix})")
+    return (f"mixed ({n_stream}/{len(regions)} regions "
+            f"streamed-cold{suffix})")
 
 
 def region_point_sids(region, plan) -> Optional[np.ndarray]:
@@ -1082,9 +1322,7 @@ def _indexed_point_frames(region, table, plan: "TpuPlan",
                            plan.time_hi is not None):
         trange = TimestampRange(plan.time_lo, plan.time_hi,
                                 tc.dtype.time_unit)
-    needed = sorted({m.column for m in plan.moments
-                     if m.column is not None}
-                    | {ff.column for ff in plan.field_filters})
+    needed = plan_scan_columns(plan, schema)
     data = snap.scan(projection=needed, time_range=trange, sid_set=sids)
     prof.rows = data.num_rows
     prof.bump("candidate_sids", len(sids))
@@ -1159,7 +1397,7 @@ def region_moment_frames(table, plan: TpuPlan,
     cold = [False if s is not None else region_streams_cold(r)
             for r, s in zip(regions, point_sids)]
     exec_stats.set_dispatch(local_dispatch_decision(
-        table, cold, regions, point_sids=point_sids))
+        table, cold, regions, plan=plan, point_sids=point_sids))
     frames = []
     from ..common import process_list
     for region, streams, sids in zip(regions, cold, point_sids):
@@ -1234,6 +1472,13 @@ class _Launched:
 
 def _moment_frame_for_scan(scan: MergedScan, schema,
                            plan: TpuPlan) -> Optional[pd.DataFrame]:
+    if plan_needs_host(plan):
+        # sketch / expression moments: reduce the resident merged scan
+        # on the host with the same segment arithmetic the streamed
+        # path uses — MergedScan rows are already sorted + MVCC-deduped,
+        # so the partial frame folds like any other
+        from .stream_exec import _host_partial_frame
+        return _host_partial_frame(scan, None, plan, scan.series_dict)
     import jax
     launched = _launch_scan_kernel(scan, schema, plan)
     if launched is None:
@@ -1451,6 +1696,24 @@ def _collect_moment_frame(launched: _Launched, plan: TpuPlan,
     return df
 
 
+def _nan_if_none(v):
+    return np.nan if v is None else v
+
+
+def _merge_sketch_cells(cells) -> Optional[bytes]:
+    """Fold encoded sketch partials (bytes) into ONE re-encoded partial.
+    Decode errors raise SketchCodecError — try_execute degrades the
+    statement to the raw-row path rather than answer wrong."""
+    from .sketches import decode_sketch, encode_sketch
+    merged = None
+    for c in cells:
+        if c is None or (isinstance(c, float) and np.isnan(c)):
+            continue
+        sk = decode_sketch(c)
+        merged = sk if merged is None else merged.merge(sk)
+    return None if merged is None else encode_sketch(merged)
+
+
 def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
     key_cols = [_group_slot(t.name) for t in plan.tag_groups]
     if plan.bucket is not None:
@@ -1466,7 +1729,9 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
         out = {}
         for slot, m in moment_cols.items():
             v = group[slot]
-            if m.op in ("sum", "sum_sq", "count"):
+            if m.op in SKETCH_MOMENT_OPS:
+                out[slot] = _merge_sketch_cells(v)
+            elif m.op in ("sum", "sum_sq", "count"):
                 out[slot] = v.sum()
             elif m.op in ("min", "min_ts"):
                 out[slot] = v.min()
@@ -1494,8 +1759,11 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
             gb = df.groupby(key_cols, dropna=False, sort=False)
             aggs = {}
             extremes = []
+            sketches = []
             for slot, m in moment_cols.items():
-                if m.op in ("sum", "sum_sq", "count"):
+                if m.op in SKETCH_MOMENT_OPS:
+                    sketches.append(slot)
+                elif m.op in ("sum", "sum_sq", "count"):
                     aggs[slot] = "sum"
                 elif m.op in ("min", "min_ts"):
                     aggs[slot] = "min"
@@ -1503,7 +1771,8 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
                     aggs[slot] = "max"
                 else:
                     extremes.append((slot, m))
-            merged = gb.agg(aggs)
+            aggs["__rowcount"] = "sum"      # a plan of only sketch
+            merged = gb.agg(aggs)           # moments still needs keys
             for slot, m in extremes:
                 # groupby.first()/.last() take the first/last NON-NULL
                 # value in frame order; sorting by the companion ts makes
@@ -1513,6 +1782,10 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
                 srt = df.sort_values(ts_slot, kind="stable")
                 gs = srt.groupby(key_cols, dropna=False, sort=False)[slot]
                 merged[slot] = gs.first() if m.op == "first" else gs.last()
+            for slot in sketches:
+                # fold encoded partials per group through the codec
+                # (bytes in, bytes out — pandas treats bytes as scalars)
+                merged[slot] = gb[slot].agg(_merge_sketch_cells)
             merged = merged.reset_index()
         else:
             merged = df
@@ -1527,6 +1800,18 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
             out[slot] = merged[mslots[0]]
         elif op == "count":
             out[slot] = merged[mslots[0]].astype(np.int64)
+        elif op in ("count_distinct", "approx_distinct"):
+            from .sketches import decode_sketch
+            out[slot] = merged[mslots[0]].map(
+                lambda b: 0 if b is None
+                else decode_sketch(b).result()).astype(np.int64)
+        elif op == "approx_percentile":
+            from .sketches import decode_sketch
+            p = plan.agg_params.get(slot, (50.0,))[0]
+            out[slot] = merged[mslots[0]].map(
+                lambda b: np.nan if b is None
+                else _nan_if_none(decode_sketch(b).quantile(p))
+            ).astype(np.float64)
         elif op == "avg":
             s, c = merged[mslots[0]], merged[mslots[1]]
             out[slot] = np.where(c > 0, s / np.maximum(c, 1), np.nan)

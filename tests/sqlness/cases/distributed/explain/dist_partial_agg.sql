@@ -1,0 +1,59 @@
+-- Distributed aggregation v2 goldens (ISSUE 14): count(DISTINCT),
+-- approx_distinct / approx_percentile / median and expression agg
+-- arguments push SKETCH/moment partials down to the datanodes, and the
+-- cost-based scatter planner renders its choice (with row estimates)
+-- identically in EXPLAIN and EXPLAIN ANALYZE.
+
+CREATE TABLE dpa (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    n BIGINT,
+    PRIMARY KEY(host)
+)
+PARTITION BY HASH (host) PARTITIONS 8;
+
+INSERT INTO dpa VALUES
+    ('h1', 1000, 1.0, 1),
+    ('h1', 2000, 2.0, 1),
+    ('h1', 3000, 2.0, 2),
+    ('h1', 4000, NULL, 2),
+    ('h2', 1000, 5.0, 3),
+    ('h2', 2000, NULL, 3),
+    ('h3', 4000, 7.5, 4);
+
+-- exact-set distinct partials: small per-group sets stay EXACT
+SELECT host, count(DISTINCT v) AS cd, count(DISTINCT n) AS cn
+FROM dpa GROUP BY host ORDER BY host;
+
+EXPLAIN SELECT host, count(DISTINCT v) AS cd FROM dpa GROUP BY host;
+
+-- expression agg arguments moment per-region before folding
+SELECT host, sum(v * 2) AS s, avg(v + n) AS av
+FROM dpa GROUP BY host ORDER BY host;
+
+-- the approx family (documented bounds; tiny sets are exact)
+SELECT host, approx_distinct(v) AS ad, approx_percentile(v, 50) AS p50
+FROM dpa GROUP BY host ORDER BY host;
+
+SELECT median(v) AS m FROM dpa;
+
+-- SET exact_distinct = 1 refuses the sketch path: raw rows, exact at
+-- any cardinality
+SET exact_distinct = 1;
+
+EXPLAIN SELECT host, count(DISTINCT v) AS cd FROM dpa GROUP BY host;
+
+SET exact_distinct = 0;
+
+-- EXPLAIN ANALYZE: the finalize stage reports partial frames, partial
+-- wire bytes, and sketch-vs-exact per aggregate
+EXPLAIN ANALYZE SELECT host, count(DISTINCT v) AS cd, sum(v) AS s
+FROM dpa GROUP BY host;
+
+-- approx aggregates cannot materialize into a flow sink (hint, like avg)
+CREATE FLOW bad_flow AS SELECT host,
+    date_bin(INTERVAL '1 minute', ts) AS tb, approx_distinct(v) AS d
+FROM dpa GROUP BY host, tb;
+
+DROP TABLE dpa;

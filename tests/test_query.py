@@ -259,13 +259,29 @@ class TestTpuPath:
         for sql in [
             "SELECT host, percentile(cpu, 50) FROM monitor GROUP BY host",
             "SELECT ts % 2, count(*) FROM monitor GROUP BY 1",
-            "SELECT host, avg(cpu + 1) FROM monitor GROUP BY host",
+            "SELECT host, avg(abs(cpu)) FROM monitor GROUP BY host",
+            # distinct sketches only pay on the distributed pushdown; a
+            # LOCAL table keeps the exact fallback (ISSUE 14)
             "SELECT host, count(DISTINCT region) FROM monitor GROUP BY host",
         ]:
             stmt = parse_sql(sql)
             a = __import__("greptimedb_tpu.query.planner",
                            fromlist=["analyze"]).analyze(stmt)
             assert tpu_exec.plan_for(table, a, stmt) is None, sql
+
+    def test_plan_accepts_expression_args(self, world):
+        """ISSUE 14: arithmetic agg arguments plan as virtual expression
+        moments instead of falling back."""
+        engine, table, _ = world
+        for sql in [
+            "SELECT host, avg(cpu + 1) FROM monitor GROUP BY host",
+            "SELECT host, sum(cpu * mem) FROM monitor GROUP BY host",
+        ]:
+            stmt = parse_sql(sql)
+            a = __import__("greptimedb_tpu.query.planner",
+                           fromlist=["analyze"]).analyze(stmt)
+            plan = tpu_exec.plan_for(table, a, stmt)
+            assert plan is not None and plan.field_exprs, sql
 
 
 class TestShow:

@@ -248,6 +248,9 @@ class TestDistributedHeat:
         rows = srv.region_heat(now=t0 + 2)
         assert rows == [{"node": "dn1", "region": "7_0000000000",
                          "rows": 3000, "size_bytes": 8192,
+                         # cost-planner inputs ride the heat rows since
+                         # ISSUE 14; zero for a beat that omits them
+                         "series": 0, "time_span": 0,
                          "ingest_rate_rps": 1000.0}]
 
     def test_dead_node_rate_zeroes(self):
