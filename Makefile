@@ -4,12 +4,12 @@
 PY ?= python
 
 .PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
-	bench-index bench-distagg
+	bench-index bench-distagg bench-trace
 
 check: lint typecheck test
 
-# greptlint: project-invariant static analyzer (rules GL01-GL12;
-# GL10-GL12 are interprocedural over the repo-wide call graph).
+# greptlint: project-invariant static analyzer (rules GL01-GL13;
+# GL10-GL13 are interprocedural over the repo-wide call graph).
 # Exit 0 requires a clean scan modulo .greptlint-baseline.json.
 lint:
 	$(PY) -m greptimedb_tpu.devtools.greptlint greptimedb_tpu/
@@ -62,6 +62,12 @@ bench-qps:
 # on vs `SET sst_index = 0` (asserts the >=3x differential)
 bench-index:
 	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=index $(PY) bench.py
+
+# only the ISSUE 15 metric: bulk-ingest + point-query differential with
+# the durable trace store's sink at sample ratio 1.0 / 0.01 vs off
+# (asserts <3% overhead at the default 0.01 ratio)
+bench-trace:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=trace $(PY) bench.py
 
 # only the ISSUE 14 metric: 4-datanode GROUP BY with
 # count/count-distinct/p95 through the sketch partial pushdown vs the

@@ -111,6 +111,33 @@ _SELF_MONITOR_SCHEMA = Schema([
     ColumnSchema("last_error", dt.STRING, nullable=True),
 ])
 
+_TRACE_SPANS_SCHEMA = Schema([
+    ColumnSchema("trace_id", dt.STRING),
+    ColumnSchema("span_id", dt.STRING),
+    ColumnSchema("parent_span_id", dt.STRING, nullable=True),
+    ColumnSchema("node", dt.STRING),
+    ColumnSchema("service", dt.STRING),
+    ColumnSchema("span_name", dt.STRING),
+    ColumnSchema("ts", dt.INT64),
+    ColumnSchema("duration_ms", dt.FLOAT64),
+    ColumnSchema("status", dt.STRING),
+    ColumnSchema("attrs", dt.STRING, nullable=True),
+])
+
+_BACKGROUND_JOBS_SCHEMA = Schema([
+    ColumnSchema("job_id", dt.INT64),
+    ColumnSchema("kind", dt.STRING),
+    ColumnSchema("table_name", dt.STRING, nullable=True),
+    ColumnSchema("region", dt.STRING, nullable=True),
+    ColumnSchema("node", dt.STRING),
+    ColumnSchema("state", dt.STRING),
+    ColumnSchema("trace_id", dt.STRING),
+    ColumnSchema("start_ms", dt.INT64),
+    ColumnSchema("duration_ms", dt.FLOAT64, nullable=True),
+    ColumnSchema("error", dt.STRING, nullable=True),
+    ColumnSchema("detail", dt.STRING, nullable=True),
+])
+
 _FLOWS_SCHEMA = Schema([
     ColumnSchema("flow_name", dt.STRING),
     ColumnSchema("source_table", dt.STRING),
@@ -432,6 +459,89 @@ def information_schema_table(catalog_manager, catalog_name: str,
             return rows
         return _VirtualTable("self_monitor", _SELF_MONITOR_SCHEMA,
                              build_self_monitor)
+    if name == "trace_spans":
+        def build_trace_spans():
+            # a SQL view over the DURABLE store: ping the datanodes
+            # (the ordinary RPC piggyback releases freshly-verdicted
+            # buffered spans — same sequence as ADMIN SHOW TRACE) and
+            # flush the sink first, so "the query just finished" reads
+            # see their spans cluster-wide, then serve the
+            # greptime_private.trace_spans rows
+            from ..common import trace_store
+            sink = trace_store.sink()
+            clients = getattr(catalog_manager, "dist_clients", None)
+            for client in (dict(clients).values() if clients else ()):
+                ping = getattr(client, "ping", None)
+                if ping is None:
+                    continue
+                try:
+                    ping()
+                except Exception as e:  # noqa: BLE001 — degrade to
+                    import logging      # what the store already holds
+                    logging.getLogger(__name__).debug(
+                        "trace_spans: span-sync ping failed: %s", e)
+            if sink is not None:
+                sink.flush()
+            rows = {k: [] for k in _TRACE_SPANS_SCHEMA.names()}
+            table = catalog_manager.table(
+                catalog_name, trace_store.PRIVATE_SCHEMA,
+                trace_store.TRACE_SPANS_TABLE)
+            if table is None:
+                return rows
+            for b in table.scan_batches():
+                d = b.to_pydict()
+                n = len(d.get("trace_id", []))
+                for k in rows:
+                    col = d.get(k)
+                    rows[k].extend(col if col is not None
+                                   else [None] * n)
+            return rows
+        return _VirtualTable("trace_spans", _TRACE_SPANS_SCHEMA,
+                             build_trace_spans)
+    if name == "background_jobs":
+        def build_background_jobs():
+            from ..common import background_jobs
+            # local registry first, then every reachable datanode's (a
+            # dist frontend pins `dist_clients`); dedup by
+            # (node, job_id) — an in-process cluster shares one
+            # process-wide registry, so the fan-out re-reads it
+            merged = {}
+            for r in background_jobs.rows():
+                merged[(r.get("node"), r.get("job_id"))] = r
+            clients = getattr(catalog_manager, "dist_clients", None)
+            peers = list(dict(clients).values()) if clients else []
+            # the metasrv runs the balancer: its op-step jobs live in
+            # ITS registry (advisory() bounds a failover client to one
+            # quick pass, the cluster_info precedent)
+            meta = getattr(catalog_manager, "meta_client", None)
+            if meta is not None and hasattr(meta, "background_jobs"):
+                peers.append(meta.advisory() if hasattr(meta, "advisory")
+                             else meta)
+            for client in peers:
+                fetch = getattr(client, "background_jobs", None)
+                if fetch is None:
+                    continue
+                try:
+                    for r in fetch():
+                        merged.setdefault(
+                            (r.get("node"), r.get("job_id")), r)
+                except Exception:  # noqa: BLE001 — a dead peer
+                    import logging      # degrades, never 500s the view
+                    logging.getLogger(__name__).debug(
+                        "background_jobs: peer unreachable",
+                        exc_info=True)
+            ordered = sorted(
+                merged.values(),
+                key=lambda r: (r.get("state") != "running",
+                               str(r.get("node")),
+                               -(r.get("job_id") or 0)))
+            rows = {k: [] for k in _BACKGROUND_JOBS_SCHEMA.names()}
+            for r in ordered:
+                for k in rows:
+                    rows[k].append(r.get(k))
+            return rows
+        return _VirtualTable("background_jobs", _BACKGROUND_JOBS_SCHEMA,
+                             build_background_jobs)
     if name == "runtime_metrics":
         def build_metrics():
             families = _collect_families()

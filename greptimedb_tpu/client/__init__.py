@@ -142,3 +142,13 @@ class LocalDatanodeClient(DatanodeClient):
         if t is None:
             return None
         return t.info, getattr(t, "partition_rule", None)
+
+    def ping(self) -> int:
+        return self.node_id
+
+    def background_jobs(self) -> list:
+        """In-process twin of the Flight action. The registry is
+        process-wide, so for an in-process cluster these rows duplicate
+        the frontend's own — the view dedups by (node, job_id)."""
+        from ..common import background_jobs
+        return background_jobs.rows()

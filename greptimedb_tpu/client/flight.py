@@ -31,21 +31,51 @@ from . import DatanodeClient
 
 def _traced(body: dict) -> dict:
     """Attach the caller's W3C trace context so the server joins this
-    trace (servers pop the key before dispatching)."""
+    trace (servers pop the key before dispatching) — plus, from a
+    verdict-deciding (root) trace sink, the recent tail-sampling
+    verdicts: datanodes buffer spans blind, and the verdicts piggyback
+    on whatever RPC happens next (released spans ride its response)."""
+    from ..common import trace_store
     tp = current_traceparent()
-    return {**body, "traceparent": tp} if tp is not None else body
+    out = {**body, "traceparent": tp} if tp is not None else body
+    sink = trace_store.sink()
+    if sink is not None and sink.role == "root":
+        verdicts = sink.recent_verdicts()
+        if verdicts:
+            out = dict(out)
+            out[trace_store.TRACE_VERDICTS_BODY_KEY] = verdicts
+    return out
+
+
+def _absorb_wire_spans(rows) -> None:
+    """Buffered datanode spans released by a piggybacked verdict: queue
+    them on the local (root) sink for the next trace-store flush."""
+    if not rows:
+        return
+    from ..common import trace_store
+    sink = trace_store.sink()
+    if sink is not None and isinstance(rows, list):
+        sink.absorb_spans(rows)
 
 
 def _absorb_stream_stats(schema: pa.Schema) -> None:
     """Replay datanode-side ExecStats riding the stream schema into the
-    active collector (the per-RPC node sub-collector during a scatter)."""
-    raw = (schema.metadata or {}).get(exec_stats.EXEC_STATS_WIRE_KEY)
-    if not raw:
-        return
-    try:
-        exec_stats.absorb_remote(json.loads(raw))
-    except (ValueError, TypeError, KeyError):
-        pass                 # stats are advisory; never fail a read
+    active collector (the per-RPC node sub-collector during a scatter),
+    and absorb any trace spans the datanode's sink released."""
+    meta = schema.metadata or {}
+    raw = meta.get(exec_stats.EXEC_STATS_WIRE_KEY)
+    if raw:
+        try:
+            exec_stats.absorb_remote(json.loads(raw))
+        except (ValueError, TypeError, KeyError):
+            pass             # stats are advisory; never fail a read
+    from ..common import trace_store
+    raw_spans = meta.get(trace_store.TRACE_SPANS_WIRE_KEY)
+    if raw_spans:
+        try:
+            _absorb_wire_spans(json.loads(raw_spans))
+        except (ValueError, TypeError):
+            pass             # spans are advisory too
 
 
 def _columns_to_arrow(columns: Dict[str, Sequence]) -> pa.Table:
@@ -98,6 +128,7 @@ class _FlightBase:
             resp = json.loads(results[0].body.to_pybytes())
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
+        _absorb_wire_spans(resp.pop("trace_spans", None))
         if not resp.get("ok", False):
             err = resp.get("error", "unknown flight error")
             if resp.get("error_type") == "TableNotFoundError":
@@ -123,6 +154,7 @@ class _FlightBase:
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
         meta = json.loads(buf.to_pybytes()) if buf is not None else {}
+        _absorb_wire_spans(meta.pop("trace_spans", None))
         if meta.get("exec_stats"):
             try:
                 exec_stats.absorb_remote(meta["exec_stats"])
@@ -241,6 +273,11 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
 
     def ping(self) -> int:
         return int(self._action("ping", {})["node_id"])
+
+    def background_jobs(self) -> list:
+        """This datanode's live + recent background jobs (the
+        cluster-merged information_schema.background_jobs view)."""
+        return list(self._action("background_jobs", {}).get("jobs", []))
 
 
 class Database(_FlightBase):

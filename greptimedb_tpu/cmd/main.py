@@ -202,6 +202,14 @@ def metasrv_start(args) -> None:
     from ..meta.kv import FileKv, MemKv
 
     init_logging(args.log_level or "info")
+    from ..common import background_jobs, trace_store
+    background_jobs.configure_node("metasrv")
+    # buffer-role sink: balancer-op traces root HERE and verdict
+    # locally (always retained — the balancer tail rule); retained
+    # spans ride home on the next meta RPC's response and the caller
+    # writes them into greptime_private.trace_spans
+    trace_store.install(trace_store.TraceSink(
+        node_label="metasrv", service="metasrv", role="buffer"))
     raft_node = None
     if args.peers:
         # replicated meta: --peers is the FULL replica set (including
@@ -301,6 +309,16 @@ def datanode_start(args) -> None:
 
     init_logging(args.log_level or "info")
     enable_compile_cache(args.data_home or "./greptimedb_data")
+    # buffer-role trace sink: this process cannot decide tail-sampling
+    # verdicts (it sees only its fragments of each trace) and cannot
+    # write trace_spans — it buffers spans keyed by trace_id until the
+    # frontend's verdict piggybacks on a later RPC, then ships released
+    # spans home on that RPC's response (TTL evicts the unclaimed)
+    from ..common import background_jobs, trace_store
+    label = f"dn{args.node_id}"
+    background_jobs.configure_node(label)
+    trace_store.install(trace_store.TraceSink(
+        node_label=label, service="datanode", role="buffer"))
     dn = DatanodeInstance(DatanodeOptions(
         data_home=args.data_home or "./greptimedb_data",
         node_id=args.node_id, register_numbers_table=False))

@@ -114,22 +114,74 @@ def span(name: str, **attrs: object) -> Iterator[Dict]:
         "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex,
         "span_id": uuid.uuid4().hex[:16],
         "parent_id": parent["span_id"] if parent else None,
+        # a parent installed by remote_context() means the trace ROOT
+        # lives in another process — the trace sink's tail-sampling
+        # verdict logic keys off this (a frontend decides for traces an
+        # external client rooted; a datanode buffers them)
+        "remote_parent": bool(parent
+                              and (parent.get("attrs") or {}).get("remote")),
         "attrs": attrs,
         "start": time.perf_counter(),
         "start_unix_ns": time.time_ns(),
     }
     stack.append(s)
+    status = "ok"
     try:
         yield s
+    except BaseException as e:  # greptlint: disable=GL02 — classified,
+        status = _exc_status(e)  # re-raised untouched
+        raise
     finally:
         stack.pop()
         elapsed_ms = (time.perf_counter() - s["start"]) * 1e3
         logger.debug("span %s finished in %.2fms attrs=%s", name,
                      elapsed_ms, attrs)
         _observe(f"span_{name}", elapsed_ms / 1e3)
-        exporter = _OTLP[0]
-        if exporter is not None and not metrics_suppressed():
-            exporter.enqueue(s, int(elapsed_ms * 1e6))
+        if not metrics_suppressed():
+            exporter = _OTLP[0]
+            if exporter is not None:
+                exporter.enqueue(s, int(elapsed_ms * 1e6))
+            sink = _SPAN_SINK[0]
+            if sink is not None:
+                try:
+                    sink.on_span_end(s, elapsed_ms, status)
+                except Exception:  # noqa: BLE001 — the sink must never
+                    logger.exception(    # break the traced path
+                        "trace sink rejected span %s", name)
+
+
+def _exc_status(e: BaseException) -> str:
+    """Span status for an exception crossing the span boundary: KILLed
+    statements read as 'cancelled' (they are tail-retained like errors,
+    but an operator filters them apart)."""
+    from ..errors import QueryCancelledError
+    return "cancelled" if isinstance(e, QueryCancelledError) else "error"
+
+
+@contextlib.contextmanager
+def root_span(name: str, **attrs: object) -> Iterator[Dict]:
+    """Open a span that ROOTS a fresh trace regardless of the ambient
+    context, restoring the caller's stack afterward. Background jobs
+    (flush, compaction, flow folds, balancer steps) use this: the work
+    belongs to no statement's trace, and rooting it makes the trace
+    sink's tail verdict fire at ITS completion."""
+    prev = getattr(_tls, "spans", None)
+    _tls.spans = []
+    try:
+        with span(name, **attrs) as s:
+            yield s
+    finally:
+        _tls.spans = prev if prev is not None else []
+
+
+#: pluggable span sink (common/trace_store.TraceSink): completed spans
+#: feed the tail-sampled durable trace store, alongside the OTLP export
+_SPAN_SINK: list = [None]
+
+
+def set_span_sink(sink) -> None:
+    with _metrics_lock:
+        _SPAN_SINK[0] = sink
 
 
 def propagate(fn: Callable) -> Callable:
@@ -339,8 +391,14 @@ class OtlpExporter:
         with self._lock:
             if len(self._buf) >= self.max_queue:
                 self.dropped += 1
-                return
-            self._buf.append(rec)
+                full = True
+            else:
+                self._buf.append(rec)
+                full = False
+        if full:
+            # beyond the one-shot debug log: a silently-shedding exporter
+            # must be visible in runtime_metrics / the scrape tables
+            increment_counter("trace_export_dropped")
 
     def _run(self) -> None:
         while not self._stop.wait(self.flush_interval):
@@ -373,6 +431,7 @@ class OtlpExporter:
             self.exported += len(batch)
         except Exception as e:  # noqa: BLE001 — export must never break
             self.dropped += len(batch)
+            increment_counter("trace_export_dropped", len(batch))
             logger.debug("otlp export failed: %s", e)
 
     def shutdown(self) -> None:

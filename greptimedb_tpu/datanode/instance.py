@@ -151,7 +151,7 @@ class DatanodeInstance:
         every `stats_every`-th beat — meta's ingest-rate derivation
         divides row deltas by the actual elapsed time between stat
         beats, so the lower cadence doesn't distort the rate."""
-        from ..common.telemetry import span
+        from ..common.telemetry import root_span
         from ..meta import DatanodeStat
         from ..storage.scheduler import RepeatedTask
         self.attach_meta(meta_client)
@@ -180,7 +180,9 @@ class DatanodeInstance:
                 stat = DatanodeStat(region_count=len(regions),
                                     full=False)
             counter[0] += 1
-            with span("heartbeat", node=self.opts.node_id):
+            # root_span: each beat is its own (sampled) trace — the
+            # loop thread has no ambient context to inherit anyway
+            with root_span("heartbeat", node=self.opts.node_id):
                 resp = meta_client.heartbeat(self.opts.node_id, stat)
             for msg in resp.mailbox:
                 self._handle_mailbox(msg)
@@ -221,8 +223,13 @@ class DatanodeInstance:
         (a BaseException) propagates — the torture harness, like a real
         SIGKILL, must see the step die before its ack."""
         op_id, step = msg.get("op_id"), msg.get("type")
+        from ..common import background_jobs
         try:
-            payload = self._balancer_step(msg)
+            with background_jobs.job(
+                    "balancer_step", table=msg.get("table"),
+                    region=str(msg.get("region")), op_id=op_id,
+                    step=step):
+                payload = self._balancer_step(msg)
             ok, error = True, None
         except Exception as e:  # noqa: BLE001 — relayed to the balancer,
             # which rolls the operation back or retries the step

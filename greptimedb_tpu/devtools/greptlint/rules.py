@@ -778,6 +778,102 @@ class DeadFailpoint(Rule):
                     f"site is dead and the experiment never fires")
 
 
+class RootlessBackgroundJob(Rule):
+    id = "GL13"
+    title = ("background root spans: every callback handed to "
+             "RepeatedTask(...) or a scheduler submit/submit_later "
+             "must reach background_jobs.job() or telemetry."
+             "root_span() — background work that roots no trace is "
+             "invisible to the durable trace store and the "
+             "information_schema.background_jobs view")
+
+    #: where background loops live (and the seeded fixture)
+    SCAN_DIRS = ("storage", "flow", "monitor", "meta", "datanode",
+                 "cmd", "servers", "selftest")
+    #: call leaves that satisfy the contract
+    ROOTING_CALLS = frozenset({"job", "root_span"})
+
+    def _covered(self, ctx: ProjectContext):
+        """Functions that (transitively) reach a rooting call — the
+        GL11 fixpoint shape, cached per run."""
+        cached = ctx.cache.get(self.id)
+        if cached is not None:
+            return cached
+        cg = ctx.callgraph
+        members = {fn for fn in cg.functions
+                   if fn.calls & self.ROOTING_CALLS}
+        changed = True
+        while changed:
+            changed = False
+            for fn in cg.functions:
+                if fn in members:
+                    continue
+                for callee in fn.calls:
+                    if any(t in members for t in cg.targets(callee)):
+                        members.add(fn)
+                        changed = True
+                        break
+        ctx.cache[self.id] = members
+        return members
+
+    @staticmethod
+    def _callback_arg(node: ast.Call, leaf: str):
+        """The callback expression of a background registration, or
+        None when this call is not one. RepeatedTask(interval, fn, ...);
+        scheduler submit/submit_later(key: str-literal/f-string, fn) —
+        the string first arg keeps ThreadPoolExecutor.submit(fn, ...)
+        out (precision first)."""
+        if leaf == "RepeatedTask":
+            if len(node.args) >= 2:
+                return node.args[1]
+            return next((kw.value for kw in node.keywords
+                         if kw.arg == "fn"), None)
+        if leaf in ("submit", "submit_later"):
+            if len(node.args) >= 2 and isinstance(
+                    node.args[0], (ast.Constant, ast.JoinedStr)):
+                return node.args[1]
+        return None
+
+    def check(self, mod, ctx):
+        if not _in_dirs(mod.rel, self.SCAN_DIRS):
+            return
+        cg = ctx.callgraph
+        covered = None                    # computed lazily: most files
+        for fn in cg.functions:           # have no registration sites
+            if fn.mod is not mod:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _call_leaf(node)
+                if leaf not in ("RepeatedTask", "submit",
+                                "submit_later"):
+                    continue
+                cb = self._callback_arg(node, leaf)
+                if cb is None:
+                    continue
+                if isinstance(cb, ast.Attribute):
+                    cb_name = cb.attr
+                elif isinstance(cb, ast.Name):
+                    cb_name = cb.id
+                else:
+                    continue              # lambda/call: unresolvable,
+                targets = cg.targets(cb_name)   # skip for precision
+                if not targets:
+                    continue              # hub or external name
+                if covered is None:
+                    covered = self._covered(ctx)
+                if any(t in covered for t in targets):
+                    continue
+                yield mod.finding(
+                    self.id, node,
+                    f"background callback {cb_name!r} (registered in "
+                    f"{fn.qual}) never reaches background_jobs.job() "
+                    f"or telemetry.root_span() — its work rides no "
+                    f"trace and never appears in "
+                    f"information_schema.background_jobs")
+
+
 class _Line:
     """Anchor object for findings not tied to one AST node."""
 
@@ -791,4 +887,5 @@ ALL_RULES: List[Rule] = [
     UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
     UntracedHandler(), UnlockedModuleMutation(), AdhocMetricObject(),
     UntypedHandlerException(), UncancellableLoop(), DeadFailpoint(),
+    RootlessBackgroundJob(),
 ]

@@ -535,11 +535,16 @@ class FlowManager:
     # ---- maintenance ----
     def tick(self) -> Dict[str, int]:
         """Fold every flow once; returns flow key -> bucket rows written.
-        Errors are contained per flow (background-loop safety)."""
+        Errors are contained per flow (background-loop safety). Each fold
+        is a background job with its own root trace — the read-path
+        refresh() folds stay on the querying statement's trace instead."""
+        from ..common import background_jobs
         out: Dict[str, int] = {}
         for spec in self.flows():
             try:
-                out[spec.key] = self.fold_flow(spec)
+                with background_jobs.job("flow_fold", table=spec.sink,
+                                         flow=spec.name):
+                    out[spec.key] = self.fold_flow(spec)
             except Exception:  # noqa: BLE001
                 logger.exception("flow %s fold failed", spec.key)
         return out

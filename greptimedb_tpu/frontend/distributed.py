@@ -376,7 +376,13 @@ class DistTable(Table):
                 ns = exec_stats.ExecStats() if parent is not None \
                     else None
                 holder["stats"] = ns
-                with exec_stats.collect_into(ns):
+                # per-hop span: in the stored trace waterfall its
+                # self-time (RPC wall minus the datanode-side span) IS
+                # the network share — the node_ms/network_ms split,
+                # reconstructible after the fact
+                from ..common.telemetry import span as _span
+                with exec_stats.collect_into(ns), \
+                        _span("dist_rpc", peer=label, what=what):
                     return call(client, regs)
 
             res = _dist_rpc(f"{what}[{label}]", attempt)
@@ -882,12 +888,25 @@ class DistInstance:
         # distributed ingest path. Background ticking is opt-in
         # (self_monitor.start_background) — cmd/main wires it; tests
         # drive tick() cooperatively.
-        from ..common import process_list
+        from ..common import background_jobs, process_list, trace_store
         from ..monitor import SelfMonitor
         self.self_monitor = SelfMonitor(self, node_label="frontend",
                                         meta=meta)
         self.catalog.self_monitor = self.self_monitor
         process_list.configure_node("frontend")
+        background_jobs.configure_node("frontend")
+        # durable trace store, root role: this frontend decides the tail
+        # verdict for its statements' traces; datanode spans buffer
+        # remotely until the verdict piggybacks on a later RPC (or the
+        # in-process datanodes of a test cluster share this very sink)
+        self.trace_sink = trace_store.TraceSink(
+            node_label="frontend", service="frontend", role="root",
+            writer=self)
+        trace_store.install(self.trace_sink)
+        self.catalog.trace_sink = self.trace_sink
+        # information_schema.background_jobs fans out to every
+        # reachable datanode and merges (compactions run THERE)
+        self.catalog.dist_clients = clients
 
     def _create_flow_sink(self, spec, schema, pk_indices):
         """Materialize a flow sink as an ordinary distributed table."""
@@ -1226,10 +1245,15 @@ class DistInstance:
                 if stats is prev_stats:     # not this statement's stats
                     stats = None
                 import logging
+
+                from ..common import trace_store
+                sink = trace_store.sink()
                 logging.getLogger("greptimedb_tpu.slow_query").warning(
                     "slow query: %.1fms (threshold %dms) trace=%s "
-                    "stmt=%r stats=[%s]", elapsed_ms, thr,
-                    sp["trace_id"], sql,
+                    "trace_stored=%s stmt=%r stats=[%s]", elapsed_ms,
+                    thr, sp["trace_id"],
+                    sink.stored_verdict(sp["trace_id"])
+                    if sink is not None else "off", sql,
                     stats.summary() if stats is not None else "n/a")
         return outs
 
@@ -1284,6 +1308,14 @@ class DistInstance:
         if stmt.kind in ("flush_table", "compact_table"):
             from .statement import apply_admin_maintenance
             return apply_admin_maintenance(self.catalog, stmt, ctx)
+        if stmt.kind == "show_trace":
+            # sync first: a ping per datanode piggybacks this frontend's
+            # verdicts and collects any released buffered spans, so the
+            # waterfall is complete even though the query long finished
+            from .statement import apply_show_trace
+            return apply_show_trace(self.catalog, stmt,
+                                    sync_clients=list(
+                                        self.clients.values()))
         if stmt.kind == "rebalance":
             full = None
             if stmt.table is not None:

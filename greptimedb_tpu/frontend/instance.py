@@ -48,11 +48,21 @@ class FrontendInstance:
         # self-monitoring: the scraper walks the telemetry registry +
         # per-region heat and writes both through handle_row_insert into
         # greptime_private system tables (monitor/scraper.py)
-        from ..common import process_list
+        from ..common import background_jobs, process_list, trace_store
         from ..monitor import SelfMonitor
         self.self_monitor = SelfMonitor(self, node_label="standalone")
         self.catalog.self_monitor = self.self_monitor
         process_list.configure_node("standalone")
+        background_jobs.configure_node("standalone")
+        # durable trace store: completed spans buffer in the sink; the
+        # tail verdict fires at trace completion (this process roots its
+        # statements' traces) and retained spans flush through
+        # handle_row_insert into greptime_private.trace_spans
+        self.trace_sink = trace_store.TraceSink(
+            node_label="standalone", service="standalone", role="root",
+            writer=self)
+        trace_store.install(self.trace_sink)
+        self.catalog.trace_sink = self.trace_sink
 
     def start(self) -> None:
         if not self.datanode._started:
@@ -132,10 +142,16 @@ class FrontendInstance:
                                 None)
                 if stats is prev_stats:
                     stats = None
+                # trace_stored makes the WARN a working pointer: 'yes'
+                # means ADMIN SHOW TRACE '<trace>' can replay it later
+                from ..common import trace_store
+                sink = trace_store.sink()
                 _slow_logger.warning(
                     "slow query: %.1fms (threshold %dms) trace=%s "
-                    "stmt=%r stats=[%s]", elapsed_ms, thr,
-                    sp["trace_id"], sql,
+                    "trace_stored=%s stmt=%r stats=[%s]", elapsed_ms,
+                    thr, sp["trace_id"],
+                    sink.stored_verdict(sp["trace_id"])
+                    if sink is not None else "off", sql,
                     stats.summary() if stats is not None else "n/a")
             if interceptor is not None:
                 out = interceptor.post_execute(out, ctx)
@@ -183,6 +199,9 @@ class FrontendInstance:
             if stmt.kind in ("flush_table", "compact_table"):
                 from .statement import apply_admin_maintenance
                 return apply_admin_maintenance(self.catalog, stmt, ctx)
+            if stmt.kind == "show_trace":
+                from .statement import apply_show_trace
+                return apply_show_trace(self.catalog, stmt)
             # region placement is a cluster concept: standalone's single
             # implicit node has nothing to migrate/split between
             from ..errors import UnsupportedError

@@ -212,6 +212,47 @@ def admin_ops_output(ops: List[dict]) -> Output:
     return Output.record_batches([rb], schema)
 
 
+def apply_show_trace(catalog: CatalogManager, stmt: ast.Admin,
+                     sync_clients=None) -> Output:
+    """Shared ADMIN SHOW TRACE handler: render one stored trace's
+    reassembled per-node waterfall from greptime_private.trace_spans.
+    One function for both frontends.
+
+    `sync_clients` (distributed) lets buffered datanode spans catch up
+    first: a cheap ping RPC per datanode carries the frontend's recent
+    verdicts piggybacked on its body, and any released spans ride the
+    response back — the same piggyback every RPC performs, just forced
+    now so the waterfall is complete at render time."""
+    from ..common import trace_store
+    from ..datatypes import data_type as dt
+    from ..datatypes.record_batch import RecordBatch
+    from ..datatypes.schema import Schema as _Schema
+    trace_id, rows = trace_store.sync_and_fetch(
+        catalog, stmt.trace_id or "", clients=sync_clients)
+    if trace_id is None:
+        raise InvalidArgumentsError(
+            "ADMIN SHOW TRACE 'last': no trace has been retained on "
+            "this frontend yet")
+    if not rows:
+        raise InvalidArgumentsError(
+            f"trace {trace_id!r} not found in greptime_private."
+            f"trace_spans (sampled out, swept by retention, or never "
+            f"existed)")
+    wf = trace_store.waterfall_rows(rows)
+    schema = _Schema([
+        ColumnSchema("span", dt.STRING),
+        ColumnSchema("node", dt.STRING),
+        ColumnSchema("start_offset_ms", dt.INT64),
+        ColumnSchema("duration_ms", dt.FLOAT64),
+        ColumnSchema("self_ms", dt.FLOAT64),
+        ColumnSchema("status", dt.STRING),
+        ColumnSchema("detail", dt.STRING),
+    ])
+    rb = RecordBatch.from_pydict(schema, {
+        k: [r[k] for r in wf] for k in schema.names()})
+    return Output.record_batches([rb], schema)
+
+
 def apply_kill(stmt: ast.Kill) -> Output:
     """Shared KILL handler: trip the cancel event of a running statement
     in the process-wide registry. The killed statement raises
@@ -413,6 +454,23 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
                 GATE.configure(retry_after_s=value)
         except ValueError as e:
             raise InvalidArgumentsError(f"SET {stmt.name}: {e}")
+    elif name == "trace_sample_ratio":
+        # head-sample rate of the tail-sampling trace store (slow/
+        # error/KILLed/balancer traces retain regardless); 0 = only
+        # tail-flagged traces persist, 1 = everything does
+        from ..common import trace_store
+        try:
+            trace_store.configure(sample_ratio=float(stmt.value))
+        except (TypeError, ValueError):
+            raise InvalidArgumentsError(
+                f"SET {stmt.name}: expected a number in [0, 1], got "
+                f"{stmt.value!r}")
+    elif name == "trace_retention_ms":
+        # retention for greptime_private.trace_spans (swept batched on
+        # the self-monitor tick; 0 disables). Separate from
+        # self_monitor_retention_ms — traces are bulkier than metrics
+        from ..common import trace_store
+        trace_store.configure(retention_ms=_int_setting(stmt))
     elif name == "self_monitor_retention_ms":
         # retention window for greptime_private.node_metrics /
         # region_heat (monitor/scraper.py sweeps on each tick;

@@ -367,11 +367,20 @@ class RegionBalancer:
         now = time.time() if now is None else now
         summary = {"leader": True, "advanced": 0, "auto_splits": 0,
                    "auto_moves": 0}
+        from ..common import background_jobs
         with span("balancer_tick"):
             for op in self.ops():
                 try:
-                    if self._advance(op, now):
-                        summary["advanced"] += 1
+                    # each op step is a background job rooting its own
+                    # trace; the trace store ALWAYS retains traces that
+                    # touched a balancer op (tail-sampling policy)
+                    with background_jobs.job(
+                            "balancer_op", table=op.get("table"),
+                            region=str(op.get("region")),
+                            op_id=op.get("id"), op_kind=op.get("kind"),
+                            step=op.get("state")):
+                        if self._advance(op, now):
+                            summary["advanced"] += 1
                 except Exception:  # noqa: BLE001 — one broken op must not
                     logger.exception(     # stall the whole control loop
                         "balancer op %s advance failed", op.get("id"))

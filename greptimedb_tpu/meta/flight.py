@@ -48,8 +48,13 @@ class FlightMetaServer(flight.FlightServerBase):
         body = json.loads(action.body.to_pybytes() or b"{}")
         kind = action.type
         # popped (not just read): raft_* handlers splat **body, and the
-        # trace key must not reach them as an unexpected argument
+        # trace keys must not reach them as unexpected arguments. The
+        # verdict piggyback matters little here (metasrv-rooted balancer
+        # traces verdict locally), but a frontend's _traced() attaches
+        # it to every meta RPC all the same
         from ..common.telemetry import remote_context
+        from ..servers.flight import _apply_wire_verdicts
+        _apply_wire_verdicts(body)
         with remote_context(body.pop("traceparent", None)):
             yield from self._do_action_inner(kind, body)
 
@@ -155,6 +160,13 @@ class FlightMetaServer(flight.FlightServerBase):
                         body["ok"], body.get("error"),
                         body.get("payload") or {})
                     resp = {"ok": True}
+            elif kind == "background_jobs":
+                # THIS replica's live + recent background work (the
+                # balancer runs on the leader, so its rows live there;
+                # any replica may answer about itself — the registry is
+                # process-local memory, not raft state)
+                from ..common import background_jobs
+                resp = {"ok": True, "jobs": background_jobs.rows()}
             elif kind == "list_datanodes":
                 peers = self.srv.alive_datanodes() \
                     if body.get("alive_only", True) else self.srv.peers()
@@ -198,6 +210,15 @@ class FlightMetaServer(flight.FlightServerBase):
         except GreptimeError as e:
             resp = {"ok": False, "error": str(e),
                     "error_type": type(e).__name__}
+        if not kind.startswith("raft_"):
+            # metasrv-rooted retained traces (balancer op steps) ride
+            # home on whatever meta RPC comes next — the same export
+            # channel the datanode servers use (raft bodies stay
+            # protocol-pure)
+            from ..servers.flight import _export_spans
+            exported = _export_spans()
+            if exported:
+                resp["trace_spans"] = exported
         yield flight.Result(json.dumps(resp).encode())
 
 
@@ -220,13 +241,15 @@ class FlightMetaClient:
             self._conn = None
 
     def _action(self, kind: str, body: dict) -> dict:
-        from ..client.flight import _to_greptime_error, _traced
+        from ..client.flight import (_absorb_wire_spans,
+                                     _to_greptime_error, _traced)
         try:
             results = list(self.conn.do_action(
                 flight.Action(kind, json.dumps(_traced(body)).encode())))
             resp = json.loads(results[0].body.to_pybytes())
         except flight.FlightError as e:
             raise _to_greptime_error(e) from None
+        _absorb_wire_spans(resp.pop("trace_spans", None))
         if not resp.get("ok", False):
             if resp.get("error_type") == "NotLeaderError":
                 from .replication import NotLeaderError
@@ -272,6 +295,12 @@ class FlightMetaClient:
 
     def cluster_info(self) -> List[dict]:
         return self._action("cluster_info", {})["nodes"]
+
+    def background_jobs(self) -> List[dict]:
+        """The metasrv replica's live + recent background jobs (the
+        balancer's op steps run on the leader) — merged into
+        information_schema.background_jobs by the frontend."""
+        return list(self._action("background_jobs", {}).get("jobs", []))
 
     def region_heat(self) -> List[dict]:
         return self._action("region_heat", {})["rows"]

@@ -103,7 +103,9 @@ class Election:
         self._task = None
 
     def campaign_once(self, now: Optional[float] = None) -> bool:
-        return self._lock.try_acquire(now)
+        from ..common.telemetry import root_span
+        with root_span("election_campaign", candidate=self.candidate_id):
+            return self._lock.try_acquire(now)
 
     @property
     def is_leader(self) -> bool:

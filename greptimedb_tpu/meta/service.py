@@ -598,6 +598,13 @@ class MetaClient:
     def cluster_info(self) -> List[dict]:
         return self._srv.cluster_info()
 
+    def background_jobs(self) -> List[dict]:
+        """In-process twin of the wire action: the shared process
+        registry (the view's (node, job_id) dedup absorbs the
+        duplication with the frontend's own rows)."""
+        from ..common import background_jobs
+        return background_jobs.rows()
+
     def region_heat(self) -> List[dict]:
         return self._srv.region_heat()
 

@@ -102,7 +102,19 @@ class SelfMonitor:
         """Scrape + write once; returns rows written. Serialized (the
         background task and a test-driven tick must not interleave) and
         error-contained — a failed scrape logs and shows up in the
-        self_monitor view, never breaks the host."""
+        self_monitor view, never breaks the host. Runs as a background
+        job (own root trace + background_jobs row), like every other
+        scheduler-driven loop."""
+        from ..common import background_jobs
+        from ..common.telemetry import suppress_metrics
+        # suppressed END TO END (not just the writes): the tick's own
+        # root span must not bump a histogram either, or idle ticks
+        # would never converge — the scraper observes, it is not
+        # observed (its background_jobs row still registers)
+        with suppress_metrics(), background_jobs.job("self_monitor"):
+            return self._tick_inner()
+
+    def _tick_inner(self) -> int:
         from ..common.telemetry import registry_snapshot, suppress_metrics
         with self._lock:
             t0 = time.perf_counter()
@@ -119,6 +131,10 @@ class SelfMonitor:
                     # the operator exactly when they need the data
                     written = self._write_metrics(samples, now_ms)
                     written += self._write_heat(heat, now_ms)
+                    # traces flush BEFORE the sweep so a tightened
+                    # trace_retention_ms applies to just-written rows
+                    # on the same tick
+                    written += self._flush_traces()
                     deleted = self._enforce_retention(now_ms)
                 self.stats["ticks"] = int(self.stats["ticks"]) + 1
                 self.stats["metric_rows"] = \
@@ -237,46 +253,69 @@ class SelfMonitor:
     #: this many rows per table per tick and catches up tick by tick
     SWEEP_BATCH_ROWS = 50_000
 
+    # ---- trace-store flush (common/trace_store.py) ----
+    def _flush_traces(self) -> int:
+        """Write retained spans queued by the process-wide trace sink,
+        and TTL-evict verdictless buffered traces. The sink's flush runs
+        under its own suppress_metrics guard."""
+        from ..common import trace_store
+        sink = trace_store.sink()
+        if sink is None:
+            return 0
+        sink.evict_expired()
+        return sink.flush()
+
     # ---- retention ----
     def _enforce_retention(self, now_ms: int) -> int:
         """Delete system-table rows older than the retention window —
         the same key-scan + delete path user DELETEs take, so the sweep
-        works on both topologies."""
+        works on both topologies. trace_spans sweeps on its own, shorter
+        leash (SET trace_retention_ms, default 3d): traces are bulkier
+        than metrics."""
+        from ..common import trace_store
+        deleted = 0
         keep_ms = retention_ms()
-        if keep_ms <= 0:
-            return 0
-        cutoff = now_ms - keep_ms
+        if keep_ms > 0:
+            for tname in (NODE_METRICS_TABLE, REGION_HEAT_TABLE):
+                deleted += self._sweep_table(tname, now_ms - keep_ms)
+        trace_keep_ms = trace_store.retention_ms()
+        if trace_keep_ms > 0:
+            deleted += self._sweep_table(trace_store.TRACE_SPANS_TABLE,
+                                         now_ms - trace_keep_ms)
+        if deleted:
+            logger.info("self-monitor: retention swept %d row(s)",
+                        deleted)
+        return deleted
+
+    def _sweep_table(self, tname: str, cutoff: int) -> int:
+        """Batched key-scan + delete of one system table's expired rows
+        (at most SWEEP_BATCH_ROWS per tick — backlogs drain tick by
+        tick instead of materializing inside the scrape lock)."""
         from .. import DEFAULT_CATALOG_NAME
         from ..common.time import TimestampRange
-        deleted = 0
-        for tname in (NODE_METRICS_TABLE, REGION_HEAT_TABLE):
-            table = self.catalog.table(DEFAULT_CATALOG_NAME,
-                                       PRIVATE_SCHEMA, tname)
-            if table is None:
-                continue
-            schema = table.schema
-            tc = schema.timestamp_column
-            key_cols = schema.tag_names() + [tc.name]
-            old: Dict[str, list] = {c: [] for c in key_cols}
-            budget = self.SWEEP_BATCH_ROWS
-            for b in table.scan_batches(
-                    projection=key_cols,
-                    time_range=TimestampRange(None, cutoff)):
-                d = b.to_pydict()
-                take = min(budget, len(d[tc.name]))
-                for c in key_cols:
-                    old[c].extend(d[c][:take])
-                budget -= take
-                if budget <= 0:
-                    break
-            n = len(old[tc.name])
-            if n:
-                table.delete(old)
-                deleted += n
-        if deleted:
-            logger.info("self-monitor: retention swept %d row(s) older "
-                        "than %dms", deleted, keep_ms)
-        return deleted
+        table = self.catalog.table(DEFAULT_CATALOG_NAME,
+                                   PRIVATE_SCHEMA, tname)
+        if table is None:
+            return 0
+        schema = table.schema
+        tc = schema.timestamp_column
+        key_cols = schema.tag_names() + [tc.name]
+        old: Dict[str, list] = {c: [] for c in key_cols}
+        budget = self.SWEEP_BATCH_ROWS
+        for b in table.scan_batches(
+                projection=key_cols,
+                time_range=TimestampRange(None, cutoff)):
+            d = b.to_pydict()
+            take = min(budget, len(d[tc.name]))
+            for c in key_cols:
+                old[c].extend(d[c][:take])
+            budget -= take
+            if budget <= 0:
+                break
+        n = len(old[tc.name])
+        if n:
+            table.delete(old)
+        return n
 
     # ---- introspection (information_schema.self_monitor) ----
     def row(self) -> Dict[str, object]:

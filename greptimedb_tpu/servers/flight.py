@@ -50,6 +50,32 @@ EXEC_STATS_KEY = exec_stats.EXEC_STATS_WIRE_KEY
 _slow_logger = logging.getLogger("greptimedb_tpu.slow_query")
 
 
+def _apply_wire_verdicts(body: dict) -> None:
+    """Tail-sampling verdicts piggybacked on an inbound RPC body: pop
+    the key (handlers must not see it) and release/discard the matching
+    buffered traces on this process's sink."""
+    from ..common import trace_store
+    verdicts = body.pop(trace_store.TRACE_VERDICTS_BODY_KEY, None)
+    sink = trace_store.sink()
+    if sink is None or not isinstance(verdicts, dict) or not verdicts:
+        return
+    try:
+        sink.apply_verdicts({str(k): bool(v)
+                             for k, v in verdicts.items()})
+    except Exception:  # noqa: BLE001 — advisory; never fail the RPC
+        logging.getLogger(__name__).exception(
+            "trace verdict application failed")
+
+
+def _export_spans() -> list:
+    """Retained spans awaiting the trip home — they ride this RPC's
+    response to the frontend, which writes them into
+    greptime_private.trace_spans."""
+    from ..common import trace_store
+    sink = trace_store.sink()
+    return sink.take_export() if sink is not None else []
+
+
 def _advertised_address(location: str, port: int) -> str:
     """Dialable address for peers: the bound host with the real port
     (port 0 in the location means OS-assigned)."""
@@ -161,6 +187,7 @@ class FlightDatanodeServer(flight.FlightServerBase):
     def do_action(self, context, action):
         body = json.loads(action.body.to_pybytes() or b"{}")
         kind = action.type
+        _apply_wire_verdicts(body)
         # join the caller's trace before any handler work so DDL/flush
         # spans and logs carry the frontend's trace id
         with remote_context(body.pop("traceparent", None)), \
@@ -196,11 +223,19 @@ class FlightDatanodeServer(flight.FlightServerBase):
                     resp = {"ok": True, "info": info.to_dict()}
             elif kind == "ping":
                 resp = {"ok": True, "node_id": self.datanode.opts.node_id}
+            elif kind == "background_jobs":
+                # live + recent background work on THIS node, for the
+                # frontend's cluster-merged information_schema view
+                from ..common import background_jobs
+                resp = {"ok": True, "jobs": background_jobs.rows()}
             else:
                 raise GreptimeError(f"unknown action {kind!r}")
         except GreptimeError as e:
             resp = {"ok": False, "error": str(e),
                     "error_type": type(e).__name__}
+        exported = _export_spans()
+        if exported:
+            resp["trace_spans"] = exported
         yield flight.Result(json.dumps(resp).encode())
 
     # ---- write plane ----
@@ -208,6 +243,7 @@ class FlightDatanodeServer(flight.FlightServerBase):
         cmd = json.loads(descriptor.command)
         if cmd.get("type") != "write_region":
             raise GreptimeError(f"unsupported put {cmd.get('type')!r}")
+        _apply_wire_verdicts(cmd)
         stats = exec_stats.ExecStats()
         t0 = time.perf_counter()
         with remote_context(cmd.get("traceparent")), \
@@ -231,9 +267,11 @@ class FlightDatanodeServer(flight.FlightServerBase):
                 cmd["region_number"], columns, op=op)
         self._log_slow(sp, "write_region", cmd,
                        (time.perf_counter() - t0) * 1e3, stats)
-        writer.write(pa.py_buffer(json.dumps(
-            {"affected_rows": n,
-             "exec_stats": stats.to_dict()}).encode()))
+        ack = {"affected_rows": n, "exec_stats": stats.to_dict()}
+        exported = _export_spans()
+        if exported:
+            ack["trace_spans"] = exported
+        writer.write(pa.py_buffer(json.dumps(ack).encode()))
 
     def _log_slow(self, sp, what: str, cmd: dict, elapsed_ms: float,
                   stats: exec_stats.ExecStats) -> None:
@@ -255,6 +293,7 @@ class FlightDatanodeServer(flight.FlightServerBase):
         kind = cmd.get("type")
         if kind not in ("scan", "region_moments"):
             raise GreptimeError(f"unsupported ticket {kind!r}")
+        _apply_wire_verdicts(cmd)
         # the scan executes eagerly under a local collector; its stats
         # ride the stream schema back so the frontend can render this
         # node's stage rows in its EXPLAIN ANALYZE tree
@@ -271,6 +310,11 @@ class FlightDatanodeServer(flight.FlightServerBase):
         self._log_slow(sp, kind, cmd, (time.perf_counter() - t0) * 1e3,
                        stats)
         metadata = {EXEC_STATS_KEY: json.dumps(stats.to_dict()).encode()}
+        exported = _export_spans()
+        if exported:
+            from ..common.trace_store import TRACE_SPANS_WIRE_KEY
+            metadata[TRACE_SPANS_WIRE_KEY] = \
+                json.dumps(exported).encode()
         if kind == "scan":
             return _batches_stream(batches, fallback, metadata=metadata)
         return _frames_stream(frames, metadata=metadata)

@@ -82,6 +82,7 @@ class HttpServer:
         r.add_get("/metrics", self.handle_metrics)
         r.add_get("/health", self.handle_health)
         r.add_get("/status", self.handle_status)
+        r.add_get("/v1/trace/{trace_id}", self.handle_trace)
         r.add_post("/v1/admin/flush", self.handle_flush)
         r.add_post("/v1/admin/compact", self.handle_compact)
         r.add_post("/v1/admin/downsample", self.handle_downsample)
@@ -465,6 +466,42 @@ class HttpServer:
                 if val is not None:
                     s.samples.append((float(val), int(row[ts_name])))
         return list(by_series.values())
+
+    async def handle_trace(self, request):
+        """GET /v1/trace/<trace_id> — the reassembled cross-node
+        waterfall of one stored trace from greptime_private.trace_spans
+        (the durable trace store). 'last' = the most recently retained
+        trace on this frontend. 404 when the trace was sampled out,
+        swept by retention, or never existed."""
+        self.user_provider.auth_http_basic(
+            request.headers.get("Authorization"))
+        trace_id = request.match_info["trace_id"]
+
+        def work():
+            from ..common import trace_store
+            clients = getattr(self.frontend, "clients", None)
+            tid, rows = trace_store.sync_and_fetch(
+                self.frontend.catalog, trace_id,
+                clients=list(clients.values()) if clients else None)
+            if not rows:
+                return tid, None
+            return tid, {
+                "spans": rows,
+                "waterfall": trace_store.waterfall_rows(rows),
+            }
+
+        loop = asyncio.get_running_loop()
+        tid, doc = await loop.run_in_executor(
+            None, self._traced_call(request, work))
+        if doc is None:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": f"trace {tid or trace_id!r} not found "
+                          f"(sampled out, swept, or never existed)"},
+                status=404)
+        doc["trace_id"] = tid
+        doc["span_count"] = len(doc["spans"])
+        return web.json_response(doc)
 
     async def handle_mem_prof(self, request):
         """Heap profile dump (reference: jemalloc /v1/prof/mem,

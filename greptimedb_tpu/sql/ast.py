@@ -420,14 +420,21 @@ class Admin(Statement):
 
     - ``ADMIN FLUSH TABLE <table>``
     - ``ADMIN COMPACT TABLE <table>``
+
+    Durable trace store (works on both deployments):
+
+    - ``ADMIN SHOW TRACE '<trace_id>'`` — the reassembled cross-node
+      waterfall from ``greptime_private.trace_spans`` ('last' = the
+      most recently retained trace on this frontend)
     """
     #: migrate_region | split_region | rebalance | flush_table |
-    #: compact_table
+    #: compact_table | show_trace
     kind: str = ""
     table: Optional[ObjectName] = None
     region: Optional[int] = None
     target_node: Optional[int] = None
     at_value: Any = None
+    trace_id: Optional[str] = None
 
 
 @dataclass

@@ -289,8 +289,21 @@ class Parser:
 
         - ADMIN FLUSH TABLE <table>
         - ADMIN COMPACT TABLE <table>
+
+        And the durable trace store's waterfall surface:
+
+        - ADMIN SHOW TRACE '<trace_id>'  ('last' = most recently
+          retained trace on this frontend)
         """
         self.expect_kw("ADMIN")
+        if self.match_kw("SHOW"):
+            self.expect_kw("TRACE")
+            t = self.next()
+            if t.kind != STRING:
+                raise ParserError(
+                    f"ADMIN SHOW TRACE needs a quoted trace id (or "
+                    f"'last'), found {t.value!r} at {t.pos}")
+            return Admin(kind="show_trace", trace_id=str(t.value))
         if self.match_kw("FLUSH"):
             self.expect_kw("TABLE")
             return Admin(kind="flush_table",
@@ -327,8 +340,8 @@ class Parser:
         t = self.peek()
         raise ParserError(
             f"expected MIGRATE REGION / SPLIT REGION / REBALANCE / "
-            f"FLUSH TABLE / COMPACT TABLE after ADMIN, found "
-            f"{t.value!r} at {t.pos}")
+            f"FLUSH TABLE / COMPACT TABLE / SHOW TRACE after ADMIN, "
+            f"found {t.value!r} at {t.pos}")
 
     def parse_kill(self) -> Kill:
         """KILL [QUERY] <id> — the id is the `id` column of

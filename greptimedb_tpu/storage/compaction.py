@@ -85,9 +85,13 @@ def run_compaction(region, plan: CompactionPlan,
     writer lock."""
     if not plan.inputs and not plan.expired:
         return []
+    from ..common import background_jobs
     from ..common.telemetry import increment_counter, span, timer
-    with span("compaction", region=region.name,
-              inputs=len(plan.inputs), expired=len(plan.expired)), \
+    with background_jobs.job("compaction", region=region.name,
+                             inputs=len(plan.inputs),
+                             expired=len(plan.expired)), \
+            span("compaction", region=region.name,
+                 inputs=len(plan.inputs), expired=len(plan.expired)), \
             timer("compaction"):
         out = _run_compaction_inner(region, plan, ttl_ms=ttl_ms,
                                     now_ms=now_ms)

@@ -52,6 +52,22 @@ class FilePurger:
                    if force or item[0] <= now]
             self._pending = [] if force else \
                 [item for item in self._pending if item[0] > now]
+        if due:
+            from ..common import background_jobs
+            ctx = background_jobs.job("purge", files=len(due))
+        else:
+            import contextlib
+            ctx = contextlib.nullcontext()
+        with ctx:
+            deleted, requeue = self._delete_due(due, force, now)
+        if requeue:
+            from ..common.telemetry import increment_counter
+            increment_counter("purge_retries", len(requeue))
+            with self._lock:
+                self._pending.extend(requeue)
+        return deleted
+
+    def _delete_due(self, due, force: bool, now: float):
         deleted = 0
         requeue = []
         for _, fn, name, attempt in due:
@@ -73,12 +89,7 @@ class FilePurger:
                         "purging %s failed (%s); retry %d/%d in %.0fs",
                         name, e, attempt + 1, len(_RETRY_BACKOFF_S), delay)
                     requeue.append((now + delay, fn, name, attempt + 1))
-        if requeue:
-            from ..common.telemetry import increment_counter
-            increment_counter("purge_retries", len(requeue))
-            with self._lock:
-                self._pending.extend(requeue)
-        return deleted
+        return deleted, requeue
 
     @property
     def pending_count(self) -> int:

@@ -1047,7 +1047,13 @@ class Region:
         to_flush = list(vc.current.memtables.immutables)
         if not to_flush:
             return []
-        with span("region_flush", region=self.name), timer("region_flush"):
+        # a background job roots its own trace (information_schema.
+        # background_jobs + the durable trace store see it); the span
+        # timer keeps feeding greptime_region_flush_seconds
+        from ..common import background_jobs
+        with background_jobs.job("flush", region=self.name), \
+                span("region_flush", region=self.name), \
+                timer("region_flush"):
             files = self._flush_memtables(to_flush)
         increment_counter("flush_files", len(files))
         increment_counter("flush_rows",
@@ -1266,8 +1272,11 @@ class Region:
                    if f.time_range[1] < cutoff]
         if not expired:
             return 0
-        self.commit_compaction(removed=[f.file_name for f in expired],
-                               added=[], retracts=True)
+        from ..common import background_jobs
+        with background_jobs.job("ttl_sweep", region=self.name,
+                                 files=len(expired)):
+            self.commit_compaction(removed=[f.file_name for f in expired],
+                                   added=[], retracts=True)
         return len(expired)
 
     # ---- alter ----

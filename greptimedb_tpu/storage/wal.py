@@ -256,7 +256,17 @@ class Wal:
         """Leader duties: give the cohort a short window to pile on, then
         pay ONE fsync for every record written so far and publish the
         covered ticket. Any fsync failure (or injected crash) is recorded
-        for the cohort and re-raised in the leader's own thread."""
+        for the cohort and re-raised in the leader's own thread.
+
+        The flush serves a whole cohort, so it roots its own trace +
+        background_jobs entry (common/background_jobs) rather than
+        riding whichever writer happened to get elected."""
+        from ..common import background_jobs
+        with background_jobs.job("wal_group_commit",
+                                 region=os.path.basename(self.dir)):
+            self._lead_sync_inner()
+
+    def _lead_sync_inner(self) -> None:
         _enabled, max_wait_us, max_batch = group_commit_settings()
         if max_wait_us > 0:
             with self._gc_cond:

@@ -1,0 +1,17 @@
+-- ADMIN SHOW TRACE (ISSUE 15): the durable trace store's waterfall
+-- surface. With trace_sample_ratio = 1 every trace is retained, so
+-- 'last' renders the immediately preceding statement's stored spans;
+-- at ratio 0 a fast statement leaves nothing. Volatile columns
+-- (timings) are normalized by the runner.
+
+SET trace_sample_ratio = 1;
+
+SELECT 1;
+
+ADMIN SHOW TRACE 'last';
+
+SET trace_sample_ratio = 0;
+
+ADMIN SHOW TRACE 'f00dfeedf00dfeedf00dfeedf00dfeed';
+
+SET trace_sample_ratio = 0.01;

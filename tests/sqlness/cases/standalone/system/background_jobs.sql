@@ -1,0 +1,33 @@
+-- information_schema.background_jobs (ISSUE 15): background work —
+-- flush, compaction, TTL sweeps, flow folds, balancer steps, WAL
+-- group commits — registers live rows with region/table attribution
+-- plus a last-N completed ring with durations and outcomes. Volatile
+-- columns (job_id/trace_id/start_ms/duration_ms) are normalized by
+-- the runner.
+
+CREATE TABLE bj (
+    host STRING,
+    ts TIMESTAMP TIME INDEX,
+    v DOUBLE,
+    PRIMARY KEY(host)
+);
+
+INSERT INTO bj VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+ADMIN FLUSH TABLE bj;
+
+INSERT INTO bj VALUES ('a', 3000, 3.0), ('b', 4000, 4.0);
+
+ADMIN FLUSH TABLE bj;
+
+ADMIN COMPACT TABLE bj;
+
+-- two flushes and one compaction, all done, none failed; every row
+-- names its region and carries a trace id into the durable trace store
+SELECT kind, region, state, error
+FROM information_schema.background_jobs
+WHERE kind IN ('flush', 'compaction')
+ORDER BY kind, job_id;
+
+SELECT count(*) FROM information_schema.background_jobs
+WHERE kind IN ('flush', 'compaction') AND trace_id != '';

@@ -72,7 +72,13 @@ def _strip_comment_lines(stmt: str) -> str:
 #: last-seen heartbeat times and dialed addresses in cluster_info)
 _VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>",
                      "last_seen_ms": "<last_seen>", "peer_addr": "<addr>",
-                     "op_id": "<op_id>"}
+                     "op_id": "<op_id>",
+                     # trace-store waterfall / background_jobs timings
+                     # and ids (ISSUE 15)
+                     "duration_ms": "<ms>", "self_ms": "<ms>",
+                     "start_offset_ms": "<ms>", "start_ms": "<ms>",
+                     "trace_id": "<trace>", "span_id": "<span>",
+                     "parent_span_id": "<span>"}
 
 #: wall-clock fragments inside EXPLAIN ANALYZE detail strings: the
 #: scatter's slowest-node latency, the per-node latency vector, and the
@@ -244,9 +250,14 @@ def run_one(sql_path: Path, update: bool) -> Optional[str]:
     result_path = sql_path.with_suffix(".result")
     distributed = "distributed" in sql_path.relative_to(CASES_DIR).parts
     # failpoint state/counters are process-global; a case sees them as a
-    # fresh server would (system/failpoints.sql pins exact hit counts)
-    from greptimedb_tpu.common import failpoint
+    # fresh server would (system/failpoints.sql pins exact hit counts).
+    # The background-job registry and trace knobs are process-global
+    # too (system/background_jobs.sql pins exact job rows)
+    from greptimedb_tpu.common import background_jobs, failpoint
+    from greptimedb_tpu.common import trace_store
     failpoint.reset()
+    background_jobs.reset()
+    trace_store.configure(sample_ratio=0.01)
     with tempfile.TemporaryDirectory() as home:
         fe = _DistEnv(home) if distributed else make_frontend(home)
         try:

@@ -387,6 +387,209 @@ class TestMultiProcessCluster:
 
 
 @pytest.mark.slow
+class TestDurableTraceCluster:
+    """ISSUE 15 acceptance drive: a REAL 4-datanode cluster (separate
+    processes). A deliberately slow distributed query finishes; long
+    after, ADMIN SHOW TRACE reassembles its full cross-node waterfall
+    from greptime_private.trace_spans — frontend AND all touched
+    datanodes under one trace id. A fast query leaves no spans, a
+    KILLed query is always retained, and background_jobs shows
+    datanode-side flush/compaction work with its region."""
+
+    _spawn = TestMultiProcessCluster._spawn
+    _http = TestMultiProcessCluster._http
+    _wait_tcp = TestMultiProcessCluster._wait_tcp
+
+    def _sql(self, port, sql, timeout=60):
+        resp = self._http(port, sql, timeout=timeout)
+        assert resp["code"] == 0, resp
+        return resp
+
+    def _rows(self, port, sql):
+        out = self._sql(port, sql)["output"][0]
+        return out.get("records", {}).get("rows", [])
+
+    def test_cross_node_waterfall_survives_the_query(self, tmp_path):
+        import socket
+        import threading
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        meta_p, http_p = free_port(), free_port()
+        dn_ports = {i: free_port() for i in (1, 2, 3, 4)}
+        # tail-sampling pinned for determinism: ONLY slow/error/killed/
+        # balancer traces retain (no head-sample noise). 300ms keeps
+        # ordinary statements fast; the "deliberately slow" query gets
+        # its slowness injected via the dist_rpc delay failpoint
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GREPTIME_TRACE_SAMPLE_RATIO="0",
+                   GREPTIME_SLOW_QUERY_MS="300")
+        procs = []
+        try:
+            procs.append(self._spawn(
+                "metasrv", "start", "--bind-addr", f"127.0.0.1:{meta_p}",
+                "--store", str(tmp_path / "kv.json"), env=env))
+            self._wait_tcp(meta_p, procs[0])
+            for i, port in dn_ports.items():
+                procs.append(self._spawn(
+                    "datanode", "start", "--node-id", str(i),
+                    "--rpc-addr", f"127.0.0.1:{port}",
+                    "--metasrv-addr", f"127.0.0.1:{meta_p}",
+                    # one shared data home (the elastic deployment
+                    # shape) so the migrate half of the drive can hand
+                    # a region between nodes; WAL/fence state is
+                    # node-scoped inside it
+                    "--data-home", str(tmp_path / "shared"), env=env))
+            for i, port in dn_ports.items():
+                self._wait_tcp(port, procs[i])
+            procs.append(self._spawn(
+                "frontend", "start",
+                "--metasrv-addr", f"127.0.0.1:{meta_p}",
+                "--http-addr", f"127.0.0.1:{http_p}", env=env))
+            self._wait_tcp(http_p, procs[-1])
+
+            self._sql(http_p, """
+CREATE TABLE tr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,
+                 PRIMARY KEY(host))
+PARTITION BY HASH (host) PARTITIONS 8""")
+            for b in range(4):
+                vals = ", ".join(
+                    f"('h{j % 40}', {100_000 + b * 1000 + j}, {float(j)})"
+                    for j in range(500))
+                self._sql(http_p, f"INSERT INTO tr VALUES {vals}")
+
+            # --- the deliberately slow distributed query: every dist
+            # RPC pays an injected 400ms hop, so the statement clears
+            # the 300ms slow threshold deterministically ---
+            self._sql(http_p, "SET failpoint_dist_rpc = 'delay(400)'")
+            rows = self._rows(http_p, "SELECT host, avg(v), count(*) "
+                                      "FROM tr GROUP BY host")
+            assert len(rows) == 40
+            self._sql(http_p, "SET failpoint_dist_rpc = 'off'")
+
+            # the query is DONE. Reassemble its waterfall from the
+            # durable store: the SHOW TRACE ping piggybacks verdicts to
+            # every datanode and collects their buffered spans
+            wf = self._rows(http_p, "ADMIN SHOW TRACE 'last'")
+            spans = [r[0].strip() for r in wf]
+            nodes = {r[1] for r in wf}
+            assert any("execute_stmt" in s for s in spans)
+            assert "frontend" in nodes
+            touched = {n for n in nodes if n.startswith("dn")}
+            assert touched == {"dn1", "dn2", "dn3", "dn4"}, nodes
+            # one trace id across every process: the stored rows agree
+            tid_rows = self._rows(
+                http_p, "SELECT DISTINCT trace_id FROM "
+                        "information_schema.trace_spans WHERE "
+                        "span_name IN ('dn_region_moments', 'dn_scan')")
+            assert len(tid_rows) == 1
+            tid = tid_rows[0][0]
+            node_rows = self._rows(
+                http_p, f"SELECT DISTINCT node FROM information_schema"
+                        f".trace_spans WHERE trace_id = '{tid}'")
+            got_nodes = {r[0] for r in node_rows}
+            assert {"frontend", "dn1", "dn2", "dn3", "dn4"} <= got_nodes
+
+            # --- a fast query leaves no spans ---
+            before = self._rows(http_p, "SELECT count(*) FROM "
+                                        "information_schema.trace_spans"
+                                        )[0][0]
+            self._sql(http_p, "SELECT 1")
+            time.sleep(0.2)
+            after = self._rows(http_p, "SELECT count(*) FROM "
+                                       "information_schema.trace_spans"
+                                       )[0][0]
+            assert after == before   # nothing new from SELECT 1
+
+            # --- a KILLed query is always retained ---
+            self._sql(http_p, "SET failpoint_dist_rpc = 'delay(2000)'")
+            killed = {}
+
+            def victim():
+                try:
+                    self._http(http_p,
+                               "SELECT host, sum(v) FROM tr "
+                               "GROUP BY host", timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    killed["err"] = e
+            t = threading.Thread(target=victim)
+            t.start()
+            pid = None
+            t0 = time.time()
+            while pid is None and time.time() - t0 < 30:
+                for r in self._rows(http_p,
+                                    "SELECT id, query FROM "
+                                    "information_schema.processes"):
+                    if "sum(v)" in r[1]:
+                        pid = r[0]
+                time.sleep(0.1)
+            assert pid is not None, "victim never registered"
+            self._sql(http_p, f"KILL {pid}")
+            t.join(60)
+            self._sql(http_p, "SET failpoint_dist_rpc = 'off'")
+            cancelled = self._rows(
+                http_p, "SELECT count(*) FROM information_schema."
+                        "trace_spans WHERE status = 'cancelled'")
+            assert cancelled[0][0] >= 1
+
+            # --- background_jobs shows datanode work with regions ---
+            self._sql(http_p, "ADMIN FLUSH TABLE tr")
+            jobs = self._rows(
+                http_p, "SELECT kind, region, node, state FROM "
+                        "information_schema.background_jobs "
+                        "WHERE kind = 'flush'")
+            assert jobs, "no flush jobs visible cluster-wide"
+            assert any(r[2].startswith("dn") and r[1] for r in jobs)
+
+            # --- balancer op steps: jobs on the METASRV process are
+            # merged into the view, and the op's trace (always
+            # retained) lands in trace_spans via the meta-RPC export ---
+            owner = self._rows(
+                http_p, "SELECT peer_id FROM information_schema."
+                        "region_peers WHERE region_number = 0")[0][0]
+            target = next(i for i in (1, 2, 3, 4) if i != owner)
+            self._sql(http_p,
+                      f"ADMIN MIGRATE REGION tr 0 TO {target}")
+            t0 = time.time()
+            bal = []
+            while time.time() - t0 < 60:
+                bal = self._rows(
+                    http_p, "SELECT kind, node, state FROM "
+                            "information_schema.background_jobs "
+                            "WHERE kind = 'balancer_op'")
+                if any(r[1] == "metasrv" for r in bal):
+                    break
+                time.sleep(0.5)
+            assert any(r[1] == "metasrv" for r in bal), bal
+            t0 = time.time()
+            stored = []
+            while time.time() - t0 < 60 and not stored:
+                stored = self._rows(
+                    http_p, "SELECT count(*) FROM information_schema."
+                            "trace_spans WHERE node = 'metasrv' AND "
+                            "span_name = 'job_balancer_op'")
+                if stored and stored[0][0] > 0:
+                    break
+                stored = []
+                time.sleep(0.5)
+            assert stored and stored[0][0] > 0, \
+                "metasrv balancer trace never reached trace_spans"
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+@pytest.mark.slow
 class TestElasticCluster:
     """ISSUE 9 acceptance drive: a REAL 4-datanode cluster (separate
     processes over a shared object store) under sustained ingest —
