@@ -4,11 +4,11 @@
 PY ?= python
 
 .PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
-	bench-index bench-distagg bench-trace
+	bench-index bench-distagg bench-trace bench-promql
 
 check: lint typecheck test
 
-# greptlint: project-invariant static analyzer (rules GL01-GL13;
+# greptlint: project-invariant static analyzer (rules GL01-GL14;
 # GL10-GL13 are interprocedural over the repo-wide call graph).
 # Exit 0 requires a clean scan modulo .greptlint-baseline.json.
 lint:
@@ -75,3 +75,10 @@ bench-trace:
 # wire-byte reduction
 bench-distagg:
 	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=distagg $(PY) bench.py
+
+# only the ISSUE 16 metric: 4-datanode PromQL range query
+# `sum by (hostname) (rate(...))` through the plan-IR pushdown vs the
+# raw-pull row path (`SET dist_partial_agg = 0`); asserts the >=3x
+# speedup and publishes the wire-byte ratio
+bench-promql:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=promql $(PY) bench.py

@@ -1060,6 +1060,122 @@ def emit_dist_partial_agg():
     }))
 
 
+def bench_promql_dist_range(n_rows: int):
+    """Eleventh driver metric (ISSUE 16): a distributed PromQL range
+    query through the plan-IR pushdown. 4 in-process datanodes host an
+    8-region hash table; the timed query is the canonical dashboard
+    shape — `sum by (hostname) (rate(cpu[1m]))` over the whole span —
+    which before this PR pulled RAW SAMPLES from every region to the
+    frontend row path. Now it lowers onto the same TpuPlan SQL ships:
+    datanodes fold regions into per-(series, bucket) moment frames,
+    only frames cross the wire, the frontend reconstructs rate and
+    folds by hostname. Differential: `SET dist_partial_agg = 0` (the
+    raw-pull row path). Published: rows/s through the IR, the speedup
+    vs raw-pull (>= 3x asserted), and the wire-byte comparison —
+    moment frames folded (ExecStats partial_bytes) vs the bytes a raw
+    scatter ships."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.client import LocalDatanodeClient
+    from greptimedb_tpu.common import exec_stats
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.distributed import DistInstance
+    from greptimedb_tpu.meta import MemKv, MetaClient, MetaSrv, Peer
+    from greptimedb_tpu.session import QueryContext
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-promql-")
+    datanodes = {}
+    try:
+        srv = MetaSrv(MemKv())
+        meta = MetaClient(srv)
+        clients = {}
+        for i in range(1, 5):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=f"{tmpdir}/dn{i}", node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        ctx = QueryContext()
+        fe.do_query(
+            "CREATE TABLE cpu (hostname STRING, ts TIMESTAMP TIME INDEX, "
+            "usage_user DOUBLE, PRIMARY KEY(hostname)) "
+            "PARTITION BY HASH (hostname) PARTITIONS 8", ctx)
+        table = fe.catalog.table("greptime", "public", "cpu")
+        rng = np.random.default_rng(11)
+        hosts = 256
+        per = n_rows // hosts
+        ts = np.tile(np.arange(per, dtype=np.int64) * 10_000, hosts)
+        host = np.repeat(
+            np.array([f"host_{i}" for i in range(hosts)]),
+            per).astype(object)
+        # a counter: monotone per series, the shape rate() exists for
+        vals = np.tile(np.cumsum(rng.random(per) * 5.0), hosts)
+        table.bulk_load({"hostname": host, "ts": ts, "usage_user": vals})
+        table.flush()
+        n = hosts * per
+        end_s = (per - 1) * 10
+        tql = (f"TQL EVAL (0, {end_s}, '60s') "
+               "sum by (hostname) (rate(cpu[1m]))")
+
+        def timed(iters=2):
+            dt = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fe.do_query(tql, ctx)
+                dt = min(dt, time.perf_counter() - t0)
+            return dt
+
+        fe.do_query(tql, ctx)              # warm caches + compiles
+        stats = exec_stats.ExecStats()
+        with exec_stats.collect(stats):
+            fe.do_query(tql, ctx)
+        partial_bytes = stats.totals()["partial_bytes"]
+        assert partial_bytes > 0, "PromQL did not ride the IR pushdown"
+        dt_ir = timed()
+
+        # the raw-pull differential: what the pre-PR row path shipped
+        raw_bytes = _record_batches_bytes(table.scan_batches(
+            projection=["hostname", "ts", "usage_user"]))
+        fe.do_query("SET dist_partial_agg = 0", ctx)
+        try:
+            fe.do_query(tql, ctx)
+            dt_raw = timed()
+        finally:
+            fe.do_query("SET dist_partial_agg = 1", ctx)
+        speedup = dt_raw / dt_ir
+        assert speedup >= 3.0, (dt_ir, dt_raw, speedup)
+        wire_reduction = raw_bytes / max(partial_bytes, 1)
+        return (n / dt_ir, speedup, partial_bytes, raw_bytes,
+                wire_reduction)
+    finally:
+        for dn in datanodes.values():
+            dn.shutdown()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def emit_promql_dist_range():
+    n_rows = int(os.environ.get("GREPTIME_BENCH_PROMQL_ROWS", 2_000_000))
+    rps, vs_raw, partial_b, raw_b, reduction = \
+        bench_promql_dist_range(n_rows)
+    print(json.dumps({
+        "metric": "promql_dist_range_query_throughput",
+        "value": round(rps / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_raw_pull": round(vs_raw, 2),
+        "partial_wire_bytes": int(partial_b),
+        "raw_wire_bytes": int(raw_b),
+        "wire_byte_reduction": round(reduction, 1),
+        "rows": n_rows,
+        "datanodes": 4,
+    }))
+
+
 def bench_region_migration_availability(n_rows: int):
     """Sixth driver metric (ISSUE 9): migrate a region between datanodes
     UNDER sustained single-row ingest and measure availability:
@@ -1386,6 +1502,9 @@ def main():
     if os.environ.get("GREPTIME_BENCH_ONLY") == "distagg":
         emit_dist_partial_agg()
         return
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "promql":
+        emit_promql_dist_range()
+        return
     if os.environ.get("GREPTIME_BENCH_ONLY") == "trace":
         emit_trace_store_overhead()
         return
@@ -1450,6 +1569,8 @@ def main():
     }))
 
     emit_dist_partial_agg()
+
+    emit_promql_dist_range()
 
     mig_rows = int(os.environ.get("GREPTIME_BENCH_MIGRATE_ROWS",
                                   1_000_000))

@@ -100,6 +100,12 @@ def _to_greptime_error(e: flight.FlightError) -> GreptimeError:
         # admission rejection crossing the wire: keep the type so a
         # routing frontend re-maps it to 429/server-busy, not 500
         return OverloadedError(msg)
+    from ..query.plan_codec import WIRE_UNSUPPORTED_MARKER
+    if WIRE_UNSUPPORTED_MARKER in msg:
+        # version-skewed plan rejected by an older datanode: keep the
+        # type so the frontend degrades the statement to the raw path
+        from ..errors import UnsupportedError
+        return UnsupportedError(msg)
     if "not found" in msg or "not on datanode" in msg:
         return TableNotFoundError(msg)
     return GreptimeError(msg)

@@ -1,4 +1,4 @@
-"""greptlint rules GL01-GL12: the project's load-bearing conventions.
+"""greptlint rules GL01-GL14: the project's load-bearing conventions.
 
 GL01-GL09 are per-file; GL10-GL12 are *interprocedural* — they consume
 the repo-wide call graph core.build_context assembles (exception-flow,
@@ -882,10 +882,59 @@ class _Line:
         self.col_offset = 0
 
 
+class UnsanctionedDataAccess(Rule):
+    id = "GL14"
+    title = ("promql/ and flow/ must not touch storage regions, the "
+             "device scan cache or raw scan_batches outside their "
+             "lowering modules — front ends reach data through the "
+             "plan IR (query/ir.py), never around it")
+
+    SCOPE = ("promql", "flow", "selftest")
+    #: the ONE sanctioned IR-lowering module per front end: all region /
+    #: scan-cache / raw-scan access under promql/ and flow/ lives there,
+    #: so fast-path coverage (scatter, pruning, fusion) cannot silently
+    #: fork per front end
+    EXEMPT = ("promql/lowering.py", "flow/lowering.py")
+
+    #: attribute accesses that reach storage underneath the IR
+    ATTRS = frozenset({"regions", "scan_batches"})
+    #: module-level names that bypass the IR entirely
+    NAMES = frozenset({"SCAN_CACHE"})
+
+    def check(self, mod, ctx):
+        if not _in_dirs(mod.rel, self.SCOPE):
+            return
+        if _is_module(mod.rel, self.EXEMPT):
+            return
+
+        def hit(node, what):
+            return mod.finding(
+                self.id, node,
+                f"{what} under {_segments(mod.rel)[-2]}/ bypasses the "
+                f"plan IR — move the access into the front end's "
+                f"lowering module (promql/lowering.py or "
+                f"flow/lowering.py) so it rides scatter/pruning/fusion "
+                f"and EXPLAIN stays truthful")
+
+        for node in mod.nodes(ast.Attribute):
+            if node.attr in self.ATTRS:
+                yield hit(node, f"`.{node.attr}` access")
+            elif node.attr in self.NAMES:
+                yield hit(node, f"`{node.attr}` access")
+        for node in mod.nodes(ast.Name):
+            if node.id in self.NAMES and \
+                    isinstance(node.ctx, ast.Load):
+                yield hit(node, f"`{node.id}` access")
+        for node in mod.nodes(ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in self.NAMES:
+                    yield hit(node, f"import of `{alias.name}`")
+
+
 ALL_RULES: List[Rule] = [
     SwallowedException(), BaseExceptionCaught(), BareRename(),
     UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
     UntracedHandler(), UnlockedModuleMutation(), AdhocMetricObject(),
     UntypedHandlerException(), UncancellableLoop(), DeadFailpoint(),
-    RootlessBackgroundJob(),
+    RootlessBackgroundJob(), UnsanctionedDataAccess(),
 ]

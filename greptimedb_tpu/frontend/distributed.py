@@ -907,6 +907,10 @@ class DistInstance:
         # information_schema.background_jobs fans out to every
         # reachable datanode and merges (compactions run THERE)
         self.catalog.dist_clients = clients
+        # TQL / PromQL rides the same engine as standalone: selectors
+        # resolve DistTables from this catalog, and the lowering in
+        # promql/lowering.py ships TpuPlans through execute_tpu_plan
+        self._tql_engine = None
 
     def _create_flow_sink(self, spec, schema, pk_indices):
         """Materialize a flow sink as an ordinary distributed table."""
@@ -1298,7 +1302,26 @@ class DistInstance:
             return apply_kill(stmt)
         if isinstance(stmt, ast.Admin):
             return self._admin(stmt, ctx)
+        if isinstance(stmt, ast.Tql):
+            return self.promql_engine().execute_tql(stmt, ctx)
         return self.query_engine.execute(stmt, ctx)
+
+    def promql_engine(self):
+        """Lazily-built, shared PromQL engine (TQL + /api/v1 + /v1/promql).
+
+        Same engine as standalone: its selectors resolve DistTables from
+        this frontend's catalog, so lowerable aggregates scatter TpuPlans
+        to the datanodes and non-lowerable shapes ride the IR raw scan
+        (region pruning + wire filter pushdown)."""
+        if self._tql_engine is None:
+            try:
+                from ..promql.engine import PromqlEngine
+            except ImportError as e:
+                from ..errors import UnsupportedError
+                raise UnsupportedError(
+                    f"PromQL engine unavailable: {e}") from e
+            self._tql_engine = PromqlEngine(self.catalog)
+        return self._tql_engine
 
     def _admin(self, stmt: ast.Admin, ctx: QueryContext):
         """ADMIN MIGRATE/SPLIT/REBALANCE → meta balancer ops. Async by

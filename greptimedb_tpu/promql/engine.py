@@ -192,25 +192,40 @@ class PromqlEngine:
         lookback = _parse_tql_duration(stmt.lookback) if stmt.lookback \
             else DEFAULT_LOOKBACK_MS
         expr = parse_promql(stmt.query)
-        if stmt.kind == "explain":
-            return self._explain_output(expr, None)
         ev = _Eval(self, ctx, start_ms, end_ms, step_ms, lookback)
+        if stmt.kind == "explain":
+            return self._explain_output(expr, None, ev=ev)
         if stmt.kind == "analyze":
             import time as _time
+
+            from ..common import exec_stats
+            stats = exec_stats.ExecStats()
             t0 = _time.perf_counter()
-            val = ev.eval(expr)
+            with exec_stats.collect(stats):
+                val = ev.eval(expr)
             elapsed_ms = (_time.perf_counter() - t0) * 1e3
             nseries = len(getattr(val, "labels", [])) or 1
             return self._explain_output(expr, {
                 "elapsed_ms": round(elapsed_ms, 2),
-                "series": nseries, "steps": len(ev.steps)})
+                "series": nseries, "steps": len(ev.steps),
+                "stats": stats}, ev=ev)
         val = ev.eval(expr)
         return _to_record_batches(val, ev.steps)
 
-    def _explain_output(self, expr, analyze: Optional[dict]) -> Output:
-        """TQL EXPLAIN / ANALYZE (reference: tql_parser.rs parses all
-        three verbs; EXPLAIN shows the plan the planner built). Renders
-        the evaluation plan tree, one node per line."""
+    def explain_lines(self, query: str, start_ms: int, end_ms: int,
+                      step_ms: int, ctx: Optional[QueryContext] = None,
+                      lookback_ms: int = DEFAULT_LOOKBACK_MS) -> List[str]:
+        """The plan/dispatch lines TQL EXPLAIN renders, as a list — the
+        HTTP API's ?explain=1 surface (servers/prom_api)."""
+        ctx = ctx or QueryContext()
+        expr = parse_promql(query)
+        ev = _Eval(self, ctx, start_ms, end_ms, step_ms, lookback_ms)
+        return self._plan_lines(expr, ev)
+
+    def _plan_lines(self, expr, ev: Optional["_Eval"]) -> List[str]:
+        """The EXPLAIN text: the evaluation plan tree, one node per
+        line, then the same dispatch stages SQL's EXPLAIN prints for
+        the statement's lowered (or row-path) scan."""
         lines: List[str] = []
 
         def walk(e, depth):
@@ -247,12 +262,34 @@ class PromqlEngine:
                     walk(child, depth + 1)
 
         walk(expr, 0)
+        if ev is not None:
+            from . import lowering
+            lines.extend(lowering.explain_lines(ev, expr))
+        return lines
+
+    def _explain_output(self, expr, analyze: Optional[dict],
+                        ev: Optional["_Eval"] = None) -> Output:
+        """TQL EXPLAIN / ANALYZE (reference: tql_parser.rs parses all
+        three verbs; EXPLAIN shows the plan the planner built)."""
+        lines = self._plan_lines(expr, ev)
         rows = {"plan_type": ["logical_plan"], "plan": ["\n".join(lines)]}
         if analyze is not None:
+            analyzed = (f"elapsed: {analyze['elapsed_ms']}ms, series: "
+                        f"{analyze['series']}, steps: {analyze['steps']}")
+            stats = analyze.get("stats")
+            if stats is not None:
+                # the executed dispatch + per-stage breakdown, same
+                # collector SQL's EXPLAIN ANALYZE renders
+                tbl = stats.rows_table()
+                for st, rows_, ms, detail in zip(
+                        tbl.get("stage", []), tbl.get("rows", []),
+                        tbl.get("elapsed_ms", []),
+                        tbl.get("detail", [])):
+                    analyzed += (f"\n{st}: rows={rows_}, "
+                                 f"elapsed: {ms}ms"
+                                 f"{', ' + detail if detail else ''}")
             rows["plan_type"].append("analyze")
-            rows["plan"].append(
-                f"elapsed: {analyze['elapsed_ms']}ms, series: "
-                f"{analyze['series']}, steps: {analyze['steps']}")
+            rows["plan"].append(analyzed)
         schema = Schema([ColumnSchema("plan_type", dt.STRING),
                          ColumnSchema("plan", dt.STRING)])
         return Output.record_batches(
@@ -286,185 +323,13 @@ class PromqlEngine:
     def select(self, sel: VectorSelector, lo_ms: int, hi_ms: int,
                ctx: QueryContext) -> _Selection:
         """Fetch samples for a selector in the closed window [lo_ms, hi_ms]
-        as a dense SeriesMatrix sorted by time within each series."""
-        from ..ops.window import SeriesMatrix
+        as a dense SeriesMatrix sorted by time within each series.
 
-        metric = sel.metric
-        for m in sel.matchers:
-            if m.name == "__name__" and m.op == "=":
-                metric = m.value
-        if not metric:
-            raise UnsupportedError(
-                "selector without metric name is not supported")
-        table = self.catalog.table(ctx.current_catalog, ctx.current_schema,
-                                   metric)
-        if table is None:
-            return _Selection([], None)
-        if not hasattr(table, "regions"):
-            raise UnsupportedError(f"{metric} is not a region-backed table")
-
-        schema = table.schema
-        tag_names = schema.tag_names()
-        tagset = set(tag_names)
-        fields = [f for f in schema.field_names()
-                  if not schema.column_schema(f).dtype.is_string and
-                  not schema.column_schema(f).dtype.is_binary]
-        if not fields:
-            return _Selection([], None)
-        field_matchers = []
-        for m in sel.matchers:
-            if m.name == "__field__":
-                field_matchers.append(m)
-        for fm in field_matchers:
-            keep = _matcher_keep(fields, fm)
-            fields = [f for f, k in zip(fields, keep) if k]
-        multi_field = len(fields) > 1
-
-        from ..query.tpu_exec import SCAN_CACHE
-
-        key_to_gid: Dict[tuple, int] = {}
-        glabels: List[Dict[str, str]] = []
-        parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-
-        eq_matchers = [m for m in sel.matchers
-                       if m.op == "=" and m.name in tagset and m.value]
-        for region in table.regions.values():
-            sid_set = self._matcher_sids(region, tag_names, eq_matchers)
-            if sid_set is not None and len(sid_set) == 0:
-                continue                 # no series of this region match
-            scan = self._region_scan(region, fields, lo_ms, hi_ms,
-                                     sid_set=sid_set)
-            if scan is None or scan.num_rows == 0:
-                continue
-            sd = scan.series_dict
-            S = sd.num_series
-            if S == 0:
-                continue
-            ids = np.arange(S, dtype=np.int32)
-            tag_cols = [sd.decode_tag_column(ids, i)
-                        for i in range(len(tag_names))]
-            tag_strs = [[_label_str(v) for v in col] for col in tag_cols]
-            keep = np.ones(S, dtype=bool)
-            for m in sel.matchers:
-                if m.name in ("__name__", "__field__"):
-                    continue
-                if m.name not in tagset:
-                    # matching a non-existent label: only ""-matching ops keep
-                    if not _matches_empty(m):
-                        keep[:] = False
-                    continue
-                keep &= _matcher_keep(tag_strs[tag_names.index(m.name)], m)
-            if not keep.any():
-                continue
-            row_keep = keep[scan.series_ids] & (scan.ts >= lo_ms) & \
-                (scan.ts <= hi_ms)
-            if not row_keep.any():
-                continue
-            for fi, fname in enumerate(fields):
-                vals, valid = scan.fields[fname]
-                rk = row_keep if valid is None else (row_keep & valid)
-                if not rk.any():
-                    continue
-                sids = scan.series_ids[rk]
-                ts = scan.ts[rk]
-                v = vals[rk].astype(np.float64)
-                # map region series → global series ids
-                uniq = np.unique(sids)
-                remap = np.full(S, -1, dtype=np.int32)
-                for s in uniq:
-                    lbl_key = tuple(tag_strs[i][s]
-                                    for i in range(len(tag_names)))
-                    gkey = lbl_key + ((fname,) if multi_field else ())
-                    gid = key_to_gid.get(gkey)
-                    if gid is None:
-                        gid = len(glabels)
-                        key_to_gid[gkey] = gid
-                        lbl = {"__name__": metric}
-                        for tn, tv in zip(tag_names, lbl_key):
-                            if tv != "":
-                                lbl[tn] = tv
-                        if multi_field:
-                            lbl["__field__"] = fname
-                        glabels.append(lbl)
-                    remap[s] = gid
-                parts.append((remap[sids], ts, v))
-
-        if not parts:
-            return _Selection([], None)
-        gids = np.concatenate([p[0] for p in parts])
-        ts = np.concatenate([p[1] for p in parts])
-        vals = np.concatenate([p[2] for p in parts])
-        # already sorted when a single region/field contributed in order
-        if len(parts) > 1 or not _is_sorted(gids, ts):
-            order = np.lexsort((ts, gids))
-            gids, ts, vals = gids[order], ts[order], vals[order]
-        sm = SeriesMatrix.build(gids, ts, vals, len(glabels))
-        return _Selection(glabels, sm, int(ts.min()), int(ts.max()))
-
-    @staticmethod
-    def _matcher_sids(region, tag_names, eq_matchers):
-        """Sorted candidate sid superset for the selector's equality
-        matchers in one region, or None when there is nothing selective
-        to resolve — what lets the cold selector path prune whole SSTs
-        through their index sidecars. Label values are matched on the
-        same string rendering the keep-mask uses, so numeric tags
-        resolve identically on both paths."""
-        from ..storage.index import sst_index_enabled
-        if not eq_matchers or not sst_index_enabled():
-            return None
-        sd = getattr(region, "series_dict", None)
-        if sd is None or not sd.tag_names:
-            return None
-        cand = None
-        for m in eq_matchers:
-            ti = tag_names.index(m.name)
-            # O(1) dictionary hit for string tags (the common case);
-            # the O(values) rendered-label scan only runs for tags whose
-            # stored values are not strings
-            vid = sd.tag_dicts[ti].get(m.value)
-            if vid is not None:
-                ids = [vid]
-            else:
-                ids = [i for i, v in
-                       enumerate(sd.tag_dicts[ti].values())
-                       if v is not None and not isinstance(v, str) and
-                       _label_str(v) == m.value]
-            sids = sd.sids_for_value_ids(ti, ids)
-            cand = sids if cand is None else \
-                np.intersect1d(cand, sids, assume_unique=True)
-            if len(cand) == 0:
-                break
-        return cand
-
-    def _region_scan(self, region, fields: List[str], lo_ms: int,
-                     hi_ms: int, sid_set=None):
-        """Rows for one region: the device-resident scan cache for warm
-        regions; a window-bounded streamed cold read for regions past the
-        streaming threshold (VERDICT gap: the PromQL path was hard-wired
-        to the resident cache, so a range query over a huge cold region
-        paid — and pinned — full residency for a small time window).
-        Both shapes expose series_ids/ts/fields/series_dict."""
-        from ..common.telemetry import increment_counter
-        from ..common.time import TimestampRange
-        from ..query.tpu_exec import SCAN_CACHE, region_streams_cold
-
-        if not region_streams_cold(region):
-            increment_counter("promql_select_resident")
-            return SCAN_CACHE.get(region)
-        # cold path: merged host read of only the selector's window and
-        # fields — proportional to the window, never enters the scan
-        # cache, leaves no device residency behind
-        increment_counter("promql_select_streamed")
-        from ..common import exec_stats
-        with exec_stats.stage("promql_cold_scan", region=region.name):
-            # equality matchers ride the SST index: whole files whose
-            # blooms exclude every candidate series never decode
-            data = region.snapshot().read_merged(
-                projection=list(fields),
-                time_range=TimestampRange(lo_ms, hi_ms + 1),
-                sid_set=sid_set)
-        exec_stats.record("promql_cold_scan", rows=data.num_rows)
-        return data
+        All data access lives in promql/lowering.py — the one module
+        under promql/ sanctioned (greptlint GL14) to touch regions, the
+        device scan cache and raw scan_batches."""
+        from . import lowering
+        return lowering.select_series(self, sel, lo_ms, hi_ms, ctx)
 
 
 def _label_str(v) -> str:
@@ -1074,7 +939,15 @@ class _Eval:
 
     # -- aggregation --
     def _aggregate(self, e: Aggregate):
-        v = self.eval(e.expr)
+        # lowered fast path: aggregate-over-selector shapes rebuild the
+        # inner instant vector from the plan IR's moment fold (per-group
+        # frames instead of raw samples); anything the lowering declines
+        # — or that the executor degrades (cost-based raw-pull, version
+        # skew, sketch decode) — evaluates on the proven row path
+        from . import lowering
+        v = lowering.try_lowered_inner(self, e)
+        if v is None:
+            v = self.eval(e.expr)
         if not isinstance(v, VectorVal):
             raise PromqlParseError(f"{e.op} expects an instant vector")
         param = None

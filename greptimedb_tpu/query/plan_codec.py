@@ -7,6 +7,17 @@ against its local catalog (df_substrait.rs:31, consumed by
 src/datanode/src/instance/grpc.rs:62-83). Here the shipped plan is the
 TpuPlan (tag groups + time bucket + moments + predicates) — the unit of
 aggregate pushdown — encoded as JSON-safe dicts.
+
+Rolling upgrades: every front end (SQL, PromQL, flows) now ships plans
+through this codec, so skew handling is uniform. Decode validates each
+moment/final op against KNOWN_*_OPS and fails closed — an old datanode
+rejects a plan carrying an op it predates (typed UnsupportedError, the
+WIRE_UNSUPPORTED_MARKER survives Flight), and the frontend degrades
+that one statement to the raw-row path for a correct (slower) answer.
+Upgrade datanodes before frontends: the window where new plan shapes
+degrade is exactly the rollout window. Adding an op = add it to the
+reducers AND these sets in the same release; never reuse a name with
+different semantics.
 """
 
 from __future__ import annotations
@@ -19,6 +30,26 @@ from ..sql.ast import (
     Literal, UnaryOp,
 )
 from .tpu_exec import BucketGroup, FieldFilter, Moment, TagGroup, TpuPlan
+
+#: every moment op this build's reducers implement, and every final op
+#: _finalize knows how to render. plan_from_dict VALIDATES against these
+#: on decode so version skew fails closed: a datanode that predates a
+#: new op rejects the plan with a typed UnsupportedError (carrying
+#: WIRE_UNSUPPORTED_MARKER across Flight), the frontend degrades the
+#: statement to the raw-row path, and no stale reducer ever folds a
+#: moment it half-understands into a wrong answer.
+KNOWN_MOMENT_OPS = frozenset({
+    "sum", "sum_sq", "count", "min", "max", "first", "last",
+    "min_ts", "max_ts", "distinct", "tdigest", "reset_corr"})
+KNOWN_FINAL_OPS = frozenset({
+    "sum", "avg", "count", "min", "max", "first", "last", "stddev",
+    "variance", "count_distinct", "approx_distinct", "approx_percentile",
+    "moment"})
+
+#: substring marker that survives Flight's string-flattened errors —
+#: client/flight.py rebuilds UnsupportedError from it, the same scheme
+#: StaleRouteError / OverloadedError use
+WIRE_UNSUPPORTED_MARKER = "unsupported shipped plan"
 
 
 def expr_to_dict(e: Optional[Expr]) -> Optional[dict]:
@@ -107,6 +138,16 @@ def plan_to_dict(plan: TpuPlan) -> dict:
 
 
 def plan_from_dict(d: dict) -> TpuPlan:
+    for m in d["moments"]:
+        if m["op"] not in KNOWN_MOMENT_OPS:
+            raise UnsupportedError(
+                f"{WIRE_UNSUPPORTED_MARKER}: moment op {m['op']!r} "
+                f"(datanode predates it; upgrade datanodes first)")
+    for _slot, op, _mslots in d["finals"]:
+        if op not in KNOWN_FINAL_OPS:
+            raise UnsupportedError(
+                f"{WIRE_UNSUPPORTED_MARKER}: final op {op!r} "
+                f"(datanode predates it; upgrade datanodes first)")
     return TpuPlan(
         tag_groups=[TagGroup(t["name"], t["tag_index"])
                     for t in d["tag_groups"]],

@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
-from ..errors import SketchCodecError, UnsupportedError
+from ..errors import UnsupportedError
 from ..ops.kernels import merge_dedup_numpy, shape_bucket, sorted_grouped_aggregate
 from ..sql.ast import (
     Between, BinaryOp, Column, Expr, FunctionCall, InList, Interval, IsNull,
@@ -558,6 +558,14 @@ class Moment:
 #: number — built on the host, merged by _finalize through the codec
 SKETCH_MOMENT_OPS = frozenset({"distinct", "tdigest"})
 
+#: numeric moment ops only the host reducer implements (no device
+#: kernel): `reset_corr` is PromQL's counter-reset correction — the sum
+#: of the pre-reset value over adjacent valid sample pairs within a run
+#: where the later sample is smaller (ops/window.py rate kernel:
+#: `where(pair_ok & (val < prev), prev, 0)`), so
+#: increase = last - first + reset_corr folds like any other moment
+HOST_ONLY_MOMENT_OPS = frozenset({"reset_corr"})
+
 
 @dataclass
 class TpuPlan:
@@ -590,7 +598,8 @@ def plan_needs_host(plan: "TpuPlan") -> bool:
     expression columns both do. The partial-frame ALGEBRA is unchanged —
     host partials fold exactly like device partials."""
     return bool(plan.field_exprs) or \
-        any(m.op in SKETCH_MOMENT_OPS for m in plan.moments)
+        any(m.op in SKETCH_MOMENT_OPS or m.op in HOST_ONLY_MOMENT_OPS
+            for m in plan.moments)
 
 
 def plan_scan_columns(plan: "TpuPlan", schema) -> List[str]:
@@ -728,6 +737,28 @@ def _is_expr_arg(e: Expr, field_names: set, schema) -> bool:
     return ok(e)
 
 
+def standard_final(op: str, col: Optional[str], moment):
+    """(final op, moment slots) for one standard aggregate through the
+    `moment(op, column) -> slot` dedupe closure — the ONE op→moment
+    mapping SQL planning (plan_for), PromQL lowering (promql/lowering)
+    and flow compilation (flow/lowering) share, so no front end can
+    teach the fold a private dialect. A count moment rides along with
+    sum/min/max so empty groups finalize to NULL, not 0."""
+    if op == "count":
+        return "count", [moment("count", col)]
+    if op in ("sum", "avg"):
+        return op, [moment("sum", col), moment("count", col)]
+    if op in ("min", "max"):
+        return op, [moment(op, col), moment("count", col)]
+    if op in ("stddev", "variance"):
+        return op, [moment("sum", col), moment("sum_sq", col),
+                    moment("count", col)]
+    if op in ("first", "last"):
+        mts = moment("min_ts" if op == "first" else "max_ts", col)
+        return op, [moment(op, col), mts]
+    return None
+
+
 def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
     """Return a TpuPlan if (table, query) fits the fast-path shape."""
     if table is None or not a.is_aggregate or query.joins:
@@ -838,27 +869,10 @@ def plan_for(table, a: Analysis, query: Query) -> Optional[TpuPlan]:
                            [moment("tdigest", col)]))
             agg_params[call.slot] = (p,)
             continue
-        if op == "count":
-            finals.append((call.slot, "count", [moment("count", col)]))
-        elif op == "sum":
-            # count comes along so empty groups finalize to NULL, not 0
-            finals.append((call.slot, "sum",
-                           [moment("sum", col), moment("count", col)]))
-        elif op == "avg":
-            finals.append((call.slot, "avg",
-                           [moment("sum", col), moment("count", col)]))
-        elif op in ("min", "max"):
-            finals.append((call.slot, op,
-                           [moment(op, col), moment("count", col)]))
-        elif op in ("stddev", "variance"):
-            finals.append((call.slot, op,
-                           [moment("sum", col), moment("sum_sq", col),
-                            moment("count", col)]))
-        elif op in ("first", "last"):
-            mts = moment("min_ts" if op == "first" else "max_ts", col)
-            finals.append((call.slot, op, [moment(op, col), mts]))
-        else:
+        std = standard_final(op, col, moment)
+        if std is None:
             return None
+        finals.append((call.slot, std[0], std[1]))
 
     # WHERE decomposition
     time_lo = time_hi = None
@@ -1104,7 +1118,6 @@ def configure_partial_pushdown(*, enabled: Optional[bool] = None) -> None:
 
 def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
     from ..common import exec_stats
-    from ..common.telemetry import span, timer
 
     plan = plan_for(table, a, query)
     if plan is None:
@@ -1119,60 +1132,13 @@ def try_execute(table, a: Analysis, query: Query) -> Optional[pd.DataFrame]:
                 f"cpu-small-scan (est_rows={est} < "
                 f"dispatch_floor={_dispatch_min_rows()})")
             return None
+    # the ONE aggregate-node executor all three front ends share
+    # (query/ir.py): scatter or local dispatch, then the moment fold
+    from .ir import execute_agg_plan
     try:
-        if hasattr(table, "execute_tpu_plan"):
-            # distributed: aggregate pushdown — datanodes reduce their
-            # regions, the frontend folds moment frames (_finalize).
-            # The table names its own scatter (pruning + fan-out) when it
-            # can, so EXPLAIN and execution print the same decision.
-            exec_stats.set_dispatch(dispatch_decision_for_pushdown(
-                table, plan))
-            with span("tpu_pushdown", table=table.name), \
-                    timer("tpu_pushdown"):
-                frames = [f for f in table.execute_tpu_plan(plan)
-                          if f is not None and len(f)]
-        else:
-            import time as _time
-            t0 = _time.perf_counter()
-            with span("tpu_execute", table=table.name), \
-                    timer("tpu_execute"):
-                frames = region_moment_frames(table, plan)
-            _note_device_query_time(_time.perf_counter() - t0)
+        return execute_agg_plan(table, plan)
     except UnsupportedError:
         return None
-    if not frames:
-        cols = [_group_slot(t.name) for t in plan.tag_groups]
-        if plan.bucket:
-            cols.append(_group_slot(plan.bucket.expr_key))
-        if cols:
-            return pd.DataFrame(columns=cols +
-                                [slot for slot, _, _ in plan.finals])
-        # global aggregate over zero rows still yields one row
-        row = {slot: (0 if op in ("count", "count_distinct",
-                                  "approx_distinct") else np.nan)
-               for slot, op, _ in plan.finals}
-        return pd.DataFrame([row])
-    with exec_stats.stage("finalize", partial_frames=len(frames),
-                          partial_bytes=frames_nbytes(frames),
-                          aggs=_aggs_desc(plan)):
-        merged = pd.concat(frames, ignore_index=True)
-        try:
-            out = _finalize(merged, plan)
-        except SketchCodecError as e:
-            # a corrupt/truncated sketch partial must NEVER become a
-            # wrong answer: count the degrade and fall back to the
-            # raw-row path (the engine re-plans this statement as a
-            # plain scan + CPU aggregate)
-            import logging
-            from ..common.telemetry import increment_counter
-            increment_counter("sketch_degrade")
-            exec_stats.record("sketch_degrade", error=str(e)[:120])
-            logging.getLogger(__name__).warning(
-                "sketch partial failed to decode (%s); retrying %s via "
-                "the raw-row path", e, table.name)
-            return None
-    exec_stats.record("finalize", rows=len(out))
-    return out
 
 
 #: finals whose result comes out of a sketch partial, not a numeric fold
@@ -1748,6 +1714,18 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
                     out[slot] = nn.loc[nn[ts_slot].idxmin(), slot]
                 else:
                     out[slot] = nn.loc[nn[ts_slot].idxmax(), slot]
+            elif m.op == "reset_corr":
+                # partials are time-disjoint slices of one series run:
+                # total correction = per-slice corrections + each slice
+                # boundary that itself crosses a counter reset
+                # (first-of-next < last-of-prev contributes the prev)
+                g = group.sort_values(_ts_slot_for(m, "min_ts"),
+                                      kind="stable")
+                prev = g[_ts_slot_for(m, "last")].shift()
+                cur = g[_ts_slot_for(m, "first")]
+                cross = (cur < prev) & cur.notna() & prev.notna()
+                out[slot] = g[slot].sum() + \
+                    prev.where(cross, 0.0).fillna(0.0).sum()
         return pd.Series(out)
 
     if key_cols:
@@ -1760,9 +1738,12 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
             aggs = {}
             extremes = []
             sketches = []
+            resets = []
             for slot, m in moment_cols.items():
                 if m.op in SKETCH_MOMENT_OPS:
                     sketches.append(slot)
+                elif m.op == "reset_corr":
+                    resets.append((slot, m))
                 elif m.op in ("sum", "sum_sq", "count"):
                     aggs[slot] = "sum"
                 elif m.op in ("min", "min_ts"):
@@ -1786,6 +1767,20 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
                 # fold encoded partials per group through the codec
                 # (bytes in, bytes out — pandas treats bytes as scalars)
                 merged[slot] = gb[slot].agg(_merge_sketch_cells)
+            for slot, m in resets:
+                # per-group partials sorted by slice start: corrections
+                # add, plus the prev-last where a slice boundary itself
+                # crosses a reset (first-of-next < last-of-prev)
+                srt = df.sort_values(_ts_slot_for(m, "min_ts"),
+                                     kind="stable")
+                gs = srt.groupby(key_cols, dropna=False, sort=False)
+                prev = gs[_ts_slot_for(m, "last")].shift()
+                cur = srt[_ts_slot_for(m, "first")]
+                cross = (cur < prev) & cur.notna() & prev.notna()
+                bonus = prev.where(cross, 0.0).fillna(0.0)
+                merged[slot] = gs[slot].sum() + bonus.groupby(
+                    [srt[k] for k in key_cols], dropna=False,
+                    sort=False).sum()
             merged = merged.reset_index()
         else:
             merged = df
@@ -1796,7 +1791,9 @@ def _finalize(df: pd.DataFrame, plan: TpuPlan) -> pd.DataFrame:
     out = merged[key_cols].copy() if key_cols else pd.DataFrame(
         index=merged.index)
     for slot, op, mslots in plan.finals:
-        if op in ("sum", "min", "max", "first", "last"):
+        if op in ("sum", "min", "max", "first", "last", "moment"):
+            # "moment": raw merged-moment passthrough — PromQL's rate
+            # finalization reads min_ts/max_ts/reset_corr directly
             out[slot] = merged[mslots[0]]
         elif op == "count":
             out[slot] = merged[mslots[0]].astype(np.int64)

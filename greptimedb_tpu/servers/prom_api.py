@@ -42,6 +42,18 @@ async def _eval(server, request, *, instant: bool):
             step_ms = parse_prom_duration(step_raw)
         engine = server.frontend.promql_engine()
         loop = asyncio.get_running_loop()
+        explain = (await server._param(request, "explain")) in (
+            "1", "true", "yes")
+        if explain:
+            # ?explain=1: render the plan the way SQL's EXPLAIN does —
+            # the Prom expression tree plus the IR node each aggregate
+            # lowered to (TpuAggregateExec / RawScan) and its dispatch
+            lines = await loop.run_in_executor(
+                None, lambda: engine.explain_lines(
+                    query, start_ms, end_ms, step_ms, ctx))
+            return web.json_response(
+                {"status": "success",
+                 "data": {"resultType": "explain", "result": lines}})
         result = await loop.run_in_executor(
             None, lambda: engine.query_to_prom_json(
                 query, start_ms, end_ms, step_ms, ctx, instant=instant))

@@ -35,11 +35,6 @@ SELECT host, ts, v_sum, v_cnt FROM cpu_flow_1m ORDER BY host, ts;
 
 SHOW FLOWS;
 
--- error: avg is not incrementally mergeable (store sum + count)
-CREATE FLOW bad_avg AS
-    SELECT avg(v) FROM cpu_flow
-    GROUP BY date_bin(INTERVAL '1 minute', ts);
-
 -- error: non-derivable aggregate
 CREATE FLOW bad_agg AS
     SELECT stddev(v) FROM cpu_flow
@@ -66,6 +61,24 @@ SHOW FLOWS;
 DROP FLOW cpu_flow_1m;
 
 DROP FLOW IF EXISTS cpu_flow_1m;
+
+-- avg flows are accepted: every fold recomputes whole buckets from the
+-- source rows, so the stored avg is exact (never an avg of avgs)
+CREATE FLOW cpu_flow_avg AS
+    SELECT host, date_bin(INTERVAL '1 minute', ts) AS b,
+           avg(v) AS v_avg, sum(v) AS v_sum, count(v) AS v_cnt
+    FROM cpu_flow GROUP BY host, b;
+
+-- this avg query is served from the rollup; its refresh folds the
+-- pending source rows, storing the exact per-bucket avg in the sink
+SELECT host, date_bin(INTERVAL '1 minute', ts) AS b, avg(v)
+FROM cpu_flow GROUP BY host, b ORDER BY host, b;
+
+SELECT host, ts, v_avg FROM cpu_flow_avg ORDER BY host, ts;
+
+DROP FLOW cpu_flow_avg;
+
+DROP TABLE cpu_flow_avg;
 
 DROP TABLE cpu_flow_1m;
 

@@ -1,0 +1,19 @@
+CREATE TABLE cpu_seconds (host STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, PRIMARY KEY(host));
+
+INSERT INTO cpu_seconds VALUES
+    ('web1', 0, 1.0), ('web1', 60000, 7.0), ('web1', 120000, 13.0),
+    ('web2', 0, 2.0), ('web2', 60000, 12.0), ('web2', 120000, 22.0);
+
+TQL EXPLAIN (0, 120, '60s') sum by (host) (rate(cpu_seconds[1m]));
+
+SET tpu_dispatch_min_rows = 0;
+
+TQL EXPLAIN (0, 120, '60s') sum by (host) (rate(cpu_seconds[1m]));
+
+TQL EXPLAIN (0, 120, '60s') avg(cpu_seconds);
+
+TQL EXPLAIN (0, 120, '60s') topk(1, cpu_seconds);
+
+SET tpu_dispatch_min_rows = 131072;
+
+DROP TABLE cpu_seconds;

@@ -93,10 +93,6 @@ class TestFlowDdl:
 
     def test_create_flow_errors(self, fe):
         _mk_cpu(fe, 10)
-        # avg is not mergeable — the error teaches the sum+count idiom
-        with pytest.raises(UnsupportedError, match="sum.*count"):
-            fe.do_query("CREATE FLOW f AS SELECT avg(v) FROM cpu "
-                        "GROUP BY date_bin(INTERVAL '1 minute', ts)")
         with pytest.raises(UnsupportedError, match="not derivable"):
             fe.do_query("CREATE FLOW f AS SELECT stddev(v) FROM cpu "
                         "GROUP BY date_bin(INTERVAL '1 minute', ts)")

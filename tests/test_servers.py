@@ -556,6 +556,21 @@ class TestPromApiQuery:
         hosts = {e.get("host") for e in data["data"]}
         assert hosts == {"h0", "h1"}
 
+    def test_query_range_explain_param(self, server):
+        """?explain=1 returns the plan/dispatch lines instead of data —
+        the HTTP twin of TQL EXPLAIN (ISSUE 16)."""
+        self._seed(server)
+        status, body = req(server, "/api/v1/query_range", params={
+            "query": "sum by (host) (rate(qcpu[1m]))", "start": "0",
+            "end": "240", "step": "60", "explain": "1"})
+        assert status == 200, body
+        data = json.loads(body)
+        assert data["status"] == "success"
+        assert data["data"]["resultType"] == "explain"
+        joined = "\n".join(data["data"]["result"])
+        assert "PromSeriesScan: qcpu" in joined
+        assert "Dispatch:" in joined
+
 
 class TestAdminCompact:
     def test_flush_then_compact_endpoint(self, server):
