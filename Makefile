@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
-	bench-index bench-distagg bench-trace bench-promql
+	bench-index bench-distagg bench-trace bench-promql bench-prof prof
 
 check: lint typecheck test
 
@@ -75,6 +75,20 @@ bench-trace:
 # wire-byte reduction
 bench-distagg:
 	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=distagg $(PY) bench.py
+
+# only the ISSUE 17 metric: mixed bulk-ingest + point-query throughput
+# with the continuous profiler sampling at the default 19 Hz vs off
+# (asserts <3% overhead)
+bench-prof:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=prof $(PY) bench.py
+
+# quick continuous-profiling demo: boots a standalone frontend with
+# `SET profiling = 1`, runs a short mixed workload and prints the
+# ADMIN SHOW PROFILE 'last' tree (ISSUE 17)
+prof:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	  tests/test_profiler.py -q -k standalone_end_to_end \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 # only the ISSUE 16 metric: 4-datanode PromQL range query
 # `sum by (hostname) (rate(...))` through the plan-IR pushdown vs the

@@ -462,6 +462,88 @@ def bench_trace_store_overhead(n_rows: int):
             n_queries / q_def)
 
 
+def bench_profiler_overhead(n_rows: int):
+    """Eleventh driver metric (ISSUE 17): mixed bulk-ingest + small-query
+    throughput with the continuous profiler sampling at the default
+    19 Hz, against the sampler disabled. The sampler holds the GIL for
+    each sys._current_frames() walk, so the bill is real but bounded by
+    the rate — the <3% bar binds at the default."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.common import profiler
+
+    rng = np.random.default_rng(23)
+    hosts = 200
+    per = n_rows // hosts
+    host = np.repeat(np.array([f"host_{i}" for i in range(hosts)]),
+                     per).astype(object)
+    ts = np.tile(np.arange(per, dtype=np.int64) * 1000, hosts)
+    vals = rng.random(hosts * per)
+    n_queries = 300
+
+    def run_once(enabled: bool) -> float:
+        """Wall seconds for one ingest + query pass, profiler on/off.
+        The flush that persists the sampled window is TIMED — it is
+        part of the feature's bill exactly like the trace store's."""
+        from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                      DatanodeOptions)
+        from greptimedb_tpu.frontend.instance import FrontendInstance
+        tmpdir = tempfile.mkdtemp(prefix="bench-prof-")
+        try:
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=tmpdir, register_numbers_table=False,
+                self_monitor_interval_s=0))
+            dn.start()
+            fe = FrontendInstance(dn)
+            fe.start()
+            profiler.configure(enabled=enabled, hz=19.0)
+            fe.do_query("CREATE TABLE cpu (hostname STRING, ts TIMESTAMP "
+                        "TIME INDEX, usage_user DOUBLE, "
+                        "PRIMARY KEY(hostname))")
+            table = fe.catalog.table("greptime", "public", "cpu")
+            t0 = time.perf_counter()
+            table.bulk_load({"hostname": host, "ts": ts,
+                             "usage_user": vals})
+            for i in range(n_queries):
+                fe.do_query(f"SELECT usage_user FROM cpu WHERE "
+                            f"hostname = 'host_{i % hosts}' LIMIT 5")
+            if enabled:
+                fe.profiler.flush()
+            dt = time.perf_counter() - t0
+            fe.shutdown()
+            return dt
+        finally:
+            profiler.configure(enabled=False)
+            profiler.install(None)
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    run_once(False)                              # absorb one-time costs
+    best = {"off": float("inf"), "on": float("inf")}
+    for _ in range(2):                           # interleaved best-of-2
+        best["off"] = min(best["off"], run_once(False))
+        best["on"] = min(best["on"], run_once(True))
+    overhead = best["on"] / best["off"] - 1.0
+    return overhead, len(ts) / best["on"], n_queries / best["on"]
+
+
+def emit_profiler_overhead():
+    rows = int(os.environ.get("GREPTIME_BENCH_PROF_ROWS", 2_000_000))
+    overhead, rps, qps = bench_profiler_overhead(rows)
+    assert overhead < 0.03, (
+        f"continuous profiler costs {overhead:.1%} at the default "
+        f"19 Hz — the bar is <3%")
+    print(json.dumps({
+        "metric": "profiler_overhead",
+        "value": round(overhead * 100, 2),
+        "unit": "percent",
+        "sample_hz": 19.0,
+        "ingest_mrows_s_sampling": round(rps / 1e6, 2),
+        "point_qps_sampling": round(qps, 1),
+        "rows": rows,
+    }))
+
+
 def emit_trace_store_overhead():
     rows = int(os.environ.get("GREPTIME_BENCH_TRACE_ROWS", 2_000_000))
     rps, overhead_default, overhead_full, qps = \
@@ -1508,6 +1590,9 @@ def main():
     if os.environ.get("GREPTIME_BENCH_ONLY") == "trace":
         emit_trace_store_overhead()
         return
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "prof":
+        emit_profiler_overhead()
+        return
     n_rows = int(os.environ.get("GREPTIME_BENCH_ROWS", 1 << 24))
     gids, ts, metrics = gen_data(n_rows)
 
@@ -1637,6 +1722,8 @@ def main():
     }))
 
     emit_trace_store_overhead()
+
+    emit_profiler_overhead()
 
     emit_concurrent_qps()
 

@@ -124,6 +124,17 @@ _TRACE_SPANS_SCHEMA = Schema([
     ColumnSchema("attrs", dt.STRING, nullable=True),
 ])
 
+_PROFILE_SAMPLES_SCHEMA = Schema([
+    ColumnSchema("node", dt.STRING),
+    ColumnSchema("kind", dt.STRING),
+    ColumnSchema("id", dt.STRING),
+    ColumnSchema("trace_id", dt.STRING),
+    ColumnSchema("stack_id", dt.STRING),
+    ColumnSchema("ts", dt.INT64),
+    ColumnSchema("stack", dt.STRING),
+    ColumnSchema("count", dt.INT64),
+])
+
 _BACKGROUND_JOBS_SCHEMA = Schema([
     ColumnSchema("job_id", dt.INT64),
     ColumnSchema("kind", dt.STRING),
@@ -498,6 +509,47 @@ def information_schema_table(catalog_manager, catalog_name: str,
             return rows
         return _VirtualTable("trace_spans", _TRACE_SPANS_SCHEMA,
                              build_trace_spans)
+    if name == "profile_samples":
+        def build_profile_samples():
+            # SQL view over the continuous profiler's durable table:
+            # drain every reachable datanode's pending aggregate (the
+            # same Flight `profile` action ADMIN SHOW PROFILE uses) and
+            # flush the local sampler first, so a just-finished query's
+            # stacks are visible cluster-wide, then serve the
+            # greptime_private.profile_samples rows
+            from ..common import profiler
+            s = profiler.sampler()
+            if s is not None:
+                clients = getattr(catalog_manager, "dist_clients", None)
+                for client in (dict(clients).values() if clients
+                               else ()):
+                    fetch = getattr(client, "profile", None)
+                    if fetch is None:
+                        continue
+                    try:
+                        s.absorb_rows(fetch(drain=True))
+                    except Exception:  # noqa: BLE001 — a dead peer
+                        import logging  # degrades, never 500s the view
+                        logging.getLogger(__name__).debug(
+                            "profile_samples: peer drain failed",
+                            exc_info=True)
+                s.flush()
+            rows = {k: [] for k in _PROFILE_SAMPLES_SCHEMA.names()}
+            table = catalog_manager.table(
+                catalog_name, profiler.PRIVATE_SCHEMA,
+                profiler.PROFILE_SAMPLES_TABLE)
+            if table is None:
+                return rows
+            for b in table.scan_batches():
+                d = b.to_pydict()
+                n = len(d.get("stack_id", []))
+                for k in rows:
+                    col = d.get(k)
+                    rows[k].extend(col if col is not None
+                                   else [None] * n)
+            return rows
+        return _VirtualTable("profile_samples", _PROFILE_SAMPLES_SCHEMA,
+                             build_profile_samples)
     if name == "background_jobs":
         def build_background_jobs():
             from ..common import background_jobs

@@ -106,18 +106,28 @@ class LocalDatanodeClient(DatanodeClient):
                            request.new_table_name or request.table_name,
                            table)
 
+    def _node_ctx(self):
+        # in-process cluster: datanode work runs on the frontend's own
+        # threads, so the sampler needs the per-node label pushed here
+        # (a no-op context while nothing samples)
+        from ..common import profiler
+        return profiler.node_context(f"dn{self.node_id}")
+
     def write_region(self, catalog: str, schema: str, table: str,
                      region_number: int, columns: Dict[str, Sequence],
                      op: str = "put") -> int:
-        return self._table(catalog, schema, table).write_region(
-            region_number, columns, op)
+        with self._node_ctx():
+            return self._table(catalog, schema, table).write_region(
+                region_number, columns, op)
 
     def region_moments(self, catalog: str, schema: str, table: str,
                        plan, regions: Optional[Sequence[int]] = None
                        ) -> List[pd.DataFrame]:
         from ..query.tpu_exec import region_moment_frames
-        return region_moment_frames(self._table(catalog, schema, table),
-                                    plan, regions=regions)
+        with self._node_ctx():
+            return region_moment_frames(
+                self._table(catalog, schema, table), plan,
+                regions=regions)
 
     def scan_batches(self, catalog: str, schema: str, table: str,
                      projection: Optional[Sequence[str]] = None,
@@ -125,7 +135,7 @@ class LocalDatanodeClient(DatanodeClient):
                      filters: Optional[Sequence] = None,
                      regions: Optional[Sequence[int]] = None) -> list:
         from ..common import exec_stats
-        with exec_stats.stage("scan"):
+        with self._node_ctx(), exec_stats.stage("scan"):
             batches = self._table(catalog, schema, table).scan_batches(
                 projection=projection, time_range=time_range, limit=limit,
                 filters=filters, regions=regions)
@@ -135,7 +145,8 @@ class LocalDatanodeClient(DatanodeClient):
         return batches
 
     def flush_table(self, catalog: str, schema: str, table: str) -> None:
-        self._table(catalog, schema, table).flush()
+        with self._node_ctx():
+            self._table(catalog, schema, table).flush()
 
     def describe_table(self, catalog: str, schema: str, name: str):
         t = self.datanode.catalog.table(catalog, schema, name)
@@ -152,3 +163,11 @@ class LocalDatanodeClient(DatanodeClient):
         the frontend's own — the view dedups by (node, job_id)."""
         from ..common import background_jobs
         return background_jobs.rows()
+
+    def profile(self, *, seconds: Optional[float] = None,
+                hz: Optional[float] = None, drain: bool = False) -> list:
+        """In-process twin of the Flight `profile` action. The sampler
+        is process-wide (the frontend's own), so draining or bursting
+        here would double-count it — per-node attribution instead rides
+        the `node_context` pushed around the data-plane calls above."""
+        return []

@@ -285,6 +285,20 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
         cluster-merged information_schema.background_jobs view)."""
         return list(self._action("background_jobs", {}).get("jobs", []))
 
+    def profile(self, *, seconds=None, hz=None, drain: bool = False
+                ) -> list:
+        """This datanode's profiler rows: a timed high-rate burst
+        (`seconds`/`hz`) or a drain of its pending sample aggregate —
+        either way the frontend absorbs the rows and owns the flush."""
+        body: dict = {}
+        if seconds is not None:
+            body["seconds"] = float(seconds)
+            if hz is not None:
+                body["hz"] = float(hz)
+        elif drain:
+            body["drain"] = True
+        return list(self._action("profile", body).get("rows", []))
+
 
 class Database(_FlightBase):
     """User-facing client (reference `Database`, client/src/database.rs)."""

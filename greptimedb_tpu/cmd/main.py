@@ -314,11 +314,15 @@ def datanode_start(args) -> None:
     # write trace_spans — it buffers spans keyed by trace_id until the
     # frontend's verdict piggybacks on a later RPC, then ships released
     # spans home on that RPC's response (TTL evicts the unclaimed)
-    from ..common import background_jobs, trace_store
+    from ..common import background_jobs, profiler, trace_store
     label = f"dn{args.node_id}"
     background_jobs.configure_node(label)
     trace_store.install(trace_store.TraceSink(
         node_label=label, service="datanode", role="buffer"))
+    # writer-less sampler: this process cannot write profile_samples;
+    # its folded stacks drain over the Flight `profile` action to the
+    # asking frontend, which absorbs and writes them
+    profiler.install(profiler.Profiler(node_label=label))
     dn = DatanodeInstance(DatanodeOptions(
         data_home=args.data_home or "./greptimedb_data",
         node_id=args.node_id, register_numbers_table=False))

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -65,6 +66,9 @@ def _start(kind: str, table: Optional[str], region: Optional[str],
         "detail": json.dumps(attrs, default=str, separators=(",", ":"))
         if attrs else "",
         "_t0": time.perf_counter(),
+        # the running thread, so the stack sampler (common/profiler.py)
+        # can attribute that thread's samples to THIS job
+        "_thread": threading.get_ident(),
     }
     with _lock:
         entry["job_id"] = _next_id[0]
@@ -127,13 +131,23 @@ def rows() -> List[dict]:
     out = []
     for e in running:
         t0 = e.pop("_t0", None)
+        e.pop("_thread", None)
         if t0 is not None:
             e["duration_ms"] = round((now - t0) * 1e3, 3)
         out.append(e)
     for e in done:
         e.pop("_t0", None)
+        e.pop("_thread", None)
         out.append(e)
     return out
+
+
+def jobs_by_thread() -> Dict[int, dict]:
+    """Snapshot for the stack sampler: which thread runs which
+    background job right now (entry dicts, not copies — read-only)."""
+    with _lock:
+        return {e["_thread"]: e for e in _running.values()
+                if "_thread" in e}
 
 
 def reset() -> None:

@@ -40,6 +40,34 @@ from ..errors import InvalidArgumentsError, QueryCancelledError
 
 _tls = threading.local()
 
+#: thread ident -> the entry currently installed on that thread. The
+#: thread-local answers "what is MY statement" (cancellation checks);
+#: this map answers the profiler's inverse question — "whose statement
+#: is THAT thread running" — for stacks sampled from outside.
+from .locks import TrackedLock as _TrackedLock
+from .tracking import tracked_state as _tracked_state
+
+_threads_lock = _TrackedLock("common.process_list_threads")
+_BY_THREAD: Dict[int, "ProcessEntry"] = _tracked_state(
+    {}, "process_list.by_thread")
+
+
+def _bind_thread(entry: Optional["ProcessEntry"]) -> None:
+    tid = threading.get_ident()
+    with _threads_lock:
+        if entry is not None:
+            _BY_THREAD[tid] = entry
+        else:
+            _BY_THREAD.pop(tid, None)
+
+
+def entries_by_thread() -> Dict[int, "ProcessEntry"]:
+    """Snapshot for the stack sampler: which thread runs which
+    statement right now (frontend threads via track(), pool workers via
+    telemetry.propagate -> install())."""
+    with _threads_lock:
+        return dict(_BY_THREAD)
+
 
 class ProcessEntry:
     """One running statement."""
@@ -173,10 +201,12 @@ def install(entry: Optional[ProcessEntry]) -> Iterator[None]:
     workers."""
     prev = getattr(_tls, "entry", None)
     _tls.entry = entry
+    _bind_thread(entry)
     try:
         yield
     finally:
         _tls.entry = prev
+        _bind_thread(prev)
 
 
 @contextlib.contextmanager
@@ -188,10 +218,12 @@ def track(query: str, *, protocol: str = "http",
     entry = REGISTRY.register(query, protocol, catalog, schema, trace_id)
     prev = getattr(_tls, "entry", None)
     _tls.entry = entry
+    _bind_thread(entry)
     try:
         yield entry
     finally:
         _tls.entry = prev
+        _bind_thread(prev)
         REGISTRY.deregister(entry)
 
 

@@ -20,7 +20,7 @@ the analyzer instead of being planted into production packages.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from .core import (Finding, ModuleInfo, ProjectContext, _call_leaf,
                    _str_arg0)
@@ -931,10 +931,57 @@ class UnsanctionedDataAccess(Rule):
                     yield hit(node, f"import of `{alias.name}`")
 
 
+class UndaemonedThread(Rule):
+    id = "GL15"
+    title = ("threading.Thread constructed without daemon=True and "
+             "never .join()ed on any shutdown path: a forgotten "
+             "non-daemon thread keeps the interpreter alive after "
+             "main() returns (hung process on exit)")
+
+    THREAD_NAMES = ("Thread", "threading.Thread")
+
+    def check(self, mod, ctx):
+        # every `<target>.join(...)` in the module, by dotted receiver —
+        # a Thread assigned to that receiver counts as reclaimed
+        joined: Set[str] = set()
+        for call in mod.nodes(ast.Call):
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "join":
+                d = _dotted(f.value)
+                if d:
+                    joined.add(d)
+        assigned_to: Dict[int, str] = {}
+        for node in mod.nodes(ast.Assign):
+            if len(node.targets) == 1 and \
+                    isinstance(node.value, ast.Call):
+                d = _dotted(node.targets[0])
+                if d:
+                    assigned_to[id(node.value)] = d
+        for call in mod.nodes(ast.Call):
+            if _dotted(call.func) not in self.THREAD_NAMES:
+                continue
+            kw = next((k for k in call.keywords
+                       if k.arg == "daemon"), None)
+            if kw is not None and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False):
+                continue          # daemon=True / daemon=<flag var>
+            target = assigned_to.get(id(call))
+            if target is not None and target in joined:
+                continue          # reclaimed on some path
+            yield mod.finding(
+                self.id, call,
+                "threading.Thread without daemon=True and never "
+                ".join()ed — a non-daemon thread left running blocks "
+                "interpreter shutdown; set daemon=True or join it on "
+                "a reachable shutdown path")
+
+
 ALL_RULES: List[Rule] = [
     SwallowedException(), BaseExceptionCaught(), BareRename(),
     UnknownFailpoint(), UntypedRaise(), RawThreadConstruction(),
     UntracedHandler(), UnlockedModuleMutation(), AdhocMetricObject(),
     UntypedHandlerException(), UncancellableLoop(), DeadFailpoint(),
     RootlessBackgroundJob(), UnsanctionedDataAccess(),
+    UndaemonedThread(),
 ]

@@ -888,7 +888,8 @@ class DistInstance:
         # distributed ingest path. Background ticking is opt-in
         # (self_monitor.start_background) — cmd/main wires it; tests
         # drive tick() cooperatively.
-        from ..common import background_jobs, process_list, trace_store
+        from ..common import (background_jobs, process_list, profiler,
+                              trace_store)
         from ..monitor import SelfMonitor
         self.self_monitor = SelfMonitor(self, node_label="frontend",
                                         meta=meta)
@@ -904,6 +905,12 @@ class DistInstance:
             writer=self)
         trace_store.install(self.trace_sink)
         self.catalog.trace_sink = self.trace_sink
+        # continuous profiler, same root role: samples taken on this
+        # frontend flush through the self-monitor path; datanode-side
+        # samples drain over the Flight `profile` action on demand
+        self.profiler = profiler.Profiler(node_label="frontend",
+                                          writer=self)
+        profiler.install(self.profiler)
         # information_schema.background_jobs fans out to every
         # reachable datanode and merges (compactions run THERE)
         self.catalog.dist_clients = clients
@@ -1250,14 +1257,15 @@ class DistInstance:
                     stats = None
                 import logging
 
-                from ..common import trace_store
+                from ..common import profiler, trace_store
                 sink = trace_store.sink()
                 logging.getLogger("greptimedb_tpu.slow_query").warning(
                     "slow query: %.1fms (threshold %dms) trace=%s "
-                    "trace_stored=%s stmt=%r stats=[%s]", elapsed_ms,
+                    "trace_stored=%s%s stmt=%r stats=[%s]", elapsed_ms,
                     thr, sp["trace_id"],
                     sink.stored_verdict(sp["trace_id"])
-                    if sink is not None else "off", sql,
+                    if sink is not None else "off",
+                    profiler.slow_query_suffix(sp["trace_id"]), sql,
                     stats.summary() if stats is not None else "n/a")
         return outs
 
@@ -1339,6 +1347,14 @@ class DistInstance:
             return apply_show_trace(self.catalog, stmt,
                                     sync_clients=list(
                                         self.clients.values()))
+        if stmt.kind == "show_profile":
+            # drain every datanode's pending sample aggregate over the
+            # Flight `profile` action, flush locally, then read the
+            # per-node tree back out of greptime_private
+            from .statement import apply_show_profile
+            return apply_show_profile(self.catalog, stmt,
+                                      sync_clients=list(
+                                          self.clients.values()))
         if stmt.kind == "rebalance":
             full = None
             if stmt.table is not None:

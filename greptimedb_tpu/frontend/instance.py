@@ -48,7 +48,8 @@ class FrontendInstance:
         # self-monitoring: the scraper walks the telemetry registry +
         # per-region heat and writes both through handle_row_insert into
         # greptime_private system tables (monitor/scraper.py)
-        from ..common import background_jobs, process_list, trace_store
+        from ..common import (background_jobs, process_list, profiler,
+                              trace_store)
         from ..monitor import SelfMonitor
         self.self_monitor = SelfMonitor(self, node_label="standalone")
         self.catalog.self_monitor = self.self_monitor
@@ -63,6 +64,12 @@ class FrontendInstance:
             writer=self)
         trace_store.install(self.trace_sink)
         self.catalog.trace_sink = self.trace_sink
+        # continuous profiler: folded stacks aggregate in-process and
+        # flush on the self-monitor tick into
+        # greptime_private.profile_samples (SET profiling = 1 arms it)
+        self.profiler = profiler.Profiler(node_label="standalone",
+                                          writer=self)
+        profiler.install(self.profiler)
 
     def start(self) -> None:
         if not self.datanode._started:
@@ -82,6 +89,7 @@ class FrontendInstance:
 
     def shutdown(self) -> None:
         self.self_monitor.stop()
+        self.profiler.stop(join=False)
         self.datanode.shutdown()
 
     # ---- SqlQueryHandler ----
@@ -144,14 +152,15 @@ class FrontendInstance:
                     stats = None
                 # trace_stored makes the WARN a working pointer: 'yes'
                 # means ADMIN SHOW TRACE '<trace>' can replay it later
-                from ..common import trace_store
+                from ..common import profiler, trace_store
                 sink = trace_store.sink()
                 _slow_logger.warning(
                     "slow query: %.1fms (threshold %dms) trace=%s "
-                    "trace_stored=%s stmt=%r stats=[%s]", elapsed_ms,
+                    "trace_stored=%s%s stmt=%r stats=[%s]", elapsed_ms,
                     thr, sp["trace_id"],
                     sink.stored_verdict(sp["trace_id"])
-                    if sink is not None else "off", sql,
+                    if sink is not None else "off",
+                    profiler.slow_query_suffix(sp["trace_id"]), sql,
                     stats.summary() if stats is not None else "n/a")
             if interceptor is not None:
                 out = interceptor.post_execute(out, ctx)
@@ -202,6 +211,9 @@ class FrontendInstance:
             if stmt.kind == "show_trace":
                 from .statement import apply_show_trace
                 return apply_show_trace(self.catalog, stmt)
+            if stmt.kind == "show_profile":
+                from .statement import apply_show_profile
+                return apply_show_profile(self.catalog, stmt)
             # region placement is a cluster concept: standalone's single
             # implicit node has nothing to migrate/split between
             from ..errors import UnsupportedError

@@ -253,6 +253,44 @@ def apply_show_trace(catalog: CatalogManager, stmt: ast.Admin,
     return Output.record_batches([rb], schema)
 
 
+def apply_show_profile(catalog: CatalogManager, stmt: ast.Admin,
+                       sync_clients=None) -> Output:
+    """Shared ADMIN SHOW PROFILE handler: render one query's (or
+    trace's) stored folded stacks as a per-node top-down self/total
+    tree from greptime_private.profile_samples. One function for both
+    frontends.
+
+    `sync_clients` (distributed) drains every datanode's writer-less
+    sampler over the Flight `profile` action first, so remote samples
+    are stored before the read — the profile twin of the trace
+    handler's span-sync pings."""
+    from ..common import profiler
+    ident, rows = profiler.sync_and_fetch(
+        catalog, stmt.trace_id or "", clients=sync_clients)
+    if ident is None:
+        raise InvalidArgumentsError(
+            "ADMIN SHOW PROFILE 'last': no query has been profiled on "
+            "this frontend yet (SET profiling = 1 and run one)")
+    if not rows:
+        raise InvalidArgumentsError(
+            f"profile for {ident!r} not found in greptime_private."
+            f"profile_samples (profiling was off while it ran, it was "
+            f"too fast to sample, or retention swept it)")
+    tree = profiler.profile_tree_rows(rows)
+    from ..datatypes import data_type as dt
+    from ..datatypes.record_batch import RecordBatch
+    from ..datatypes.schema import Schema as _Schema
+    schema = _Schema([
+        ColumnSchema("frame", dt.STRING),
+        ColumnSchema("node", dt.STRING),
+        ColumnSchema("self_samples", dt.INT64),
+        ColumnSchema("total_samples", dt.INT64),
+    ])
+    rb = RecordBatch.from_pydict(schema, {
+        k: [r[k] for r in tree] for k in schema.names()})
+    return Output.record_batches([rb], schema)
+
+
 def apply_kill(stmt: ast.Kill) -> Output:
     """Shared KILL handler: trip the cancel event of a running statement
     in the process-wide registry. The killed statement raises
@@ -471,6 +509,28 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
         # self_monitor_retention_ms — traces are bulkier than metrics
         from ..common import trace_store
         trace_store.configure(retention_ms=_int_setting(stmt))
+    elif name == "profiling":
+        # continuous stack sampler master switch (common/profiler.py);
+        # env twin GREPTIME_PROFILING. Sampling starts/stops live.
+        from ..common import profiler
+        profiler.configure(enabled=bool(_int_setting(stmt)))
+    elif name == "profile_hz":
+        # continuous sampling rate (default ~19 Hz — low enough for
+        # always-on, high enough to catch a slow query's hot frames)
+        from ..common import profiler
+        try:
+            profiler.configure(hz=float(stmt.value))
+        except (TypeError, ValueError):
+            raise InvalidArgumentsError(
+                f"SET {stmt.name}: expected a rate in "
+                f"[{profiler.MIN_HZ:g}, {profiler.MAX_HZ:g}] Hz, got "
+                f"{stmt.value!r}")
+    elif name == "profile_retention_ms":
+        # retention for greptime_private.profile_samples (swept batched
+        # on the self-monitor tick; 0 disables). Separate knob from the
+        # trace/metrics windows — profiles age fastest
+        from ..common import profiler
+        profiler.configure(retention_ms=_int_setting(stmt))
     elif name == "self_monitor_retention_ms":
         # retention window for greptime_private.node_metrics /
         # region_heat (monitor/scraper.py sweeps on each tick;

@@ -131,10 +131,12 @@ class SelfMonitor:
                     # the operator exactly when they need the data
                     written = self._write_metrics(samples, now_ms)
                     written += self._write_heat(heat, now_ms)
-                    # traces flush BEFORE the sweep so a tightened
-                    # trace_retention_ms applies to just-written rows
+                    # traces and profile samples flush BEFORE the sweep
+                    # so a tightened trace_retention_ms /
+                    # profile_retention_ms applies to just-written rows
                     # on the same tick
                     written += self._flush_traces()
+                    written += self._flush_profile()
                     deleted = self._enforce_retention(now_ms)
                 self.stats["ticks"] = int(self.stats["ticks"]) + 1
                 self.stats["metric_rows"] = \
@@ -265,6 +267,17 @@ class SelfMonitor:
         sink.evict_expired()
         return sink.flush()
 
+    def _flush_profile(self) -> int:
+        """Persist the continuous profiler's aggregated folded stacks
+        (common/profiler.py). Writer-less samplers (datanode processes)
+        report flush() == 0 and keep buffering until drained over
+        Flight; the sampler's flush has its own suppress guard."""
+        from ..common import profiler
+        s = profiler.sampler()
+        if s is None:
+            return 0
+        return s.flush()
+
     # ---- retention ----
     def _enforce_retention(self, now_ms: int) -> int:
         """Delete system-table rows older than the retention window —
@@ -282,6 +295,11 @@ class SelfMonitor:
         if trace_keep_ms > 0:
             deleted += self._sweep_table(trace_store.TRACE_SPANS_TABLE,
                                          now_ms - trace_keep_ms)
+        from ..common import profiler
+        prof_keep_ms = profiler.retention_ms()
+        if prof_keep_ms > 0:
+            deleted += self._sweep_table(
+                profiler.PROFILE_SAMPLES_TABLE, now_ms - prof_keep_ms)
         if deleted:
             logger.info("self-monitor: retention swept %d row(s)",
                         deleted)

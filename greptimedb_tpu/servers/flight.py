@@ -228,6 +228,21 @@ class FlightDatanodeServer(flight.FlightServerBase):
                 # frontend's cluster-merged information_schema view
                 from ..common import background_jobs
                 resp = {"ok": True, "jobs": background_jobs.rows()}
+            elif kind == "profile":
+                # continuous profiler, datanode side: writer-less
+                # sampler — {"drain": true} hands the pending aggregate
+                # to the frontend (which owns the flush), {"seconds":
+                # N[, "hz": h]} runs a high-rate burst for /debug/prof
+                from ..common import profiler
+                s = profiler.sampler()
+                if s is None:
+                    resp = {"ok": True, "rows": []}
+                elif body.get("seconds") is not None:
+                    resp = {"ok": True, "rows": s.collect_burst(
+                        float(body["seconds"]),
+                        burst_hz=body.get("hz"))}
+                else:
+                    resp = {"ok": True, "rows": s.drain_rows()}
             else:
                 raise GreptimeError(f"unknown action {kind!r}")
         except GreptimeError as e:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -26,6 +27,8 @@ from . import influxdb as influx_mod
 from . import opentsdb as tsdb_mod
 from . import prometheus as prom_mod
 from .auth import NoopUserProvider, UserProvider
+
+logger = logging.getLogger(__name__)
 
 
 def parse_db_param(db: Optional[str]) -> tuple:
@@ -90,6 +93,7 @@ class HttpServer:
         r.add_post("/v1/scripts", self.handle_scripts)
         r.add_post("/v1/run-script", self.handle_run_script)
         r.add_get("/v1/prof/mem", self.handle_mem_prof)
+        r.add_get("/debug/prof/cpu", self.handle_cpu_prof)
         r.add_route("*", "/api/v1/query", self.handle_prom_api_query)
         r.add_route("*", "/api/v1/query_range", self.handle_prom_api_range)
         r.add_route("*", "/api/v1/labels", self.handle_prom_api_labels)
@@ -502,6 +506,74 @@ class HttpServer:
         doc["trace_id"] = tid
         doc["span_count"] = len(doc["spans"])
         return web.json_response(doc)
+
+    async def handle_cpu_prof(self, request):
+        """GET /debug/prof/cpu?seconds=N&hz=H&format=folded|flamegraph|json
+        — an on-demand high-rate CPU sampling burst (the reference's
+        pprof-shaped /debug/prof/cpu, src/common/pprof). On a
+        distributed frontend the burst fans out to every datanode over
+        the Flight `profile` action concurrently and the folded stacks
+        merge per node. Works with `SET profiling` off — the burst has
+        its own clock and rate."""
+        self.user_provider.auth_http_basic(
+            request.headers.get("Authorization"))
+        fmt = request.query.get("format", "folded")
+        if fmt not in ("folded", "flamegraph", "json"):
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": f"format {fmt!r} not supported "
+                          f"(folded | flamegraph | json)"}, status=400)
+        try:
+            seconds = float(request.query.get("seconds", "3"))
+            hz = request.query.get("hz")
+            hz_f = float(hz) if hz is not None else None
+        except ValueError:
+            return web.json_response(
+                {"code": int(StatusCode.INVALID_ARGUMENTS),
+                 "error": "seconds/hz must be numbers"}, status=400)
+
+        def work():
+            from ..common import profiler
+            from ..common.runtime import parallel_map
+            s = profiler.sampler()
+            clients = list(getattr(self.frontend, "clients",
+                                   {}).values())
+
+            def one(target):
+                try:
+                    if target is None:
+                        if s is None:
+                            return []
+                        return s.collect_burst(seconds, burst_hz=hz_f)
+                    return target.profile(seconds=seconds, hz=hz_f)
+                except Exception as e:  # noqa: BLE001 — a dead node
+                    logger.warning(     # must not void the whole burst
+                        "profile burst fan-out failed: %s", e)
+                    return []
+
+            merged: list = []
+            for rows in parallel_map(one, [None] + clients,
+                                     max_workers=len(clients) + 1):
+                merged.extend(rows or [])
+            return merged
+
+        loop = asyncio.get_running_loop()
+        rows = await loop.run_in_executor(
+            None, self._traced_call(request, work))
+        from ..common import profiler as prof_mod
+        if fmt == "folded":
+            return web.Response(text=prof_mod.folded_text(rows),
+                                content_type="text/plain")
+        if fmt == "flamegraph":
+            return web.Response(
+                text=prof_mod.flamegraph_svg(
+                    rows, title=f"cpu {seconds:g}s burst"),
+                content_type="image/svg+xml")
+        return web.json_response({
+            "seconds": seconds,
+            "sample_count": sum(int(r.get("count") or 0) for r in rows),
+            "rows": rows,
+        })
 
     async def handle_mem_prof(self, request):
         """Heap profile dump (reference: jemalloc /v1/prof/mem,

@@ -421,14 +421,18 @@ class Admin(Statement):
     - ``ADMIN FLUSH TABLE <table>``
     - ``ADMIN COMPACT TABLE <table>``
 
-    Durable trace store (works on both deployments):
+    Observability (works on both deployments):
 
     - ``ADMIN SHOW TRACE '<trace_id>'`` — the reassembled cross-node
       waterfall from ``greptime_private.trace_spans`` ('last' = the
       most recently retained trace on this frontend)
+    - ``ADMIN SHOW PROFILE '<query_id>'|'<trace_id>'|'last'`` — the
+      continuous profiler's per-node self/total frame tree from
+      ``greptime_private.profile_samples`` (``trace_id`` carries the
+      id for both SHOW forms)
     """
     #: migrate_region | split_region | rebalance | flush_table |
-    #: compact_table | show_trace
+    #: compact_table | show_trace | show_profile
     kind: str = ""
     table: Optional[ObjectName] = None
     region: Optional[int] = None

@@ -290,20 +290,29 @@ class Parser:
         - ADMIN FLUSH TABLE <table>
         - ADMIN COMPACT TABLE <table>
 
-        And the durable trace store's waterfall surface:
+        And the observability surfaces:
 
         - ADMIN SHOW TRACE '<trace_id>'  ('last' = most recently
           retained trace on this frontend)
+        - ADMIN SHOW PROFILE '<query_id>'|'<trace_id>'|'last' — the
+          continuous profiler's per-node self/total frame tree
         """
         self.expect_kw("ADMIN")
         if self.match_kw("SHOW"):
-            self.expect_kw("TRACE")
+            what = "TRACE" if self.match_kw("TRACE") else \
+                ("PROFILE" if self.match_kw("PROFILE") else None)
+            if what is None:
+                t = self.peek()
+                raise ParserError(
+                    f"expected TRACE or PROFILE after ADMIN SHOW, "
+                    f"found {t.value!r} at {t.pos}")
             t = self.next()
             if t.kind != STRING:
                 raise ParserError(
-                    f"ADMIN SHOW TRACE needs a quoted trace id (or "
-                    f"'last'), found {t.value!r} at {t.pos}")
-            return Admin(kind="show_trace", trace_id=str(t.value))
+                    f"ADMIN SHOW {what} needs a quoted id (or 'last'), "
+                    f"found {t.value!r} at {t.pos}")
+            kind = "show_trace" if what == "TRACE" else "show_profile"
+            return Admin(kind=kind, trace_id=str(t.value))
         if self.match_kw("FLUSH"):
             self.expect_kw("TABLE")
             return Admin(kind="flush_table",
@@ -340,8 +349,8 @@ class Parser:
         t = self.peek()
         raise ParserError(
             f"expected MIGRATE REGION / SPLIT REGION / REBALANCE / "
-            f"FLUSH TABLE / COMPACT TABLE / SHOW TRACE after ADMIN, "
-            f"found {t.value!r} at {t.pos}")
+            f"FLUSH TABLE / COMPACT TABLE / SHOW TRACE / SHOW PROFILE "
+            f"after ADMIN, found {t.value!r} at {t.pos}")
 
     def parse_kill(self) -> Kill:
         """KILL [QUERY] <id> — the id is the `id` column of

@@ -1,0 +1,26 @@
+-- ADMIN SHOW PROFILE (ISSUE 17): the continuous profiler's tree
+-- surface. Sampling is wall-clock driven, so this golden sticks to the
+-- deterministic surfaces — knob plumbing, validation, and the two
+-- not-found paths; the sampled tree itself is asserted by
+-- tests/test_profiler.py. The runner resets the profiling knobs per
+-- case and normalizes sample counts / stack hashes.
+
+SELECT count(*) FROM information_schema.profile_samples;
+
+ADMIN SHOW PROFILE 'last';
+
+ADMIN SHOW PROFILE 'f00dfeedf00dfeedf00dfeedf00dfeed';
+
+SET profiling = 1;
+
+SET profile_hz = 250;
+
+SET profile_hz = 0.5;
+
+SET profile_hz = 99999;
+
+SET profile_hz = 'fast';
+
+SET profile_retention_ms = 3600000;
+
+SET profiling = 0;

@@ -31,6 +31,10 @@ SELECT name, action, hits, fires FROM information_schema.failpoints
 SELECT name, action FROM information_schema.failpoints
     WHERE name LIKE 'sst_index%' ORDER BY name;
 
+-- the continuous profiler's flush point (ISSUE 17) registers at import
+SELECT name, action FROM information_schema.failpoints
+    WHERE name LIKE 'profiler_%' ORDER BY name;
+
 -- NxM one-in-N arming renders verbatim
 SET failpoint_objstore_read = '1x3*err(transient)';
 

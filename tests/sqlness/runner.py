@@ -78,7 +78,11 @@ _VOLATILE_COLUMNS = {"elapsed_ms": "<elapsed>", "watermark": "<watermark>",
                      "duration_ms": "<ms>", "self_ms": "<ms>",
                      "start_offset_ms": "<ms>", "start_ms": "<ms>",
                      "trace_id": "<trace>", "span_id": "<span>",
-                     "parent_span_id": "<span>"}
+                     "parent_span_id": "<span>",
+                     # continuous-profiler sample counts / stack hashes
+                     # (ISSUE 17): wall-clock sampling never byte-repeats
+                     "self_samples": "<n>", "total_samples": "<n>",
+                     "stack_id": "<stack>"}
 
 #: wall-clock fragments inside EXPLAIN ANALYZE detail strings: the
 #: scatter's slowest-node latency, the per-node latency vector, and the
@@ -254,10 +258,15 @@ def run_one(sql_path: Path, update: bool) -> Optional[str]:
     # The background-job registry and trace knobs are process-global
     # too (system/background_jobs.sql pins exact job rows)
     from greptimedb_tpu.common import background_jobs, failpoint
-    from greptimedb_tpu.common import trace_store
+    from greptimedb_tpu.common import profiler, trace_store
     failpoint.reset()
     background_jobs.reset()
     trace_store.configure(sample_ratio=0.01)
+    # profiler knobs are process-global too; a case that SET them must
+    # not leak into the next (the frontend construct installs a fresh
+    # sampler, but enabled/hz/retention live at module level)
+    profiler.configure(enabled=False, hz=19.0,
+                       retention_ms=24 * 3600 * 1000)
     with tempfile.TemporaryDirectory() as home:
         fe = _DistEnv(home) if distributed else make_frontend(home)
         try:

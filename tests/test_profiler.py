@@ -1,0 +1,740 @@
+"""Continuous profiling tests (ISSUE 17).
+
+A wall-clock stack sampler attributes every sampled stack live — to the
+owning statement via the process registry, to background work via the
+background-jobs registry — and flushes aggregated folded stacks through
+the self-monitor path into greptime_private.profile_samples. Surfaces:
+ADMIN SHOW PROFILE, GET /debug/prof/cpu, and the
+information_schema.profile_samples view.
+"""
+
+import json
+import logging
+import re
+import time
+
+import pytest
+
+from greptimedb_tpu.common import profiler, trace_store
+from greptimedb_tpu.common.profiler import (
+    PRIVATE_SCHEMA, PROFILE_SAMPLES_TABLE, Profiler, fold_stack,
+    stack_id)
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import InvalidArgumentsError
+from greptimedb_tpu.frontend.instance import FrontendInstance
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs():
+    saved = (profiler.enabled(), profiler.hz(), profiler.retention_ms())
+    saved_sampler = profiler.sampler()
+    saved_ratio = trace_store.sample_ratio()
+    yield
+    profiler.configure(enabled=saved[0], hz=saved[1],
+                       retention_ms=saved[2])
+    profiler.install(saved_sampler)
+    trace_store.configure(sample_ratio=saved_ratio)
+    from greptimedb_tpu.common.telemetry import set_slow_query_threshold_ms
+    set_slow_query_threshold_ms(None)
+
+
+@pytest.fixture()
+def fe(tmp_path):
+    dn = DatanodeInstance(DatanodeOptions(data_home=str(tmp_path)))
+    frontend = FrontendInstance(dn)
+    frontend.start()
+    frontend.do_query(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME INDEX, "
+        "v DOUBLE, PRIMARY KEY(host))")
+    frontend.do_query("INSERT INTO cpu VALUES ('a', 1000, 1.5), "
+                      "('b', 2000, 2.5)")
+    yield frontend
+    frontend.shutdown()
+
+
+def _pydict(fe, sql):
+    out = fe.do_query(sql)[-1]
+    return out.batches[0].to_pydict()
+
+
+def _counter_value(name):
+    from greptimedb_tpu.common.telemetry import registry_snapshot
+    return sum(v for n, _l, v, _k in registry_snapshot() if n == name)
+
+
+def _spin(fe, seconds, sql="SELECT host, avg(v) FROM cpu GROUP BY host"):
+    """Keep query work on the books long enough for the sampler."""
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        fe.do_query(sql)
+
+
+class TestFolding:
+    def test_fold_stack_root_first_and_trimmed(self):
+        import sys
+        frame = sys._getframe()
+        stack = fold_stack(frame)
+        parts = stack.split(";")
+        # leaf is THIS function, root is the runner's entry — root-first
+        assert parts[-1].endswith(
+            ":test_fold_stack_root_first_and_trimmed")
+        assert all(";" not in p for p in parts)
+        # repo-internal files render package-relative, not absolute
+        assert not any(p.startswith("/") for p in parts)
+
+    def test_stack_id_stable_hash(self):
+        assert stack_id("a;b;c") == stack_id("a;b;c")
+        assert stack_id("a;b;c") != stack_id("a;b;d")
+        assert re.fullmatch(r"[0-9a-f]{8}", stack_id("a;b;c"))
+
+    def test_node_context_overrides_attribution_only_when_sampling(self):
+        s = Profiler(node_label="frontend")
+        old = profiler.install(s)
+        try:
+            assert not profiler.sampling_active()
+            with profiler.node_context("dn7"):
+                # knob off, no burst: bookkeeping short-circuits
+                assert profiler.node_overrides() == {}
+            profiler.configure(enabled=True)
+            import threading
+            with profiler.node_context("dn7"):
+                assert profiler.node_overrides()[
+                    threading.get_ident()] == "dn7"
+            assert profiler.node_overrides() == {}
+        finally:
+            profiler.configure(enabled=False)
+            profiler.install(old)
+
+
+class TestKnobs:
+    def test_set_profiling_and_hz(self, fe):
+        fe.do_query("SET profiling = 1")
+        assert profiler.enabled()
+        fe.do_query("SET profile_hz = 50")
+        assert profiler.hz() == 50.0
+        fe.do_query("SET profiling = 0")
+        assert not profiler.enabled()
+
+    def test_hz_validation(self, fe):
+        for bad in ("0.5", "99999", "'fast'"):
+            with pytest.raises(InvalidArgumentsError):
+                fe.do_query(f"SET profile_hz = {bad}")
+        assert profiler.hz() != 0.5
+
+    def test_retention_knob_independent_of_trace_knob(self, fe):
+        fe.do_query("SET profile_retention_ms = 12345")
+        assert profiler.retention_ms() == 12345
+        assert trace_store.retention_ms() != 12345
+
+    def test_no_thread_until_enabled(self, tmp_path):
+        """Default-off means zero always-on cost: constructing a
+        frontend must not start a sampler thread."""
+        import threading
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "nt")))
+        frontend = FrontendInstance(dn)
+        try:
+            names = {t.name for t in threading.enumerate()}
+            assert not any(n.startswith("profiler-") for n in names)
+        finally:
+            frontend.shutdown()
+
+
+def _parked_thread(body):
+    """Run `body(ready, release)` on a worker thread; yields while the
+    worker is parked. sample_once skips the CALLING thread (the sampler
+    never profiles itself), so attribution tests need real peers."""
+    import contextlib
+    import threading
+
+    @contextlib.contextmanager
+    def cm():
+        ready, release = threading.Event(), threading.Event()
+        t = threading.Thread(target=body, args=(ready, release),
+                             daemon=True)
+        t.start()
+        try:
+            assert ready.wait(5)
+            yield
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    return cm()
+
+
+class TestAttribution:
+    def test_query_samples_carry_statement_identity(self):
+        """A thread inside process_list.track() samples as kind=query
+        with the entry's id and trace id."""
+        from greptimedb_tpu.common import process_list
+        from greptimedb_tpu.common.telemetry import root_span
+        s = Profiler(node_label="t")
+        seen = {}
+
+        def work(ready, release):
+            with root_span("execute_stmt") as sp:
+                seen["trace"] = sp["trace_id"]
+                with process_list.track("SELECT 1", catalog="greptime",
+                                        schema="public",
+                                        trace_id=sp["trace_id"]):
+                    ready.set()
+                    release.wait(5)
+
+        with _parked_thread(work):
+            s.sample_once()
+        q = [(k, c) for k, c in s._agg.items() if k[1] == "query"]
+        assert q
+        (node, kind, ident, trace, stack), _c = q[0]
+        assert node == "t"
+        assert ident.isdigit()
+        assert trace == seen["trace"]
+        assert s.last_query_trace == seen["trace"]
+
+    def test_background_job_samples_attributed(self):
+        """A thread inside background_jobs.job() samples by job kind and
+        id, taking precedence over any process entry."""
+        import threading
+
+        from greptimedb_tpu.common import background_jobs
+        s = Profiler(node_label="t")
+        seen = {}
+        done = threading.Event()
+        go = threading.Event()
+
+        def work():
+            with background_jobs.job("flush", table="cpu") as j:
+                seen.update(j)
+                go.set()
+                done.wait(5)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        try:
+            assert go.wait(5)
+            s.sample_once()
+        finally:
+            done.set()
+            t.join(timeout=5)
+        flush_keys = [k for k in s._agg if k[1] == "flush"]
+        assert flush_keys
+        assert flush_keys[0][2] == str(seen.get("job_id"))
+
+    def test_unattributed_threads_are_idle(self):
+        s = Profiler(node_label="t")
+
+        def park(ready, release):
+            ready.set()
+            release.wait(5)
+
+        with _parked_thread(park):
+            s.sample_once()
+        kinds = {k[1] for k in s._agg}
+        assert "idle" in kinds
+
+    def test_sampler_skips_its_own_thread(self):
+        """The calling thread never shows up in its own sample pass —
+        the sampler must not charge its overhead to the workload."""
+        s = Profiler(node_label="t")
+        s.sample_once()
+        assert not any("sample_once" in k[4] for k in s._agg)
+
+
+class TestFlushAndStore:
+    def test_flush_writes_profile_samples(self, fe):
+        fe.do_query("SET profiling = 1")
+        _spin(fe, 0.4)
+        assert fe.profiler.flush() > 0
+        d = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE}")
+        assert d["count(*)"][0] > 0
+        fe.do_query("SET profiling = 0")
+
+    def test_windows_do_not_dedup_each_other(self, fe):
+        """Two flush windows with the SAME folded stack land as distinct
+        rows: ts is the window start, and stack_id tags the stack, so
+        the mito (tags, ts) primary key never collapses history."""
+        rows = [{"node": "n", "kind": "idle", "id": "", "trace_id": "",
+                 "stack_id": stack_id("a;b"), "ts": 1000, "stack": "a;b",
+                 "count": 3},
+                {"node": "n", "kind": "idle", "id": "", "trace_id": "",
+                 "stack_id": stack_id("a;b"), "ts": 2000, "stack": "a;b",
+                 "count": 5}]
+        fe.profiler.absorb_rows(rows)
+        assert fe.profiler.flush() == 2
+        d = _pydict(fe, f"SELECT count, ts FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE} WHERE node = 'n' "
+                        f"ORDER BY ts")
+        assert d["count"] == [3, 5]
+
+    def test_flush_failure_contained_and_counted(self, fe):
+        """An armed profiler_flush failpoint: the write fails, the rows
+        drop (counted), nothing raises — the observer must never break
+        its host."""
+        from greptimedb_tpu.common import failpoint
+        fe.profiler.absorb_rows([{
+            "node": "n", "kind": "idle", "id": "", "trace_id": "",
+            "stack_id": stack_id("x"), "ts": 1000, "stack": "x",
+            "count": 1}])
+        before = _counter_value("greptime_profiler_dropped_total")
+        with failpoint.cfg("profiler_flush", "err"):
+            assert fe.profiler.flush() == 0
+        assert fe.profiler.stats["write_errors"] == 1
+        assert _counter_value(
+            "greptime_profiler_dropped_total") - before == 1
+        # the failed rows are gone, not retried forever
+        assert fe.profiler.pending_count() == 0
+
+    def test_absorb_overflow_sheds_and_counts(self, fe, monkeypatch):
+        monkeypatch.setattr(Profiler, "MAX_ABSORBED", 2)
+        before = _counter_value("greptime_profiler_dropped_total")
+        fe.profiler.absorb_rows([
+            {"node": "n", "kind": "idle", "id": "", "trace_id": "",
+             "stack_id": stack_id(f"s{i}"), "ts": 1000,
+             "stack": f"s{i}", "count": 1}
+            for i in range(5)])
+        assert fe.profiler.stats["rows_absorbed"] == 2
+        assert _counter_value(
+            "greptime_profiler_dropped_total") - before == 3
+
+
+class TestShowProfile:
+    def test_standalone_end_to_end(self, fe):
+        """SET profiling + real queries → ADMIN SHOW PROFILE 'last'
+        renders a top-down self/total tree attributed to this query's
+        trace (the `make prof` demo)."""
+        fe.do_query("SET profiling = 1")
+        fe.do_query("SET profile_hz = 97")
+        _spin(fe, 0.8)
+        out = fe.do_query("ADMIN SHOW PROFILE 'last'")[-1]
+        assert out.is_batches
+        names = out.batches[0].schema.names()
+        assert names == ["frame", "node", "self_samples",
+                         "total_samples"]
+        rows = []
+        for b in out.batches:
+            rows.extend(b.to_pylist())
+        assert rows
+        # tree shape: the root row is unindented, self <= total, and
+        # query frames from the engine appear somewhere in the tree
+        assert not rows[0]["frame"].startswith(" ")
+        assert all(r["self_samples"] <= r["total_samples"]
+                   for r in rows)
+        assert any("greptimedb_tpu" in r["frame"] for r in rows)
+        fe.do_query("SET profiling = 0")
+
+    def test_show_profile_by_trace_and_query_id(self, fe):
+        fe.do_query("SET profiling = 1")
+        fe.do_query("SET profile_hz = 97")
+        _spin(fe, 0.8)
+        tid = fe.profiler.last_query_trace
+        assert tid is not None
+        out = fe.do_query(f"ADMIN SHOW PROFILE '{tid}'")[-1]
+        assert out.batches and out.batches[0].num_rows > 0
+        # the numeric ident path reads by process-list id; stored rows
+        # carry it in the id column
+        d = _pydict(fe, f"SELECT id FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE} WHERE kind = 'query' "
+                        f"AND trace_id = '{tid}' LIMIT 1")
+        qid = d["id"][0]
+        out = fe.do_query(f"ADMIN SHOW PROFILE '{qid}'")[-1]
+        assert out.batches and out.batches[0].num_rows > 0
+        fe.do_query("SET profiling = 0")
+
+    def test_unknown_idents_error(self, fe):
+        with pytest.raises(InvalidArgumentsError,
+                           match="no query has been profiled"):
+            fe.do_query("ADMIN SHOW PROFILE 'last'")
+        with pytest.raises(InvalidArgumentsError, match="not found"):
+            fe.do_query("ADMIN SHOW PROFILE "
+                        "'f00dfeedf00dfeedf00dfeedf00dfeed'")
+
+    def test_parser_rejects_unquoted_ident(self, fe):
+        from greptimedb_tpu.errors import GreptimeError
+        with pytest.raises(GreptimeError, match="quoted id"):
+            fe.do_query("ADMIN SHOW PROFILE last")
+
+
+class TestSlowQueryLine:
+    def test_warn_line_carries_top_frames(self, fe, caplog):
+        from greptimedb_tpu.common.telemetry import \
+            set_slow_query_threshold_ms
+        fe.do_query("SET profiling = 1")
+        fe.do_query("SET profile_hz = 147")
+        set_slow_query_threshold_ms(1)      # everything is "slow"
+        sql = "SELECT host, avg(v), sum(v) FROM cpu GROUP BY host"
+        with caplog.at_level(logging.WARNING,
+                             logger="greptimedb_tpu.slow_query"):
+            deadline = time.time() + 8
+            while not any("profile_top=[" in r.getMessage()
+                          for r in caplog.records) \
+                    and time.time() < deadline:
+                fe.do_query(sql)
+        slow = [r.getMessage() for r in caplog.records
+                if "slow query" in r.getMessage()]
+        assert slow
+        hit = [m for m in slow if "profile_top=[" in m]
+        assert hit, "WARN line never carried profile_top frames"
+        assert "trace_stored=" in hit[0]
+        fe.do_query("SET profiling = 0")
+
+    def test_no_suffix_when_profiling_off(self, fe, caplog):
+        from greptimedb_tpu.common.telemetry import \
+            set_slow_query_threshold_ms
+        set_slow_query_threshold_ms(1)
+        with caplog.at_level(logging.WARNING,
+                             logger="greptimedb_tpu.slow_query"):
+            for _ in range(20):
+                fe.do_query("SELECT host, avg(v) FROM cpu "
+                            "GROUP BY host")
+        slow = [r.getMessage() for r in caplog.records
+                if "slow query" in r.getMessage()]
+        assert slow
+        assert all("profile_top=" not in m for m in slow)
+
+
+class TestMetricsSurface:
+    def test_profiler_counters_published(self, fe):
+        fe.do_query("SET profiling = 1")
+        before = _counter_value("greptime_profiler_samples_total")
+        _spin(fe, 0.3)
+        assert _counter_value(
+            "greptime_profiler_samples_total") > before
+        assert _counter_value("greptime_profiler_overhead_ns_total") > 0
+        fe.do_query("SET profiling = 0")
+
+    def test_counters_in_runtime_metrics_view(self, fe):
+        fe.do_query("SET profiling = 1")
+        _spin(fe, 0.3)
+        d = _pydict(fe, "SELECT metric_name FROM "
+                        "information_schema.runtime_metrics WHERE "
+                        "metric_name LIKE 'greptime_profiler%'")
+        assert "greptime_profiler_samples_total" in d["metric_name"]
+        assert "greptime_profiler_overhead_ns_total" \
+            in d["metric_name"]
+        fe.do_query("SET profiling = 0")
+
+
+class TestRetentionSweep:
+    """Satellite: _sweep_table generalizes over trace_spans AND
+    profile_samples, each on its own knob."""
+
+    def _plant_profile_row(self, fe, ts_ms):
+        fe.profiler.absorb_rows([{
+            "node": "old", "kind": "idle", "id": "", "trace_id": "",
+            "stack_id": stack_id("stale"), "ts": ts_ms,
+            "stack": "stale", "count": 1}])
+        assert fe.profiler.flush() == 1
+
+    def test_profile_retention_sweep_same_tick_as_flush(self, fe):
+        """Flush-before-sweep: rows still pending in the sampler when
+        retention tightens are flushed and then swept within ONE tick —
+        the same property the trace store guarantees."""
+        old_ms = int(time.time() * 1000) - 10 * 24 * 3600 * 1000
+        fe.profiler.absorb_rows([{
+            "node": "old", "kind": "idle", "id": "", "trace_id": "",
+            "stack_id": stack_id("stale"), "ts": old_ms,
+            "stack": "stale", "count": 1}])
+        fe.do_query("SET profile_retention_ms = 60000")
+        assert fe.profiler.pending_count() == 1    # not yet written
+        fe.self_monitor.tick()
+        assert fe.profiler.pending_count() == 0    # flushed this tick
+        d = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE} WHERE node = 'old'")
+        assert d["count(*)"][0] == 0               # ...and swept
+
+    def test_knobs_sweep_independently(self, fe):
+        """trace_retention_ms sweeps trace_spans only;
+        profile_retention_ms sweeps profile_samples only."""
+        old_ms = int(time.time() * 1000) - 10 * 24 * 3600 * 1000
+        # plant one aged row in each store
+        trace_store.configure(sample_ratio=1.0)
+        fe.do_query("SELECT host FROM cpu")
+        sink = trace_store.sink()
+        sink.flush()
+        self._plant_profile_row(fe, old_ms)
+        trace_store.configure(sample_ratio=0.0)
+
+        def counts():
+            t = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                            f"{trace_store.TRACE_SPANS_TABLE}")
+            p = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                            f"{PROFILE_SAMPLES_TABLE}")
+            return t["count(*)"][0], p["count(*)"][0]
+
+        t0, p0 = counts()
+        assert t0 > 0 and p0 > 0
+        # profile knob alone: profile row goes, trace rows stay
+        fe.do_query("SET profile_retention_ms = 60000")
+        fe.do_query("SET trace_retention_ms = 0")
+        fe.self_monitor.tick()
+        t1, p1 = counts()
+        assert t1 == t0 and p1 == 0
+        # trace knob alone sweeps the (freshly re-planted) other side
+        self._plant_profile_row(fe, old_ms)
+        fe.do_query("SET profile_retention_ms = 0")
+        fe.do_query("SET trace_retention_ms = 1")
+        time.sleep(0.01)
+        fe.self_monitor.tick()
+        t2, p2 = counts()
+        assert t2 == 0 and p2 == 1
+        fe.do_query("SET profile_retention_ms = 86400000")
+        fe.do_query("SET trace_retention_ms = 259200000")
+
+    def test_profile_sweep_batched(self, fe, monkeypatch):
+        old_ms = int(time.time() * 1000) - 10 * 24 * 3600 * 1000
+        fe.profiler.absorb_rows([{
+            "node": "old", "kind": "idle", "id": "", "trace_id": "",
+            "stack_id": stack_id(f"s{i}"), "ts": old_ms + i,
+            "stack": f"s{i}", "count": 1} for i in range(5)])
+        assert fe.profiler.flush() == 5
+        monkeypatch.setattr(type(fe.self_monitor), "SWEEP_BATCH_ROWS", 2)
+        fe.do_query("SET profile_retention_ms = 60000")
+        fe.self_monitor.tick()
+        d = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE} WHERE node = 'old'")
+        assert d["count(*)"][0] == 3               # capped per tick
+        for _ in range(3):
+            fe.self_monitor.tick()
+        d = _pydict(fe, f"SELECT count(*) FROM {PRIVATE_SCHEMA}."
+                        f"{PROFILE_SAMPLES_TABLE} WHERE node = 'old'")
+        assert d["count(*)"][0] == 0
+        fe.do_query("SET profile_retention_ms = 86400000")
+
+
+class TestInformationSchemaView:
+    def test_view_serves_stored_rows(self, fe):
+        fe.do_query("SET profiling = 1")
+        _spin(fe, 0.4)
+        d = _pydict(fe, "SELECT node, kind, count FROM "
+                        "information_schema.profile_samples")
+        assert d["node"] and "standalone" in d["node"]
+        assert set(d["kind"]) <= {"query", "flush", "compaction",
+                                  "flow", "balancer", "idle"}
+        fe.do_query("SET profiling = 0")
+
+    def test_view_empty_without_sampling(self, fe):
+        d = _pydict(fe, "SELECT count(*) FROM "
+                        "information_schema.profile_samples")
+        assert d["count(*)"][0] == 0
+
+
+class TestHttpBurst:
+    @pytest.fixture()
+    def server(self, fe):
+        from greptimedb_tpu.servers.http import HttpServer
+        srv = HttpServer(fe, addr="127.0.0.1:0")
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def _get(self, srv, path):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}",
+                    timeout=30) as resp:
+                return (resp.status, resp.headers.get_content_type(),
+                        resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get_content_type(), e.read()
+
+    def test_burst_folded_and_json(self, fe, server):
+        """The burst works with `SET profiling` OFF: it has its own
+        clock and rate."""
+        assert not profiler.enabled()
+        status, ctype, body = self._get(
+            server, "/debug/prof/cpu?seconds=0.3&format=json&hz=147")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["sample_count"] > 0
+        assert all({"node", "kind", "stack", "count"} <= set(r)
+                   for r in doc["rows"])
+        status, ctype, body = self._get(
+            server, "/debug/prof/cpu?seconds=0.2&format=folded")
+        assert status == 200 and ctype == "text/plain"
+        line = body.decode().splitlines()[0]
+        assert re.fullmatch(r"\S+ \d+", line)
+
+    def test_burst_flamegraph_svg(self, fe, server):
+        status, ctype, body = self._get(
+            server, "/debug/prof/cpu?seconds=0.2&format=flamegraph")
+        assert status == 200 and ctype == "image/svg+xml"
+        assert body.startswith(b"<svg")
+        assert b"samples" in body
+
+    def test_bad_format_400(self, fe, server):
+        status, _ctype, body = self._get(
+            server, "/debug/prof/cpu?format=pprof")
+        assert status == 400
+        assert b"not supported" in body
+
+
+class TestFlightAction:
+    @staticmethod
+    def _act(body):
+        """Drive FlightDatanodeServer's action handler directly — the
+        in-process twin of the socket round-trip (the profile branch
+        only touches the process-global sampler, never self)."""
+        import types
+
+        from greptimedb_tpu.servers.flight import FlightDatanodeServer
+        srv = types.SimpleNamespace()
+        results = list(FlightDatanodeServer._do_action_inner(
+            srv, "profile", body))
+        return json.loads(results[0].body.to_pybytes())
+
+    @staticmethod
+    def _park(ready, release):
+        ready.set()
+        release.wait(5)
+
+    def test_profile_action_drains_datanode_sampler(self):
+        """The wire path: a writer-less datanode sampler accumulates,
+        the Flight `profile` action hands rows to the caller."""
+        s = Profiler(node_label="dn9")       # writer-less: datanode
+        old = profiler.install(s)
+        try:
+            with _parked_thread(self._park):
+                s.sample_once()
+            assert s.pending_count() > 0
+            resp = self._act({"drain": True})
+            assert resp["ok"] and resp["rows"]
+            assert all(r["node"] == "dn9" for r in resp["rows"])
+            assert s.pending_count() == 0    # drained
+        finally:
+            profiler.install(old)
+
+    def test_profile_action_burst(self):
+        s = Profiler(node_label="dn9")
+        old = profiler.install(s)
+        try:
+            with _parked_thread(self._park):
+                resp = self._act({"seconds": 0.2, "hz": 147})
+            assert resp["ok"]
+            assert sum(r["count"] for r in resp["rows"]) > 0
+        finally:
+            s.stop()
+            profiler.install(old)
+
+
+class TestDistributedAttribution:
+    """Acceptance: on an in-process 4-datanode cluster, a slow
+    distributed query's ADMIN SHOW PROFILE '<trace_id>' sample nodes
+    cover every datanode the PR 15 waterfall names, and >=90% of work
+    samples are attributed (not idle)."""
+
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        from greptimedb_tpu.client import LocalDatanodeClient
+        from greptimedb_tpu.frontend.distributed import DistInstance
+        from greptimedb_tpu.meta import MetaClient, Peer
+        from greptimedb_tpu.meta.kv import MemKv
+        from greptimedb_tpu.meta.service import MetaSrv
+        datanodes, clients = {}, {}
+        srv = MetaSrv(MemKv(), datanode_lease_secs=3600)
+        meta = MetaClient(srv)
+        for i in (1, 2, 3, 4):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=str(tmp_path / f"dn{i}"), node_id=i,
+                register_numbers_table=False))
+            dn.start()
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        yield fe
+        for dn in datanodes.values():
+            dn.shutdown()
+
+    @pytest.mark.slow
+    def test_profile_nodes_cover_waterfall_datanodes(self, cluster):
+        fe = cluster
+        fe.do_query("SET profiling = 1")
+        fe.do_query("SET profile_hz = 147")
+        trace_store.configure(sample_ratio=1.0)
+        fe.do_query(
+            "CREATE TABLE m (host STRING, ts TIMESTAMP TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY(host)) "
+            "PARTITION BY HASH (host) PARTITIONS 8")
+        values = ", ".join(f"('h{i}', {1000 + i}, {float(i)})"
+                           for i in range(2000))
+        fe.do_query(f"INSERT INTO m VALUES {values}")
+        sql = ("SELECT host, avg(v), sum(v), min(v), max(v) FROM m "
+               "GROUP BY host")
+        deadline = time.time() + 10
+        tid = None
+        while time.time() < deadline:
+            fe.do_query(sql)
+            tid = trace_store.sink().last_retained
+            if tid and profiler.sampler().last_query_trace == tid:
+                break
+        assert tid is not None
+        out = fe.do_query(f"ADMIN SHOW PROFILE '{tid}'")[-1]
+        tree = []
+        for b in out.batches:
+            tree.extend(b.to_pylist())
+        assert tree
+        profile_nodes = {r["node"] for r in tree}
+        # the trace's waterfall names the datanodes the scatter touched
+        trace_store.sink().flush()
+        spans = trace_store.fetch_trace(fe.catalog, tid)
+        wf_nodes = {json.loads(r["attrs"])["peer"] for r in spans
+                    if r["span_name"] == "dist_rpc"}
+        assert wf_nodes                       # the query DID scatter
+        assert wf_nodes <= profile_nodes, (
+            f"profile missing datanodes: {wf_nodes - profile_nodes}")
+        assert "frontend" in profile_nodes
+        # attribution differential: >=90% of WORK samples (stacks inside
+        # the engine/dispatch/storage) carry a statement or job, not idle
+        d = _pydict(fe, "SELECT kind, stack, count FROM "
+                        "information_schema.profile_samples")
+        work = attributed = 0
+        work_re = re.compile(
+            r"execute_stmt|dist_rpc|region_moment|scan_batches|"
+            r"tpu_exec|write_region")
+        for kind, stack, count in zip(d["kind"], d["stack"], d["count"]):
+            if not work_re.search(stack):
+                continue
+            work += count
+            if kind != "idle":
+                attributed += count
+        assert work > 0
+        assert attributed / work >= 0.9, (
+            f"only {attributed}/{work} work samples attributed")
+        fe.do_query("SET profiling = 0")
+
+    @pytest.mark.slow
+    def test_trace_id_joins_profile_to_trace_spans(self, cluster):
+        """trace ids join profile_samples to trace_spans: one SQL query
+        correlates a trace's spans with its sampled stacks."""
+        fe = cluster
+        fe.do_query("SET profiling = 1")
+        fe.do_query("SET profile_hz = 147")
+        trace_store.configure(sample_ratio=1.0)
+        fe.do_query(
+            "CREATE TABLE j (host STRING, ts TIMESTAMP TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY(host)) "
+            "PARTITION BY HASH (host) PARTITIONS 4")
+        fe.do_query("INSERT INTO j VALUES ('a', 1000, 1.0)")
+        deadline = time.time() + 10
+        tid = None
+        while time.time() < deadline:
+            fe.do_query("SELECT host, avg(v) FROM j GROUP BY host")
+            tid = trace_store.sink().last_retained
+            if tid and profiler.sampler().last_query_trace == tid:
+                break
+        trace_store.sink().flush()
+        profiler.sync_and_fetch(fe.catalog, tid,
+                                clients=list(fe.clients.values()))
+        d = _pydict(fe, f"SELECT p.trace_id, t.span_name FROM "
+                        f"{PRIVATE_SCHEMA}.{PROFILE_SAMPLES_TABLE} p "
+                        f"JOIN {PRIVATE_SCHEMA}."
+                        f"{trace_store.TRACE_SPANS_TABLE} t "
+                        f"ON p.trace_id = t.trace_id "
+                        f"WHERE p.trace_id = '{tid}'")
+        assert d["trace_id"]
+        assert "execute_stmt" in set(d["span_name"])
+        fe.do_query("SET profiling = 0")
