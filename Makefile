@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: check lint typecheck test test-slow race baseline bench bench-qps \
-	bench-index bench-distagg bench-trace bench-promql bench-prof prof
+	bench-index bench-distagg bench-trace bench-promql bench-prof \
+	bench-replica prof
 
 check: lint typecheck test
 
@@ -89,6 +90,12 @@ prof:
 	JAX_PLATFORMS=cpu $(PY) -m pytest \
 	  tests/test_profiler.py -q -k standalone_end_to_end \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# only the ISSUE 19 metric: read QPS at 1/2/3 region replicas under
+# SET read_replica = 'follower', plus the leader kill -9 promotion
+# handoff window and the acked-loss/dup counts (asserted zero)
+bench-replica:
+	JAX_PLATFORMS=cpu GREPTIME_BENCH_ONLY=replica $(PY) bench.py
 
 # only the ISSUE 16 metric: 4-datanode PromQL range query
 # `sum by (hostname) (rate(...))` through the plan-IR pushdown vs the

@@ -1397,6 +1397,247 @@ def bench_region_migration_availability(n_rows: int):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_replicated_read_qps(n_rows: int = 100_000):
+    """Eighth driver metric (ISSUE 19): read-QPS scaling across region
+    read replicas, plus failover quality numbers:
+
+    - ``qps_{1,2,3}_replicas`` — SET read_replica = 'follower' point
+      reads against the same region served by 1 (leader only), 2 and 3
+      replicas; the rotating least-assigned pool spreads the load.
+    - ``promotion_handoff_ms`` — kill -9 twin of the leader under
+      sustained fsync-acked ingest → time until a write acks through
+      the promoted follower (lease loss + salvage + route commit).
+    - ``acked_lost_rows`` / ``dup_rows`` — every row acked before or
+      after the fault is readable exactly once (asserted zero/zero,
+      then published).
+
+    3 in-process datanodes over one SHARED object store AND one shared
+    data_home (node-scoped WAL dirs) — the deployment shape where
+    promotion can salvage the dead leader's fsynced WAL tail.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from greptimedb_tpu.client import LocalDatanodeClient
+    from greptimedb_tpu.datanode.instance import (DatanodeInstance,
+                                                  DatanodeOptions)
+    from greptimedb_tpu.frontend.distributed import (DistInstance,
+                                                     configure_read_replica)
+    from greptimedb_tpu.meta import (DatanodeStat, MemKv, MetaClient,
+                                     MetaSrv, Peer)
+    from greptimedb_tpu.query.stream_exec import region_stat_entries
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-replica-")
+    datanodes = {}
+    stop = threading.Event()
+    pump_t = None
+    try:
+        shared = FsObjectStore(f"{tmpdir}/shared")
+        srv = MetaSrv(MemKv(), datanode_lease_secs=3600.0)
+        srv.balancer.resend_interval_s = 0.05
+        meta = MetaClient(srv)
+        clients = {}
+        for i in (1, 2, 3):
+            dn = DatanodeInstance(DatanodeOptions(
+                data_home=f"{tmpdir}/home", node_id=i,
+                wal_sync_on_write=True,
+                register_numbers_table=False), store=shared)
+            dn.start()
+            dn.attach_meta(meta)
+            datanodes[i] = dn
+            clients[i] = LocalDatanodeClient(dn)
+            srv.register_datanode(Peer(i, f"dn{i}"))
+            srv.handle_heartbeat(i)
+        fe = DistInstance(meta, clients)
+        ctx = QueryContext()
+        fe.do_query(
+            "CREATE TABLE rr (host STRING, ts TIMESTAMP TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY(host))", ctx)
+        table = fe.catalog.table("greptime", "public", "rr")
+        table.bulk_load({
+            "host": np.array([f"h{i % 64}" for i in range(n_rows)],
+                             dtype=object),
+            "ts": np.arange(n_rows, dtype=np.int64) * 1000,
+            "v": np.random.default_rng(7).random(n_rows)})
+        table.flush()
+        route = srv.table_route("greptime.public.rr")
+        leader = next(rr.leader.id for rr in route.region_routes
+                      if rr.region_number == 0)
+        followers = [i for i in (1, 2, 3) if i != leader]
+
+        dead = set()
+
+        def pump():
+            # production cadence stand-in: balancer ticks + full
+            # stat-bearing heartbeats (they carry replicated_seq, the
+            # lag gate behind replica read eligibility) + failover scan
+            while not stop.is_set():
+                try:
+                    srv.balancer.tick()
+                    srv.failover_check()
+                    for i, dn in list(datanodes.items()):
+                        if i in dead:
+                            continue       # kill -9 twin: silence
+                        regions = dn.storage.list_regions()
+                        entries, rows_, nb = region_stat_entries(
+                            regions.values())
+                        resp = srv.handle_heartbeat(i, DatanodeStat(
+                            region_count=len(regions),
+                            approximate_rows=rows_,
+                            approximate_bytes=nb,
+                            region_stats=entries))
+                        for msg in resp.mailbox:
+                            dn._handle_mailbox(msg)
+                except Exception:  # noqa: BLE001 — a mid-fault pump
+                    pass           # round retries on the next tick
+                time.sleep(0.02)
+
+        pump_t = threading.Thread(target=pump, daemon=True)
+        pump_t.start()
+
+        def wait_replica(target):
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                caught = any(
+                    r.get("table_name") == "greptime.public.rr" and
+                    r.get("peer_id") == target and
+                    r.get("is_leader") == "No" and
+                    r.get("status") == "ALIVE" and
+                    r.get("lag_ms") is not None
+                    for r in srv.region_peers())
+                if caught and not srv.balancer.ops():
+                    return
+                time.sleep(0.02)
+            raise AssertionError(f"replica on dn{target} never caught up")
+
+        configure_read_replica(mode="follower", max_lag_ms=60_000)
+
+        def measure_qps(seconds=1.2, threads=4):
+            counts = [0] * threads
+            t_end = time.perf_counter() + seconds
+
+            def worker(k):
+                rng = np.random.default_rng(k)
+                while time.perf_counter() < t_end:
+                    h = int(rng.integers(0, 64))
+                    fe.do_query(
+                        f"SELECT count(*) FROM rr WHERE host = 'h{h}'",
+                        ctx)
+                    counts[k] += 1
+
+            ws = [threading.Thread(target=worker, args=(k,))
+                  for k in range(threads)]
+            for w in ws:
+                w.start()
+            for w in ws:
+                w.join()
+            return sum(counts) / seconds
+
+        qps = {1: measure_qps()}                  # leader only
+        fe.do_query(f"ADMIN ADD REPLICA rr 0 TO {followers[0]}", ctx)
+        wait_replica(followers[0])
+        qps[2] = measure_qps()
+        fe.do_query(f"ADMIN ADD REPLICA rr 0 TO {followers[1]}", ctx)
+        wait_replica(followers[1])
+        qps[3] = measure_qps()
+
+        # --- promotion handoff under sustained fsync-acked ingest ---
+        acked = []
+        ingest_stop = threading.Event()
+
+        def ingest():
+            n = 0
+            while not ingest_stop.is_set():
+                n += 1
+                key_ts = 10_000_000 + n
+                try:
+                    fe.do_query(
+                        f"INSERT INTO rr VALUES ('w', {key_ts}, 1.0)",
+                        ctx)
+                except Exception:  # noqa: BLE001 — an unacked write
+                    continue       # during the fault is legal
+                acked.append((key_ts, time.perf_counter()))
+
+        ingest_t = threading.Thread(target=ingest, daemon=True)
+        ingest_t.start()
+        time.sleep(0.3)                           # steady-state ingest
+        t_kill = time.perf_counter()
+        dn = datanodes[leader]
+        for region in dn.storage.list_regions().values():
+            with region._writer_lock:              # kill -9 twin: stop
+                region.closed = True               # answering mid-state
+                region.wal.close()
+        dead.add(leader)
+        srv._last_seen[leader] = 0.0               # lease lost
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            rt = srv.table_route("greptime.public.rr")
+            lid = next(r.leader.id for r in rt.region_routes
+                       if r.region_number == 0)
+            if lid != leader:
+                break
+            time.sleep(0.005)
+        else:
+            raise AssertionError("promotion never committed")
+        t_flip = time.perf_counter()
+        # first ack THROUGH the promoted follower bounds the handoff
+        # (acks before the route flip were in-flight writes the kill
+        # loop let drain under the writer lock — not handoff evidence)
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if any(t > t_flip for _, t in acked):
+                break
+            time.sleep(0.005)
+        first_ack = min(t for _, t in acked if t > t_flip)
+        handoff_ms = (first_ack - t_kill) * 1e3
+        time.sleep(0.3)                           # post-handoff ingest
+        ingest_stop.set()
+        ingest_t.join(timeout=60)
+
+        # continuity: every acked row readable exactly once
+        configure_read_replica(mode="leader")
+        out = fe.do_query(
+            "SELECT ts FROM rr WHERE ts >= 10000000", ctx)[-1]
+        got = [r[0] for b in out.batches for r in b.rows()]
+        lost = len({k for k, _ in acked} - set(got))
+        dup = len(got) - len(set(got))
+        assert lost == 0, f"lost {lost} acked rows"
+        assert dup == 0, f"{dup} duplicated rows"
+        return (qps[1], qps[2], qps[3], handoff_ms, len(acked), lost,
+                dup)
+    finally:
+        stop.set()
+        if pump_t is not None:
+            pump_t.join(timeout=10)
+        configure_read_replica(mode="leader", max_lag_ms=5000)
+        for dn in datanodes.values():
+            try:
+                dn.shutdown()
+            except Exception:  # noqa: BLE001 — the killed twin's WAL is
+                pass           # already closed
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def emit_replicated_read_qps():
+    q1, q2, q3, handoff_ms, acked_n, lost, dup = \
+        bench_replicated_read_qps()
+    print(json.dumps({
+        "metric": "replicated_read_qps",
+        "value": round(q3, 1),
+        "unit": "qps_at_3_replicas",
+        "qps_1_replica": round(q1, 1),
+        "qps_2_replicas": round(q2, 1),
+        "qps_3_replicas": round(q3, 1),
+        "promotion_handoff_ms": round(handoff_ms, 1),
+        "acked_writes_during_failover": acked_n,
+        "acked_lost_rows": lost,
+        "dup_rows": dup,
+    }))
+
+
 def bench_index_point_query(n_series: int = 100_000, files: int = 16):
     """Seventh driver metric (ISSUE 13): high-cardinality point-query
     throughput against a persisted many-SST region, with the per-SST
@@ -1587,6 +1828,9 @@ def main():
     if os.environ.get("GREPTIME_BENCH_ONLY") == "promql":
         emit_promql_dist_range()
         return
+    if os.environ.get("GREPTIME_BENCH_ONLY") == "replica":
+        emit_replicated_read_qps()
+        return
     if os.environ.get("GREPTIME_BENCH_ONLY") == "trace":
         emit_trace_store_overhead()
         return
@@ -1671,6 +1915,8 @@ def main():
         "lost_rows": lost,
         "dup_rows": dup,
     }))
+
+    emit_replicated_read_qps()
 
     fp_rows = int(os.environ.get("GREPTIME_BENCH_FAILPOINT_ROWS",
                                  2_000_000))

@@ -78,6 +78,11 @@ _REGION_PEERS_SCHEMA = Schema([
     ColumnSchema("peer_addr", dt.STRING),
     ColumnSchema("is_leader", dt.STRING),
     ColumnSchema("status", dt.STRING),
+    # read replicas (PR 19): the leader row's replicated_seq is its
+    # committed sequence; a follower row's is its applied position, and
+    # lag_ms bounds its staleness (0 = caught up, NULL = no beat yet)
+    ColumnSchema("replicated_seq", dt.INT64, nullable=True),
+    ColumnSchema("lag_ms", dt.INT64, nullable=True),
     ColumnSchema("route_version", dt.INT64),
     ColumnSchema("operation", dt.STRING, nullable=True),
     ColumnSchema("op_id", dt.STRING, nullable=True),
@@ -318,11 +323,15 @@ def _region_peer_rows(catalog_manager, catalog_name: str):
             if not regions:
                 continue
             for rn in sorted(regions):
+                vc = getattr(regions[rn], "version_control", None)
                 rows.append({
                     "table_name":
                         f"{catalog_name}.{schema_name}.{tname}",
                     "region_number": rn, "peer_id": 0, "peer_addr": "",
                     "is_leader": "Yes", "status": "ALIVE",
+                    "replicated_seq": int(vc.committed_sequence)
+                    if vc is not None else None,
+                    "lag_ms": 0,
                     "route_version": 0, "operation": None,
                     "op_id": None,
                 })

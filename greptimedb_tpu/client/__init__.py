@@ -157,6 +157,16 @@ class LocalDatanodeClient(DatanodeClient):
     def ping(self) -> int:
         return self.node_id
 
+    def repl_apply(self, catalog: str, schema: str, table: str,
+                   region_number: int, entries: list,
+                   leader_flushed: int = 0) -> dict:
+        """Apply shipped WAL records to this node's standby replica of
+        the region (the continuous-replication consumer side)."""
+        with self._node_ctx():
+            return self.datanode.repl_apply(
+                catalog, schema, table, region_number, entries,
+                leader_flushed=leader_flushed)
+
     def background_jobs(self) -> list:
         """In-process twin of the Flight action. The registry is
         process-wide, so for an in-process cluster these rows duplicate

@@ -280,6 +280,16 @@ class FlightDatanodeClient(_FlightBase, DatanodeClient):
     def ping(self) -> int:
         return int(self._action("ping", {})["node_id"])
 
+    def repl_apply(self, catalog: str, schema: str, table: str,
+                   region_number: int, entries: list,
+                   leader_flushed: int = 0) -> dict:
+        """Ship WAL records to this node's standby replica of the region
+        (leader shipper → follower, the continuous replication hop)."""
+        return self._action("repl_apply", {
+            "catalog": catalog, "schema": schema, "table": table,
+            "region_number": int(region_number), "entries": entries,
+            "leader_flushed": int(leader_flushed)})
+
     def background_jobs(self) -> list:
         """This datanode's live + recent background jobs (the
         cluster-merged information_schema.background_jobs view)."""

@@ -325,7 +325,9 @@ def datanode_start(args) -> None:
     profiler.install(profiler.Profiler(node_label=label))
     dn = DatanodeInstance(DatanodeOptions(
         data_home=args.data_home or "./greptimedb_data",
-        node_id=args.node_id, register_numbers_table=False))
+        node_id=args.node_id, register_numbers_table=False,
+        wal_sync_on_write=bool(getattr(args, "wal_sync_on_write",
+                                       False))))
     dn.start()
     server = FlightDatanodeServer(dn, f"grpc://{args.rpc_addr}")
     server.serve_in_background()
@@ -431,6 +433,10 @@ def main(argv=None) -> int:
     dstart.add_argument("--metasrv-addr", default="127.0.0.1:3002")
     dstart.add_argument("--data-home")
     dstart.add_argument("--heartbeat-interval", type=float, default=5.0)
+    dstart.add_argument("--wal-sync-on-write", action="store_true",
+                        help="fsync the WAL before acking each write "
+                             "(the replication acceptance drives run "
+                             "with this on)")
     dstart.add_argument("--log-level")
     dstart.set_defaults(func=datanode_start)
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -33,6 +34,22 @@ from .. import DEFAULT_CATALOG_NAME, DEFAULT_SCHEMA_NAME
 logger = logging.getLogger(__name__)
 
 _fp.register("balancer_snapshot_upload")
+_fp.register("repl_apply")
+_fp.register("repl_promote")
+_fp.register("repl_bootstrap")
+
+#: live in-process datanodes by node id (latest wins) — the replica
+#: shipper resolves same-process followers here instead of dialing a
+#: Flight socket (single-process clusters: tests, embedded topologies)
+_live_lock = threading.Lock()
+_live_datanodes: Dict[int, "DatanodeInstance"] = {}
+
+
+def live_datanode(node_id) -> Optional["DatanodeInstance"]:
+    if node_id is None:
+        return None
+    with _live_lock:
+        return _live_datanodes.get(int(node_id))
 
 
 @dataclass
@@ -106,6 +123,13 @@ class DatanodeInstance:
         #: meta client for datanode→meta control RPCs (balancer step
         #: acks); start_heartbeat wires it, tests may attach directly
         self._meta_client = None
+        # continuous WAL-tail replication to read replicas (ISSUE 19):
+        # repl_set_followers mailbox steps wire regions in, the region
+        # on_commit hook nudges the ship thread
+        from .replication import ReplicaShipper
+        self.replication = ReplicaShipper(self)
+        with _live_lock:
+            _live_datanodes[int(opts.node_id)] = self
 
     def _create_flow_sink(self, spec, schema, pk_indices):
         from ..table.requests import CreateTableRequest
@@ -214,7 +238,8 @@ class DatanodeInstance:
                                   msg["table"]) is None:
                 self.catalog.register_table(
                     msg["catalog"], msg["schema"], msg["table"], table)
-        elif kind is not None and kind.startswith("balancer_"):
+        elif kind is not None and (kind.startswith("balancer_") or
+                                   kind.startswith("repl_")):
             self._handle_balancer_msg(msg)
 
     # ---- elastic-region steps (meta/balancer.py's worker side) ----
@@ -236,6 +261,10 @@ class DatanodeInstance:
             logger.exception("balancer step %s of op %s failed",
                              step, op_id)
             ok, error, payload = False, f"{type(e).__name__}: {e}", {}
+        if op_id is None:
+            # fire-and-forget control message (failover promotion /
+            # follower re-wiring): no op doc is waiting on an ack
+            return
         if self._meta_client is None:
             logger.error("balancer step %s of op %s has no meta client "
                          "to ack through", step, op_id)
@@ -312,13 +341,108 @@ class DatanodeInstance:
             self.mito.abort_split(cat, sch, tbl, msg["region"],
                                   list(msg["children"]))
             return {}
+        if kind == "repl_bootstrap":
+            # replica-add step 2 (leader side): the WAL delta past the
+            # snapshot's flushed sequence, WITHOUT fencing — ingest
+            # continues; the continuous shipper covers records committed
+            # after this read (followers dedup by sequence)
+            _fp.fail_point("repl_bootstrap")
+            _, region = self.mito._hosted(cat, sch, tbl, msg["region"])
+            flushed = int(region.version_control.current.flushed_sequence)
+            return {"wal_tail": region.wal_entries_since(flushed),
+                    "flushed_seq": flushed}
+        if kind == "repl_attach":
+            # replica-add step 3 (follower side): adopt the last-flushed
+            # shared state as a durable standby + replay the bootstrap
+            # tail at its original sequences
+            table = self.mito.adopt_standby(
+                msg["table_info"], msg["region"], msg.get("wal_tail"))
+            if self.catalog.table(cat, sch, tbl) is None:
+                self.catalog.register_table(cat, sch, tbl, table)
+            return {"replayed": len(msg.get("wal_tail") or [])}
+        if kind == "repl_set_followers":
+            # leader side, post-commit (and after failover promotions):
+            # (re)wire the continuous shipper's follower set
+            _, region = self.mito._hosted(cat, sch, tbl, msg["region"])
+            n = self.replication.set_followers(
+                cat, sch, tbl, msg["region"], region.name,
+                list(msg.get("followers") or []))
+            return {"followers": n}
+        if kind == "repl_drop":
+            # follower side: detach the standby (replica removed, or a
+            # pre-commit replica-add rollback)
+            gone = self.mito.release_region(cat, sch, tbl, msg["region"])
+            if gone:
+                self.catalog.deregister_table(cat, sch, tbl)
+            return {"table_gone": gone}
+        if kind == "repl_promote":
+            # failover promotion (fire-and-forget from failover_check):
+            # fence the dead leader's WAL dir, refresh from the shared
+            # manifest, salvage + replay its surviving WAL records, then
+            # take over as leader — zero acked rows lost
+            _fp.fail_point("repl_promote")
+            _, region = self.mito._hosted(cat, sch, tbl, msg["region"])
+            if not getattr(region, "standby", False):
+                # re-delivered promotion (meta retries the fire-and-
+                # forget mail until a heartbeat confirms): already leader
+                return {"salvaged": 0, "replayed": 0, "committed_seq":
+                        int(region.version_control.committed_sequence)}
+            old_id = msg.get("old_leader")
+            old_dir = self._wal_dir_of(old_id, region.name) \
+                if old_id is not None else None
+            return self.mito.promote_standby(cat, sch, tbl, msg["region"],
+                                             old_dir)
         from ..errors import UnsupportedError
         raise UnsupportedError(f"unknown balancer step {kind!r}")
 
+    def _wal_dir_of(self, node_id: int, region_name: str) -> str:
+        """Another datanode's WAL dir for a region, on the SHARED
+        data_home (mirrors EngineConfig.wal_home scoping) — promotion
+        salvages a dead leader's acked-but-unflushed records from it."""
+        if node_id:
+            return os.path.join(self.opts.data_home, "nodes",
+                                str(node_id), "wal", region_name)
+        return os.path.join(self.opts.data_home, "wal", region_name)
+
+    # ---- replica apply (follower side of the continuous ship path;
+    # reached in-process via the shipper or over the repl_apply Flight
+    # action) ----
+    def repl_apply(self, catalog: str, schema: str, table: str,
+                   region_number: int, entries: list,
+                   leader_flushed: int = 0) -> dict:
+        _fp.fail_point("repl_apply")
+        _, region = self.mito._hosted(catalog, schema, table,
+                                      region_number)
+        if not region.standby:
+            # already promoted (or never a standby): a late ship from a
+            # deposed leader — ignore it; the WAL-dir fence keeps that
+            # leader from acking anything new
+            return {"replayed": 0, "standby": False, "committed_seq":
+                    int(region.version_control.committed_sequence)}
+        vc = region.version_control
+        gap = bool(entries) and \
+            int(entries[0]["seq"]) > vc.committed_sequence + 1
+        if gap or int(leader_flushed or 0) > \
+                vc.current.flushed_sequence:
+            # the leader flushed past this replica's manifest view (or
+            # shipped records skipped ahead): reopen from the CURRENT
+            # shared manifest — it always covers the gap, and the reopen
+            # bounds the standby's memtable to the leader's unflushed
+            # window
+            region = self.mito.refresh_standby(catalog, schema, table,
+                                               region_number)
+        replayed = region.ingest_wal_tail(entries) if entries else 0
+        return {"replayed": replayed, "standby": True, "committed_seq":
+                int(region.version_control.committed_sequence)}
+
     def shutdown(self) -> None:
+        self.replication.stop()
         self.flow_manager.stop()
         if self._heartbeat_task is not None:
             self._heartbeat_task.stop()
         for engine in self.engines.values():
             engine.close()
         self.storage.close()
+        with _live_lock:
+            if _live_datanodes.get(int(self.opts.node_id)) is self:
+                del _live_datanodes[int(self.opts.node_id)]

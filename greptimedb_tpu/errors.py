@@ -140,6 +140,14 @@ class SchedulerStoppedError(StorageError, RuntimeError):
     machinery covers the data)."""
 
 
+class RegionClosedError(StorageError):
+    """The region is closed on this node (shutdown, or a crashed node's
+    in-process twin). To a distributed frontend this is a stale-route
+    signal: the region either moved or is being failed over — refresh
+    the route and retry, exactly like a dead peer's connection error
+    over the wire."""
+
+
 class RegionNotFoundError(GreptimeError):
     status_code = StatusCode.REGION_NOT_FOUND
 

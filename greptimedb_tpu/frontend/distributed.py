@@ -33,6 +33,7 @@ The data plane is a PARALLEL, PRUNED scatter-gather executor:
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -49,8 +50,9 @@ from ..common.failpoint import register as _fp_register
 from ..common.runtime import env_int
 from ..datatypes.schema import Schema
 from ..errors import (
-    GreptimeError, InvalidArgumentsError, StaleRouteError,
-    TableAlreadyExistsError, TableNotFoundError, UnsupportedError)
+    GreptimeError, InvalidArgumentsError, RegionClosedError,
+    StaleRouteError, TableAlreadyExistsError, TableNotFoundError,
+    UnsupportedError)
 from ..meta import MetaClient, TableRoute
 from ..partition import rule_from_partitions, split_rows
 from ..query import QueryEngine
@@ -98,6 +100,35 @@ def configure_dist_rpc_retry(*, max_retries: Optional[int] = None,
         _DIST_RPC_MAX_RETRIES[0] = max(0, int(max_retries))
     if base_ms is not None:
         _DIST_RPC_BASE_MS[0] = max(1, int(base_ms))
+
+
+#: replica-aware read routing (PR 19): "leader" scatters reads to region
+#: leaders only; "follower" lets reads land on read replicas whose
+#: replication lag is inside the bounded-staleness budget below,
+#: balancing by per-node assignment count. SET read_replica /
+#: SET replica_max_lag_ms flip these at runtime; GREPTIME_* twins seed.
+_READ_REPLICA = [os.environ.get("GREPTIME_READ_REPLICA",
+                                "leader").strip().lower() or "leader"]
+_REPLICA_MAX_LAG_MS = [env_int("GREPTIME_REPLICA_MAX_LAG_MS", 5000)]
+
+
+def configure_read_replica(mode: Optional[str] = None,
+                           max_lag_ms: Optional[int] = None) -> None:
+    """SET read_replica = leader|follower / SET replica_max_lag_ms."""
+    if mode is not None:
+        mode = str(mode).strip().lower()
+        if mode not in ("leader", "follower"):
+            raise InvalidArgumentsError(
+                f"read_replica: expected 'leader' or 'follower', "
+                f"got {mode!r}")
+        _READ_REPLICA[0] = mode
+    if max_lag_ms is not None:
+        try:
+            _REPLICA_MAX_LAG_MS[0] = max(0, int(float(max_lag_ms)))
+        except (TypeError, ValueError):
+            raise InvalidArgumentsError(
+                f"replica_max_lag_ms: expected a number, got "
+                f"{max_lag_ms!r}")
 
 
 def _dist_rpc(what: str, call):
@@ -209,7 +240,8 @@ class DistTable(Table):
                 # refresh covers the detection window). Everything else
                 # propagates untouched.
                 retryable = isinstance(
-                    e, (StaleRouteError, TableNotFoundError)) or \
+                    e, (StaleRouteError, TableNotFoundError,
+                        RegionClosedError)) or \
                     is_transient(e)
                 if not retryable or \
                         attempt >= _STALE_ROUTE_MAX_RETRIES[0]:
@@ -269,7 +301,11 @@ class DistTable(Table):
                 self.info.catalog_name, self.info.schema_name,
                 self.info.name)
             if dn_table is not None:
-                out.update(dn_table.regions)
+                # skip standby replicas: the leader's copy of the same
+                # region number is the authoritative one for the union
+                out.update({rn: reg for rn, reg
+                            in dn_table.regions.items()
+                            if not getattr(reg, "standby", False)})
         return out
 
     # ---- pruning ----
@@ -333,6 +369,86 @@ class DistTable(Table):
             if client is None:
                 raise GreptimeError(f"no client for datanode {node_id}")
             out.append((client, sorted(by_node[node_id])))
+        return out
+
+    #: region_peers cache TTL for replica routing: one meta read serves
+    #: a read burst; lag only moves at heartbeat cadence anyway
+    _REPLICA_TTL_S = 5.0
+
+    def _replica_candidates(self) -> Dict[int, List[int]]:
+        """{region_number: [alive follower node ids inside the lag
+        bound]} from meta's region_peers, TTL-cached per route version.
+        Empty on any failure — replica routing is an optimization and
+        must never fail a read (it degrades to the leader)."""
+        if self.meta is None or not hasattr(self.meta, "region_peers"):
+            return {}
+        now = time.monotonic()
+        cache = getattr(self, "_replica_cache", None)
+        if cache is not None and cache[0] > now and \
+                cache[1] == self.route.version:
+            return cache[2]
+        max_lag = _REPLICA_MAX_LAG_MS[0]
+        full = (f"{self.info.catalog_name}.{self.info.schema_name}."
+                f"{self.info.name}")
+        out: Dict[int, List[int]] = {}
+        try:
+            for row in self.meta.region_peers():
+                if row.get("table_name") != full or \
+                        row.get("is_leader") == "Yes" or \
+                        row.get("status") != "ALIVE":
+                    continue
+                lag = row.get("lag_ms")
+                if lag is None or lag > max_lag:
+                    continue
+                out.setdefault(int(row["region_number"]), []).append(
+                    int(row["peer_id"]))
+        except Exception:  # noqa: BLE001 — degrade to leader reads
+            logger.exception("replica candidate lookup for %s failed; "
+                             "reads stay on leaders", full)
+            out = {}
+        self._replica_cache = (now + self._REPLICA_TTL_S,
+                               self.route.version, out)
+        return out
+
+    def _read_owners_for(self, region_numbers: Sequence[int]
+                         ) -> List[Tuple[DatanodeClient, List[int]]]:
+        """Scatter targets for a READ. Leader-only unless SET
+        read_replica = 'follower': then each region picks the least-
+        assigned node among its leader and lag-bounded followers
+        (cost-based: per-node load with the replicated_seq lag gate),
+        spreading a hot table's read QPS across its replicas. Writes
+        always use _owners_for — only the leader may ack."""
+        if _READ_REPLICA[0] != "follower":
+            return self._owners_for(region_numbers)
+        candidates = self._replica_candidates()
+        if not candidates:
+            return self._owners_for(region_numbers)
+        wanted = set(region_numbers)
+        count: Dict[int, int] = {}
+        assigned: Dict[int, List[int]] = {}
+        # rotating start keeps successive queries spreading over the
+        # pool (a single-region table would otherwise pin every read to
+        # the tie-winning leader and replicas would never take traffic)
+        rot = self._read_rr = getattr(self, "_read_rr", 0) + 1
+        for rr in sorted(self.route.region_routes,
+                         key=lambda r: r.region_number):
+            if rr.region_number not in wanted:
+                continue
+            pool = [rr.leader.id] + [
+                n for n in candidates.get(rr.region_number, ())
+                if n in self.clients]
+            pool = pool[rot % len(pool):] + pool[:rot % len(pool)]
+            # least-assigned within this scatter; min() keeps the first
+            # (rotated) entry on ties
+            pick = min(pool, key=lambda n: count.get(n, 0))
+            count[pick] = count.get(pick, 0) + 1
+            assigned.setdefault(pick, []).append(rr.region_number)
+        out = []
+        for node_id in sorted(assigned):
+            client = self.clients.get(node_id)
+            if client is None:
+                raise GreptimeError(f"no client for datanode {node_id}")
+            out.append((client, sorted(assigned[node_id])))
         return out
 
     # ---- scatter-gather core ----
@@ -452,8 +568,11 @@ class DistTable(Table):
             except GreptimeError as e:
                 # also covers _owner()'s "region not in route" against a
                 # refreshed-but-shrunk route; only stale-route shapes
-                # re-route — everything else propagates
-                if not isinstance(e, StaleRouteError) and \
+                # re-route — everything else propagates. A CLOSED region
+                # is one: the node died or released it (failover moves
+                # the lease, so the refreshed route points elsewhere)
+                if not isinstance(e, (StaleRouteError,
+                                      RegionClosedError)) and \
                         "not in route" not in str(e):
                     raise
                 # the region moved (migrate) or was refined away (split)
@@ -509,7 +628,7 @@ class DistTable(Table):
                 from ..common.telemetry import increment_counter
                 increment_counter("stale_route_write_reroutes")
                 return written
-            except StaleRouteError as e:
+            except (StaleRouteError, RegionClosedError) as e:
                 logger.info("re-routed write to %s still stale (%s); "
                             "retry %d/%d", self.info.name, e, attempt,
                             _STALE_ROUTE_MAX_RETRIES[0])
@@ -543,7 +662,7 @@ class DistTable(Table):
         filters = list(filters or ())
         survivors, total = self._prune_regions(filters=filters,
                                                time_range=time_range)
-        targets = self._owners_for(survivors)
+        targets = self._read_owners_for(survivors)
         tag_names = self.schema.tag_names()
         ship = [f for f in filters if pushable_tag_filter(f, tag_names)]
         wire_limit = limit if limit is not None and \
@@ -601,7 +720,7 @@ class DistTable(Table):
         survivors, total = self._prune_regions(
             filters=plan.tag_predicates, time_lo=plan.time_lo,
             time_hi=plan.time_hi)
-        targets = self._owners_for(survivors)
+        targets = self._read_owners_for(survivors)
         cost = self._plan_cost(plan, survivors)
         result = (survivors, total, targets, cost)
         plan._dist_scatter_cache = (self, self.route.version, result)
@@ -1303,6 +1422,15 @@ class DistInstance:
                 self.meta.balancer_configure(
                     name[len("balancer_"):], stmt.value)
                 return _Output.rows(0)
+            if name in ("read_replica", "replica_max_lag_ms"):
+                # replica-aware read routing is frontend-local state
+                # (each frontend scatters its own reads)
+                from ..query.output import Output as _Output
+                if name == "read_replica":
+                    configure_read_replica(mode=stmt.value)
+                else:
+                    configure_read_replica(max_lag_ms=stmt.value)
+                return _Output.rows(0)
             from .statement import apply_set_variable
             return apply_set_variable(stmt, ctx)
         if isinstance(stmt, ast.Kill):
@@ -1371,6 +1499,12 @@ class DistInstance:
         elif stmt.kind == "split_region":
             op = self.meta.admin_split_region(full, stmt.region,
                                               stmt.at_value)
+        elif stmt.kind == "add_replica":
+            op = self.meta.admin_add_replica(full, stmt.region,
+                                             stmt.target_node)
+        elif stmt.kind == "remove_replica":
+            op = self.meta.admin_remove_replica(full, stmt.region,
+                                                stmt.target_node)
         else:
             raise UnsupportedError(f"ADMIN {stmt.kind}")
         return admin_ops_output([op])

@@ -188,6 +188,11 @@ def admin_ops_output(ops: List[dict]) -> Output:
     def detail(op: dict) -> str:
         if op["kind"] == "migrate":
             return f"dn{op['from_node']} -> dn{op['to_node']}"
+        if op["kind"] == "replica_add":
+            return f"replica on dn{op['to_node']} (leader " \
+                   f"dn{op['from_node']})"
+        if op["kind"] == "replica_remove":
+            return f"drop replica on dn{op['to_node']}"
         d = f"children={op['children']}"
         if op.get("at_value") is not None:
             d += f" at={op['at_value']!r}"
@@ -544,6 +549,14 @@ def apply_set_variable(stmt: ast.SetVariable, ctx: QueryContext) -> Output:
         raise InvalidArgumentsError(
             f"SET {stmt.name}: balancer knobs apply to a distributed "
             f"cluster (standalone has no region balancer)")
+    elif name in ("read_replica", "replica_max_lag_ms"):
+        # replica-aware read routing is a distributed-frontend feature
+        # (DistInstance intercepts BEFORE this shared handler); a
+        # standalone deployment has no region replicas to read from
+        from ..errors import UnsupportedError
+        raise UnsupportedError(
+            f"SET {stmt.name}: read replicas require a distributed "
+            f"deployment (metasrv + datanodes)")
     elif name in _CLIENT_COMPAT_VARS or name.startswith("@"):
         # connection boilerplate from wire clients: accepted, ignored
         pass

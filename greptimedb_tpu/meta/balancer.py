@@ -20,6 +20,11 @@ drives three multi-step, crash-safe region operations:
   overloaded datanodes toward the least-loaded alive ones (the
   load_based selector's heat, applied continuously instead of only at
   CREATE TABLE).
+- **replica add/remove** (PR 19) — bootstrap a read replica of a region
+  on another datanode (flush-snapshot → WAL-tail bootstrap through the
+  op doc → standby attach → atomic route commit → continuous-shipping
+  wire-up) or detach one (route commit first, then drop). Followers
+  serve bounded-staleness reads and are the failover promotion pool.
 
 Every operation is a resumable state machine persisted in the meta KV
 under ``__balancer/`` (the ``__flow/`` durability pattern): each step is
@@ -58,7 +63,8 @@ SEQ_KEY = "__balancer/seq"
 
 #: op states that precede the route/rule commit: a failure there rolls
 #: back; every later state must roll FORWARD (the route already moved)
-_PRE_COMMIT = {"snapshot", "fence", "open", "prepare", "catchup"}
+_PRE_COMMIT = {"snapshot", "fence", "open", "prepare", "catchup",
+               "bootstrap", "attach"}
 
 #: op state -> the mailbox message type whose ack advances it
 _STEP_MSG = {
@@ -69,6 +75,12 @@ _STEP_MSG = {
     ("split", "prepare"): "balancer_split_prepare",
     ("split", "catchup"): "balancer_split_catchup",
     ("split", "apply"): "balancer_split_apply",
+    ("replica_add", "snapshot"): "balancer_snapshot",
+    ("replica_add", "bootstrap"): "repl_bootstrap",
+    ("replica_add", "attach"): "repl_attach",
+    ("replica_add", "wire"): "repl_set_followers",
+    ("replica_remove", "drop"): "repl_drop",
+    ("replica_remove", "wire"): "repl_set_followers",
 }
 
 
@@ -333,6 +345,85 @@ class RegionBalancer:
             increment_counter("balancer_rebalance_moves", len(out))
         return out
 
+    def add_replica(self, full_name: str, region: int, to_node: int
+                    ) -> dict:
+        """ADMIN ADD REPLICA: bootstrap a read replica of the region on
+        `to_node` (snapshot → WAL-tail bootstrap → standby attach →
+        atomic route commit → shipper wire-up)."""
+        from ..common.telemetry import increment_counter
+        route = self.srv.table_route(full_name)
+        if route is None:
+            raise GreptimeError(f"table {full_name} has no route")
+        rr = next((r for r in route.region_routes
+                   if r.region_number == region), None)
+        if rr is None:
+            raise InvalidArgumentsError(
+                f"region {region} is not in the route of {full_name} "
+                f"(have {[r.region_number for r in route.region_routes]})")
+        if self.srv.peer(to_node) is None:
+            raise InvalidArgumentsError(
+                f"datanode {to_node} is not registered")
+        if rr.leader.id == to_node:
+            raise InvalidArgumentsError(
+                f"datanode {to_node} already leads region {region} of "
+                f"{full_name}; a leader cannot follow itself")
+        if any(f.id == to_node for f in rr.followers):
+            raise InvalidArgumentsError(
+                f"datanode {to_node} is already a replica of region "
+                f"{region} of {full_name}")
+        self._check_can_enqueue(full_name)
+        catalog, schema, table = full_name.split(".", 2)
+        op = {
+            "id": self._alloc_id(), "kind": "replica_add",
+            "catalog": catalog, "schema": schema, "table": full_name,
+            "table_short": table, "region": int(region),
+            "from_node": int(rr.leader.id), "to_node": int(to_node),
+            "state": "snapshot", "wal_tail": None, "flushed_seq": 0,
+            "created_ms": int(time.time() * 1000),
+        }
+        self._save(op)
+        increment_counter("balancer_ops_started")
+        increment_counter("balancer_replica_adds_started")
+        logger.info("balancer: enqueued %s — add replica of region %s of "
+                    "%s on dn%d (leader dn%d)", op["id"], region,
+                    full_name, to_node, op["from_node"])
+        return op
+
+    def remove_replica(self, full_name: str, region: int, node: int
+                       ) -> dict:
+        """ADMIN REMOVE REPLICA: detach a follower — route commit first
+        (reads stop scattering there), then drop its standby region."""
+        from ..common.telemetry import increment_counter
+        route = self.srv.table_route(full_name)
+        if route is None:
+            raise GreptimeError(f"table {full_name} has no route")
+        rr = next((r for r in route.region_routes
+                   if r.region_number == region), None)
+        if rr is None:
+            raise InvalidArgumentsError(
+                f"region {region} is not in the route of {full_name}")
+        if all(f.id != node for f in rr.followers):
+            raise InvalidArgumentsError(
+                f"datanode {node} is not a replica of region {region} of "
+                f"{full_name} (followers: "
+                f"{[f.id for f in rr.followers]})")
+        self._check_can_enqueue(full_name)
+        catalog, schema, table = full_name.split(".", 2)
+        op = {
+            "id": self._alloc_id(), "kind": "replica_remove",
+            "catalog": catalog, "schema": schema, "table": full_name,
+            "table_short": table, "region": int(region),
+            "from_node": int(rr.leader.id), "to_node": int(node),
+            "state": "commit",
+            "created_ms": int(time.time() * 1000),
+        }
+        self._save(op)
+        increment_counter("balancer_ops_started")
+        increment_counter("balancer_replica_removes_started")
+        logger.info("balancer: enqueued %s — remove replica of region %s "
+                    "of %s from dn%d", op["id"], region, full_name, node)
+        return op
+
     def _check_can_enqueue(self, full_name: str) -> None:
         inflight = self._inflight_tables()
         if full_name in inflight:
@@ -398,6 +489,10 @@ class RegionBalancer:
         if state == "commit":
             if op["kind"] == "migrate":
                 self._commit_migrate(op)
+            elif op["kind"] == "replica_add":
+                self._commit_replica_add(op)
+            elif op["kind"] == "replica_remove":
+                self._commit_replica_remove(op)
             else:
                 self._commit_split(op)
             return True
@@ -435,6 +530,8 @@ class RegionBalancer:
         payload = ack["payload"]
         if op["kind"] == "migrate":
             self._migrate_on_ack(op, state, payload)
+        elif op["kind"] in ("replica_add", "replica_remove"):
+            self._replica_on_ack(op, state, payload)
         else:
             self._split_on_ack(op, state, payload)
         return True
@@ -465,6 +562,35 @@ class RegionBalancer:
                 return op["to_node"], {
                     **base, "table_info": info,
                     "wal_tail": op.get("wal_tail") or []}
+            return op["from_node"], base
+        if op["kind"] in ("replica_add", "replica_remove"):
+            if msg_type == "repl_attach":
+                info = self.srv.table_info(op["table"])
+                if info is None:
+                    raise GreptimeError(
+                        f"no table info for {op['table']} — cannot "
+                        f"materialize the standby on dn{op['to_node']}")
+                return op["to_node"], {
+                    **base, "table_info": info,
+                    "wal_tail": op.get("wal_tail") or []}
+            if msg_type == "repl_drop":
+                return op["to_node"], base
+            if msg_type == "repl_set_followers":
+                # the re-wire targets the route's CURRENT leader with the
+                # route's CURRENT follower set (a failover may have moved
+                # either since the op was enqueued)
+                route = self.srv.table_route(op["table"])
+                rr = next((r for r in (route.region_routes
+                                       if route else [])
+                           if r.region_number == op["region"]), None)
+                if rr is None:
+                    raise GreptimeError(
+                        f"route for region {op['region']} of "
+                        f"{op['table']} vanished mid-op")
+                return rr.leader.id, {
+                    **base,
+                    "followers": [f.to_dict() for f in rr.followers]}
+            # balancer_snapshot / repl_bootstrap run on the leader
             return op["from_node"], base
         # split: every step runs on the owning node
         extra: dict = {"children": op["children"]}
@@ -602,12 +728,108 @@ class RegionBalancer:
                     op["region"], op["table"], op["children"],
                     op["at_value"], route.version)
 
+    # ---- replica add/remove transitions ----
+    def _replica_on_ack(self, op: dict, state: str, payload: dict
+                        ) -> None:
+        if state == "snapshot":
+            # leader flushed: the shared-store SSTs now cover everything
+            # below its flushed sequence, so the bootstrap tail is small
+            op["state"] = "bootstrap"
+        elif state == "bootstrap":
+            # the tail persists IN THE OP DOC (the migrate discipline):
+            # a meta crash after this point still holds everything the
+            # follower needs to come up at the leader's acked frontier
+            op["wal_tail"] = payload.get("wal_tail") or []
+            op["flushed_seq"] = payload.get("flushed_seq", 0)
+            op["state"] = "attach"
+        elif state == "attach":
+            op["state"] = "commit"
+        elif state == "drop":
+            op["state"] = "wire"
+        elif state == "wire":
+            self._finish(op, "done")
+            return
+        self._save(op)
+
+    def _commit_replica_add(self, op: dict) -> None:
+        """The replica-add commit point: the follower joins the route in
+        ONE atomic KV batch with the op transition; the wire step then
+        turns on continuous shipping from the leader."""
+        from ..common.telemetry import increment_counter
+        _fp.fail_point("balancer_route_commit")
+        route = self.srv.table_route(op["table"])
+        if route is None:
+            self._finish(op, "failed", "route vanished before commit")
+            return
+        rr = next((r for r in route.region_routes
+                   if r.region_number == op["region"]), None)
+        if rr is None:
+            self._finish(op, "failed", "region vanished before commit")
+            return
+        if rr.leader.id != op["from_node"]:
+            # the leader moved under the op (failover/migration raced the
+            # busy-table guard): the bootstrapped standby tracked the OLD
+            # leader's WAL — abort and drop it rather than publish a
+            # follower of unknown lineage
+            self._abort(op, f"region leader changed to dn{rr.leader.id} "
+                            f"mid-replica-add; aborting commit")
+            return
+        if all(f.id != op["to_node"] for f in rr.followers):
+            peer = self.srv.peer(op["to_node"]) or Peer(op["to_node"])
+            rr.followers.append(peer)
+        route.version += 1
+        op["state"] = "wire"
+        op["wal_tail"] = None      # bootstrapped; shrink the op doc
+        op["updated_ms"] = int(time.time() * 1000)
+        op.setdefault("times", {}).setdefault("wire", op["updated_ms"])
+        self.srv.kv.batch([
+            ("put", f"{ROUTE_PREFIX}{op['table']}",
+             json.dumps(route.to_dict()).encode()),
+            ("put", f"{OP_PREFIX}{op['id']}", json.dumps(op).encode())])
+        increment_counter("balancer_replicas_added")
+        logger.info("balancer op %s: route committed — region %s of %s "
+                    "now replicated on dn%d (route v%d)", op["id"],
+                    op["region"], op["table"], op["to_node"],
+                    route.version)
+
+    def _commit_replica_remove(self, op: dict) -> None:
+        """The replica-remove commit point (the op STARTS here): the
+        follower leaves the route first so no frontend routes reads to
+        it, then the drop step releases its standby region."""
+        from ..common.telemetry import increment_counter
+        _fp.fail_point("balancer_route_commit")
+        route = self.srv.table_route(op["table"])
+        if route is None:
+            self._finish(op, "failed", "route vanished before commit")
+            return
+        rr = next((r for r in route.region_routes
+                   if r.region_number == op["region"]), None)
+        if rr is None:
+            self._finish(op, "failed", "region vanished before commit")
+            return
+        rr.followers = [f for f in rr.followers
+                        if f.id != op["to_node"]]
+        route.version += 1
+        op["state"] = "drop"
+        op["updated_ms"] = int(time.time() * 1000)
+        op.setdefault("times", {}).setdefault("drop", op["updated_ms"])
+        self.srv.kv.batch([
+            ("put", f"{ROUTE_PREFIX}{op['table']}",
+             json.dumps(route.to_dict()).encode()),
+            ("put", f"{OP_PREFIX}{op['id']}", json.dumps(op).encode())])
+        increment_counter("balancer_replicas_removed")
+        logger.info("balancer op %s: route committed — region %s of %s "
+                    "no longer replicated on dn%d (route v%d)", op["id"],
+                    op["region"], op["table"], op["to_node"],
+                    route.version)
+
     # ---- rollback ----
     def _abort(self, op: dict, reason: str) -> None:
         """Pre-commit rollback: the route never changed, so undoing means
-        unfencing the source (migrate) or dropping the pending children
-        (split). The undo message is fire-and-forget — it is idempotent
-        and re-sendable, and the op itself lands in done/ as failed."""
+        unfencing the source (migrate), dropping the pending children
+        (split) or the half-built standby (replica_add). The undo message
+        is fire-and-forget — it is idempotent and re-sendable, and the op
+        itself lands in done/ as failed."""
         logger.warning("balancer op %s rolling back: %s", op["id"], reason)
         base = {"op_id": op["id"], "catalog": op["catalog"],
                 "schema": op["schema"], "table": op["table_short"],
@@ -615,6 +837,11 @@ class RegionBalancer:
         if op["kind"] == "migrate":
             self.srv.send_mailbox(op["from_node"],
                                   {**base, "type": "balancer_unfence"})
+        elif op["kind"] == "replica_add":
+            self.srv.send_mailbox(op["to_node"],
+                                  {**base, "type": "repl_drop"})
+        elif op["kind"] == "replica_remove":
+            pass    # commit-first: nothing pre-commit to undo
         else:
             self.srv.send_mailbox(op["node"],
                                   {**base, "type": "balancer_split_abort",

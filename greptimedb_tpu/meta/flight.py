@@ -128,7 +128,8 @@ class FlightMetaServer(flight.FlightServerBase):
                     raise NotLeaderError(self.raft_node.leader_id)
                 resp = {"ok": True, "rows": self.srv.region_peers()}
             elif kind in ("admin_migrate_region", "admin_split_region",
-                          "admin_rebalance", "balancer_ack",
+                          "admin_rebalance", "admin_add_replica",
+                          "admin_remove_replica", "balancer_ack",
                           "balancer_configure"):
                 # balancer surface: ops mutate routes / consume leader-
                 # local acks, so only the leader may run them
@@ -150,6 +151,16 @@ class FlightMetaServer(flight.FlightServerBase):
                     resp = {"ok": True,
                             "ops": self.srv.admin_rebalance(
                                 body.get("name"))}
+                elif kind == "admin_add_replica":
+                    resp = {"ok": True,
+                            "op": self.srv.admin_add_replica(
+                                body["name"], body["region"],
+                                body["to_node"])}
+                elif kind == "admin_remove_replica":
+                    resp = {"ok": True,
+                            "op": self.srv.admin_remove_replica(
+                                body["name"], body["region"],
+                                body["node"])}
                 elif kind == "balancer_configure":
                     self.srv.balancer.configure(body["knob"],
                                                 body["value"])
@@ -337,6 +348,16 @@ class FlightMetaClient:
     def admin_rebalance(self, full_name: Optional[str] = None
                         ) -> List[dict]:
         return self._action("admin_rebalance", {"name": full_name})["ops"]
+
+    def admin_add_replica(self, full_name: str, region: int,
+                          to_node: int) -> dict:
+        return self._action("admin_add_replica", {
+            "name": full_name, "region": region, "to_node": to_node})["op"]
+
+    def admin_remove_replica(self, full_name: str, region: int,
+                             node: int) -> dict:
+        return self._action("admin_remove_replica", {
+            "name": full_name, "region": region, "node": node})["op"]
 
     def balancer_configure(self, knob: str, value: object) -> None:
         self._action("balancer_configure", {"knob": knob, "value": value})

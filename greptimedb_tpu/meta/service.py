@@ -41,14 +41,23 @@ class Peer:
 class RegionRoute:
     region_number: int
     leader: Peer
+    #: read replicas (ISSUE 19): standby peers continuously fed the
+    #: leader's WAL tail; reads may scatter here, writes never do, and
+    #: failover promotes the most-caught-up one
+    followers: List[Peer] = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {"region_number": self.region_number,
-                "leader": self.leader.to_dict()}
+        d = {"region_number": self.region_number,
+             "leader": self.leader.to_dict()}
+        if self.followers:
+            d["followers"] = [p.to_dict() for p in self.followers]
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "RegionRoute":
-        return RegionRoute(d["region_number"], Peer.from_dict(d["leader"]))
+        return RegionRoute(d["region_number"], Peer.from_dict(d["leader"]),
+                           [Peer.from_dict(p)
+                            for p in d.get("followers", [])])
 
 
 @dataclass
@@ -111,6 +120,11 @@ TABLE_ID_SEQ = "__meta/seq/table_id"
 ROUTE_PREFIX = "__meta/route/"
 PEER_PREFIX = "__meta/peer/"
 TINFO_PREFIX = "__meta/tinfo/"
+#: pending failover promotions (ISSUE 19): the repl_promote mail is
+#: fire-and-forget, so the doc persists here until a full heartbeat from
+#: the promoted node shows the region out of standby — a new leader that
+#: dies mid-promote gets the mail again after it restarts
+PROMOTE_PREFIX = "__balancer/promote/"
 
 
 class NoAliveDatanodeError(GreptimeError):
@@ -152,6 +166,20 @@ class MetaSrv:
             {}, "meta.srv.prev_region_rows")
         self._region_rates: Dict[int, Dict[str, float]] = tracked_state(
             {}, "meta.srv.region_rates")
+        #: replica catch-up feed off full heartbeats (ISSUE 19): per
+        #: FOLLOWER node {region_name: (replicated_seq, beat_time)}, and
+        #: per region name the LEADER-reported (committed_seq,
+        #: beat_time) — region_peers derives lag_ms from the pair, and
+        #: failover_check promotes the max-replicated_seq follower
+        self._replica_seq: Dict[int, Dict[str, tuple]] = tracked_state(
+            {}, "meta.srv.replica_seq")
+        self._leader_seq: Dict[str, tuple] = tracked_state(
+            {}, "meta.srv.leader_seq")
+        #: last FULL (stat-bearing) beat per node — pending-promotion
+        #: confirmation compares against it (a beat after the promote
+        #: mail whose stats no longer flag the region standby)
+        self._stat_time: Dict[int, float] = tracked_state(
+            {}, "meta.srv.stat_time")
         self._last_seen: Dict[int, float] = tracked_state(
             {}, "meta.srv.last_seen")
         self._detectors: Dict[int, PhiAccrualFailureDetector] = \
@@ -254,6 +282,18 @@ class MetaSrv:
                         for region, rows in by_region.items()}
                 self._prev_region_rows[node_id] = (by_region, now)
                 self._stats[node_id] = stat
+                # replica lag feed: standby regions report how far they
+                # have applied, leader regions what they have committed
+                repl: Dict[str, tuple] = {}
+                for rs in stat.region_stats:
+                    if rs.get("standby"):
+                        repl[rs["region"]] = (
+                            int(rs.get("replicated_seq", 0) or 0), now)
+                    elif rs.get("committed_seq") is not None:
+                        self._leader_seq[rs["region"]] = (
+                            int(rs.get("committed_seq", 0) or 0), now)
+                self._replica_seq[node_id] = repl
+                self._stat_time[node_id] = now
             elif stat is not None:
                 # light beat: region_count only (selector freshness);
                 # keep the last full stat's rows/region heat intact
@@ -461,14 +501,26 @@ class MetaSrv:
                         ) -> List[dict]:
         return self.balancer.rebalance(full_table_name)
 
+    def admin_add_replica(self, full_table_name: str, region: int,
+                          to_node: int) -> dict:
+        return self.balancer.add_replica(full_table_name, region, to_node)
+
+    def admin_remove_replica(self, full_table_name: str, region: int,
+                             node: int) -> dict:
+        return self.balancer.remove_replica(full_table_name, region, node)
+
     def balancer_ack(self, node_id: int, op_id: str, step: str, ok: bool,
                      error: Optional[str], payload: dict) -> None:
         self.balancer.handle_ack(node_id, op_id, step, ok, error, payload)
 
     def region_peers(self, now: Optional[float] = None) -> List[dict]:
-        """One row per (table, region): placement + lease state of the
-        hosting node + any in-flight balancer operation touching it —
-        the information_schema.region_peers feed."""
+        """One row per (table, region, hosting peer): the leader row
+        plus one row per read-replica follower, each with its lease
+        state, replication position (`replicated_seq` — the leader row
+        carries its committed sequence) and staleness bound (`lag_ms`),
+        plus any in-flight balancer operation touching the region — the
+        information_schema.region_peers feed and the replica-aware read
+        router's input."""
         now = time.time() if now is None else now
         states = {r["peer_id"]: r["lease_state"]
                   for r in self.cluster_info(now)}
@@ -478,25 +530,56 @@ class MetaSrv:
             ops_by_region[(op["table"], op["region"])] = op
             for child in op.get("children") or []:
                 ops_by_region.setdefault((op["table"], child), op)
+        with self._state_lock:
+            replica_seq = {nid: dict(m)
+                           for nid, m in self._replica_seq.items()}
+            leader_seq = dict(self._leader_seq)
         rows: List[dict] = []
         for route in self.all_table_routes():
             for rr in sorted(route.region_routes,
                              key=lambda r: r.region_number):
                 op = ops_by_region.get(
                     (route.table_name, rr.region_number))
-                rows.append({
+                rname = f"{route.table_id}_{rr.region_number:010d}"
+                committed = leader_seq.get(rname, (None,))[0]
+                base = {
                     "table_name": route.table_name,
                     "region_number": rr.region_number,
-                    "peer_id": rr.leader.id,
-                    "peer_addr": addrs.get(rr.leader.id, rr.leader.addr),
-                    "is_leader": "Yes",
-                    "status": states.get(rr.leader.id, "unknown").upper(),
                     "route_version": route.version,
                     "operation": f"{op['kind']}:{op['state']}"
                     if op is not None else None,
                     "op_id": op["id"] if op is not None else None,
+                }
+                rows.append({
+                    **base,
+                    "peer_id": rr.leader.id,
+                    "peer_addr": addrs.get(rr.leader.id, rr.leader.addr),
+                    "is_leader": "Yes",
+                    "status": states.get(rr.leader.id, "unknown").upper(),
+                    "replicated_seq": committed,
+                    "lag_ms": 0,
                 })
-        rows.sort(key=lambda r: (r["table_name"], r["region_number"]))
+                for f in rr.followers:
+                    rep = replica_seq.get(f.id, {}).get(rname)
+                    if rep is None:
+                        lag_ms = None       # no stat beat yet: unknown
+                    elif committed is not None and rep[0] >= committed:
+                        lag_ms = 0          # fully caught up
+                    else:
+                        # staleness bound: the replica held everything
+                        # as of its last stat-bearing heartbeat
+                        lag_ms = max(0, int((now - rep[1]) * 1000))
+                    rows.append({
+                        **base,
+                        "peer_id": f.id,
+                        "peer_addr": addrs.get(f.id, f.addr),
+                        "is_leader": "No",
+                        "status": states.get(f.id, "unknown").upper(),
+                        "replicated_seq": rep[0] if rep else None,
+                        "lag_ms": lag_ms,
+                    })
+        rows.sort(key=lambda r: (r["table_name"], r["region_number"],
+                                 r["is_leader"] != "Yes", r["peer_id"]))
         return rows
 
     # ---- region failover (the action the reference leaves TODO,
@@ -506,8 +589,15 @@ class MetaSrv:
     # elsewhere at their last-flushed state) ----
     def failover_check(self, now: Optional[float] = None) -> List[dict]:
         """Re-place regions led by dead datanodes onto alive ones and
-        mail open_regions to the new leaders. Returns the moves."""
+        mail open_regions to the new leaders. Regions with a caught-up
+        read replica are PROMOTED instead: the most-replicated alive
+        follower becomes leader (mail repl_promote so it fences the dead
+        leader's WAL, refreshes off the shared manifest and salvages the
+        acked tail — zero acked rows lost). Dead followers are pruned.
+        Returns the moves."""
+        from ..common import failpoint as _fp
         now_t = time.time() if now is None else now
+        self._retry_pending_promotions(now_t)
         dead = {p.id for p in self.failed_datanodes(now_t)}
         peers = self.peers()
         with self._state_lock:
@@ -521,10 +611,13 @@ class MetaSrv:
                  if p.id not in dead]
         if not alive:
             return []
+        alive_ids = {p.id for p in alive}
         with self._state_lock:
             load = {p.id: self._stats.get(p.id,
                                           DatanodeStat()).region_count
                     for p in alive}
+            replica_seq = {nid: dict(m)
+                           for nid, m in self._replica_seq.items()}
         # tables mid-balancer-op are off limits: re-placing a region the
         # balancer is migrating would dual-own it (both paths rewrite the
         # route); the op finishes or times out into a rollback first, and
@@ -534,26 +627,71 @@ class MetaSrv:
         for route in self.all_table_routes():
             if route.table_name in busy_tables:
                 continue
-            lost = [rr for rr in route.region_routes
-                    if rr.leader.id in dead]
-            if not lost:
-                continue
+            changed = False
+            rewire: List = []      # region routes whose follower set or
+            promote: List = []     # leader changed: re-wire the shipper
             assigned: Dict[int, List[int]] = {}
-            for rr in lost:
-                target = min(alive, key=lambda p: (load[p.id], p.id))
-                load[target.id] += 1
+            catalog, schema_name, tname = route.table_name.split(".", 2)
+            for rr in route.region_routes:
+                live_followers = [f for f in rr.followers
+                                  if f.id not in dead]
+                if len(live_followers) != len(rr.followers):
+                    rr.followers = live_followers
+                    changed = True
+                    rewire.append(rr)
+                if rr.leader.id not in dead:
+                    continue
                 old = rr.leader
-                rr.leader = target
-                assigned.setdefault(target.id, []).append(
-                    rr.region_number)
-                moves.append({"table": route.table_name,
-                              "region": rr.region_number,
-                              "from": old.id, "to": target.id})
+                rname = f"{route.table_id}_{rr.region_number:010d}"
+                candidates = [f for f in rr.followers
+                              if f.id in alive_ids]
+                if candidates:
+                    # most-caught-up follower takes over: its standby
+                    # region already holds everything up to its
+                    # replicated_seq, so promotion replays the least
+                    best = max(candidates, key=lambda f: (
+                        replica_seq.get(f.id, {}).get(rname, (0, 0))[0],
+                        -f.id))
+                    rr.leader = best
+                    rr.followers = [f for f in rr.followers
+                                    if f.id != best.id]
+                    load[best.id] = load.get(best.id, 0) + 1
+                    pmsg = {
+                        "type": "repl_promote", "catalog": catalog,
+                        "schema": schema_name, "table": tname,
+                        "region": rr.region_number,
+                        "old_leader": old.id}
+                    # durable until a post-promote heartbeat confirms:
+                    # the mail itself is fire-and-forget, and a new
+                    # leader that dies mid-promote must get it again
+                    self.kv.put(
+                        f"{PROMOTE_PREFIX}{best.id}/{rname}",
+                        json.dumps({"node": best.id,
+                                    "region_name": rname,
+                                    "msg": pmsg, "t": now_t}).encode())
+                    promote.append((best.id, pmsg))
+                    rewire.append(rr)
+                    moves.append({"table": route.table_name,
+                                  "region": rr.region_number,
+                                  "from": old.id, "to": best.id,
+                                  "promoted": True})
+                else:
+                    target = min(alive, key=lambda p: (load[p.id], p.id))
+                    load[target.id] += 1
+                    rr.leader = target
+                    assigned.setdefault(target.id, []).append(
+                        rr.region_number)
+                    moves.append({"table": route.table_name,
+                                  "region": rr.region_number,
+                                  "from": old.id, "to": target.id})
+                changed = True
+            if not changed:
+                continue
             route.version += 1     # placement changed: stale frontends
-            self.kv.put(f"{ROUTE_PREFIX}{route.table_name}",  # must refresh
+            _fp.fail_point("balancer_route_commit")       # must refresh
+            self.kv.put(f"{ROUTE_PREFIX}{route.table_name}",
                         json.dumps(route.to_dict()).encode())
             info = self.table_info(route.table_name)
-            catalog, schema_name, tname = route.table_name.split(".", 2)
             for node_id, region_numbers in assigned.items():
                 self.send_mailbox(node_id, {
                     "type": "open_regions", "catalog": catalog,
@@ -561,7 +699,52 @@ class MetaSrv:
                     "table_id": route.table_id,
                     "region_numbers": region_numbers,
                     "table_info": info})
+            # fire-and-forget (no op_id → no ack): promotions first so
+            # the new leader unfences before shipping resumes, then
+            # shipper re-wires reflecting the pruned/promoted sets
+            for node_id, msg in promote:
+                self.send_mailbox(node_id, msg)
+            for rr in rewire:
+                self.send_mailbox(rr.leader.id, {
+                    "type": "repl_set_followers", "catalog": catalog,
+                    "schema": schema_name, "table": tname,
+                    "region": rr.region_number,
+                    "followers": [f.to_dict() for f in rr.followers]})
         return moves
+
+    def _retry_pending_promotions(self, now_t: float) -> None:
+        """Re-mail repl_promote for promotions the new leader has not
+        confirmed (a full heartbeat after the mail whose stats show the
+        region out of standby). The step is idempotent on the datanode,
+        so duplicate deliveries are harmless; a doc whose region has
+        since been re-routed away from the node is dropped."""
+        docs = self.kv.range(PROMOTE_PREFIX)
+        if not docs:
+            return
+        with self._state_lock:
+            stat_time = dict(self._stat_time)
+            replica_seq = {nid: dict(m)
+                           for nid, m in self._replica_seq.items()}
+        leaders = {}
+        for route in self.all_table_routes():
+            for rr in route.region_routes:
+                leaders[f"{route.table_id}_{rr.region_number:010d}"] = \
+                    rr.leader.id
+        for key, raw in docs:
+            try:
+                doc = json.loads(raw)
+                nid, rname = int(doc["node"]), doc["region_name"]
+            except (ValueError, KeyError, TypeError):
+                self.kv.delete(key)
+                continue
+            if leaders.get(rname) != nid:
+                self.kv.delete(key)    # superseded by a later failover
+                continue
+            if stat_time.get(nid, 0.0) > float(doc["t"]) and \
+                    rname not in replica_seq.get(nid, {}):
+                self.kv.delete(key)    # promotion confirmed
+                continue
+            self.send_mailbox(nid, doc["msg"])
 
 
 class MetaClient:
@@ -622,6 +805,14 @@ class MetaClient:
     def admin_rebalance(self, full_name: Optional[str] = None
                         ) -> List[dict]:
         return self._srv.admin_rebalance(full_name)
+
+    def admin_add_replica(self, full_name: str, region: int,
+                          to_node: int) -> dict:
+        return self._srv.admin_add_replica(full_name, region, to_node)
+
+    def admin_remove_replica(self, full_name: str, region: int,
+                             node: int) -> dict:
+        return self._srv.admin_remove_replica(full_name, region, node)
 
     def balancer_configure(self, knob: str, value: object) -> None:
         self._srv.balancer.configure(knob, value)

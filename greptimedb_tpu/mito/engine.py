@@ -15,6 +15,7 @@ create regions, then register — recovery re-opens from the manifest.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -52,6 +53,8 @@ from ..table.requests import (
     OpenTableRequest,
 )
 from ..table.table import Table, TableEngine
+
+logger = logging.getLogger(__name__)
 
 MIN_USER_TABLE_ID = 1024
 
@@ -742,6 +745,88 @@ class MitoEngine(TableEngine):
         if wal_tail:
             table.regions[region_number].ingest_wal_tail(wal_tail)
         return table
+
+    def adopt_standby(self, info_doc: dict, region_number: int,
+                      wal_tail: Optional[List[dict]]) -> MitoTable:
+        """Replica-attach target side: open the region at its
+        last-flushed shared state, durably mark it a standby (fenced for
+        writes, read-serving, never flushing — the shared manifest
+        belongs to the leader), then replay the bootstrap WAL tail at
+        its original sequences. Idempotent: a re-delivered attach finds
+        the standby already marked and the tail already applied."""
+        table = self.adopt_regions(info_doc, [region_number])
+        region = table.regions[region_number]
+        region.make_standby()
+        if wal_tail:
+            region.ingest_wal_tail(wal_tail)
+        return table
+
+    def refresh_standby(self, catalog: str, schema: str, name: str,
+                        region_number: int) -> Region:
+        """Close + reopen a standby from the CURRENT shared manifest —
+        the catch-up path when shipped records skipped ahead of the
+        replica (the shipper was down past a leader flush that obsoleted
+        the segments it would have shipped, or a WAL-less bulk ingest
+        landed) and the bounded-memory path (the reopen drops memtable
+        rows the leader has since flushed). Local WAL records the fresh
+        manifest already covers are trimmed."""
+        table, region = self._hosted(catalog, schema, name, region_number)
+        ropts = region_opts_from_table_options(table.info.meta.options)
+        reopened = self.storage.reopen_region(
+            region.name, table.info.meta.schema,
+            opts={**(ropts or {}), "sweep_orphans": False})
+        if reopened is None:
+            from ..errors import StaleRouteError
+            raise StaleRouteError(
+                f"standby region {region.name} vanished from shared "
+                f"storage during refresh")
+        reopened.wal.obsolete(
+            reopened.version_control.current.flushed_sequence)
+        table.regions[region_number] = reopened
+        return reopened
+
+    def promote_standby(self, catalog: str, schema: str, name: str,
+                        region_number: int,
+                        old_wal_dir: Optional[str]) -> dict:
+        """Failover promotion: fence the dead leader's WAL dir (a
+        resurrected old owner must reopen fenced, never dual-own),
+        refresh from the current shared manifest, salvage and replay
+        every surviving WAL record the old leader acked but never
+        flushed or shipped, then unfence into the leader role. Zero
+        acked loss: an acked row was fsynced in the old WAL, so it is
+        either in a flushed SST (the refresh covers it) or in a
+        surviving WAL segment (the salvage covers it — the WAL only ever
+        deletes segments at or below the flushed sequence)."""
+        from ..storage.region import fence_wal_dir, salvage_wal_entries
+        self._hosted(catalog, schema, name, region_number)
+        if old_wal_dir:
+            try:
+                fence_wal_dir(old_wal_dir)
+            except OSError:
+                logger.exception("promotion: could not fence old leader "
+                                 "wal dir %s", old_wal_dir)
+        region = self.refresh_standby(catalog, schema, name, region_number)
+        salvaged = replayed = 0
+        if old_wal_dir:
+            try:
+                entries = salvage_wal_entries(
+                    old_wal_dir,
+                    region.version_control.committed_sequence)
+                salvaged = len(entries)
+                replayed = region.ingest_wal_tail(entries)
+            except Exception:  # noqa: BLE001 — degrade, don't block the
+                logger.exception(          # takeover of a healthy replica
+                    "promotion: WAL salvage from %s failed; region %s "
+                    "serves from its last shipped/flushed state",
+                    old_wal_dir, region.name)
+        region.unfence()
+        logger.warning(
+            "region %s PROMOTED to leader (salvaged=%d replayed=%d "
+            "committed_seq=%d)", region.name, salvaged, replayed,
+            region.version_control.committed_sequence)
+        return {"salvaged": salvaged, "replayed": replayed,
+                "committed_seq":
+                    int(region.version_control.committed_sequence)}
 
     def release_region(self, catalog: str, schema: str, name: str,
                        region_number: int) -> bool:

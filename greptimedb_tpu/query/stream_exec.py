@@ -150,10 +150,21 @@ def region_stat_entries(regions) -> tuple:
         sd = getattr(region, "series_dict", None)
         total_rows += rows
         total_bytes += size
-        entries.append({"region": region.name, "rows": rows,
-                        "size_bytes": size,
-                        "series": int(getattr(sd, "num_series", 0) or 0),
-                        "time_span": region_time_span(region)})
+        entry = {"region": region.name, "rows": rows,
+                 "size_bytes": size,
+                 "series": int(getattr(sd, "num_series", 0) or 0),
+                 "time_span": region_time_span(region)}
+        # replication feed: followers beat their applied position,
+        # leaders their acked frontier — meta derives per-replica lag
+        # (region_peers) and picks the promotion winner from these
+        vc = getattr(region, "version_control", None)
+        committed = int(vc.committed_sequence) if vc is not None else 0
+        if getattr(region, "standby", False):
+            entry["standby"] = True
+            entry["replicated_seq"] = committed
+        else:
+            entry["committed_seq"] = committed
+        entries.append(entry)
     return entries, total_rows, total_bytes
 
 

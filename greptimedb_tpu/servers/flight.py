@@ -223,6 +223,15 @@ class FlightDatanodeServer(flight.FlightServerBase):
                     resp = {"ok": True, "info": info.to_dict()}
             elif kind == "ping":
                 resp = {"ok": True, "node_id": self.datanode.opts.node_id}
+            elif kind == "repl_apply":
+                # continuous replication consumer: apply shipped WAL
+                # records to this node's standby replica of the region
+                applied = self.local.repl_apply(
+                    body["catalog"], body["schema"], body["table"],
+                    int(body["region_number"]),
+                    list(body.get("entries") or []),
+                    leader_flushed=int(body.get("leader_flushed") or 0))
+                resp = {"ok": True, **applied}
             elif kind == "background_jobs":
                 # live + recent background work on THIS node, for the
                 # frontend's cluster-merged information_schema view

@@ -415,6 +415,8 @@ class Admin(Statement):
     - ``ADMIN MIGRATE REGION <table> <region> TO <node_id>``
     - ``ADMIN SPLIT REGION <table> <region> [AT <literal>]``
     - ``ADMIN REBALANCE [TABLE <table>]``
+    - ``ADMIN ADD REPLICA <table> <region> TO <node_id>``
+    - ``ADMIN REMOVE REPLICA <table> <region> FROM <node_id>``
 
     Table maintenance (storage surface; works standalone too):
 

@@ -284,6 +284,8 @@ class Parser:
         - ADMIN MIGRATE REGION <table> <region> TO <node_id>
         - ADMIN SPLIT REGION <table> <region> [AT <literal>]
         - ADMIN REBALANCE [TABLE <table>]
+        - ADMIN ADD REPLICA <table> <region> TO <node_id>
+        - ADMIN REMOVE REPLICA <table> <region> FROM <node_id>
 
         Plus table maintenance (storage surface, both deployments):
 
@@ -346,10 +348,27 @@ class Parser:
                                       "concrete literal, not NULL")
             return Admin(kind="split_region", table=table, region=region,
                          at_value=at_value)
+        if self.match_kw("ADD"):
+            self.expect_kw("REPLICA")
+            table = self.parse_object_name()
+            region = self._parse_int("region number")
+            self.expect_kw("TO")
+            target = self._parse_int("target datanode id")
+            return Admin(kind="add_replica", table=table,
+                         region=region, target_node=target)
+        if self.match_kw("REMOVE"):
+            self.expect_kw("REPLICA")
+            table = self.parse_object_name()
+            region = self._parse_int("region number")
+            self.expect_kw("FROM")
+            target = self._parse_int("replica datanode id")
+            return Admin(kind="remove_replica", table=table,
+                         region=region, target_node=target)
         t = self.peek()
         raise ParserError(
             f"expected MIGRATE REGION / SPLIT REGION / REBALANCE / "
-            f"FLUSH TABLE / COMPACT TABLE / SHOW TRACE / SHOW PROFILE "
+            f"ADD REPLICA / REMOVE REPLICA / FLUSH TABLE / "
+            f"COMPACT TABLE / SHOW TRACE / SHOW PROFILE "
             f"after ADMIN, found {t.value!r} at {t.pos}")
 
     def parse_kill(self) -> Kill:

@@ -166,6 +166,18 @@ class StorageEngine:
         region.release()
         return True
 
+    def reopen_region(self, name: str, schema: Optional[Schema] = None,
+                      opts: Optional[dict] = None) -> Optional[Region]:
+        """Close and reopen a region from its CURRENT shared manifest —
+        the standby-replica refresh path: the leader's flushes advanced
+        the manifest under this replica, so a plain reopen folds them in
+        (local WAL replay rides on top of the new flushed sequence)."""
+        with self._lock:
+            region = self._regions.pop(name, None)
+        if region is not None:
+            region.close()
+        return self.open_region(name, schema, opts=opts)
+
     def list_regions(self) -> Dict[str, Region]:
         with self._lock:
             return dict(self._regions)

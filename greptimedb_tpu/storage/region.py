@@ -29,7 +29,8 @@ from ..common.locks import TrackedRLock
 from ..common.time import TimestampRange
 from ..datatypes import RecordBatch, Schema, Vector
 from ..datatypes.vector import compat_column, null_column
-from ..errors import InvalidArgumentsError, StorageError
+from ..errors import (InvalidArgumentsError, RegionClosedError,
+                      StorageError)
 from .memtable import Memtable, MemtableSnapshot, MemtableVersion
 from .manifest import RegionManifest
 from .object_store import ObjectStore
@@ -399,6 +400,18 @@ class Region:
         # Persisted as a node-local marker file so a restart mid-handoff
         # cannot resurrect an unfenced old owner (see fence()).
         self.fenced = False
+        # read-replica standby: the region serves reads and applies
+        # shipped WAL records at their original sequences, but never
+        # accepts client writes and never flushes/compacts — the shared
+        # region dir and its manifest belong to the LEADER. Implies
+        # fenced; persisted as marker content "standby" (see
+        # make_standby()) so a restarted replica datanode comes back in
+        # the same role.
+        self.standby = False
+        #: post-commit replication hook (datanode/replication.py): called
+        #: with the region after a write's durability wait. The hook only
+        #: nudges the shipper thread — acks NEVER wait on followers.
+        self.on_commit = None
         self._writer_lock = TrackedRLock("storage.region_writer")
         if wal is not None:
             self.wal = wal
@@ -521,13 +534,26 @@ class Region:
             region._sweep_orphan_ssts()
         region._replay_wal(flushed_sequence)
         import os as _os
-        if _os.path.exists(region._fence_marker_path()):
+        marker = region._fence_marker_path()
+        if _os.path.exists(marker):
             # this node fenced the region mid-handoff and then restarted:
             # it must come back fenced (an unfenced resurrection could
-            # ack writes the migration target will never see)
+            # ack writes the migration target will never see). The marker
+            # CONTENT distinguishes a mid-migration fence from a standby
+            # replica, which reopens fenced-for-writes but read-serving.
             region.fenced = True
-            logger.warning("region %s reopened FENCED (handoff marker "
-                           "present)", region.name)
+            try:
+                with open(marker, encoding="utf-8") as fh:
+                    kind = fh.read().strip()
+            except OSError:
+                kind = "fenced"
+            if kind == "standby":
+                region.standby = True
+                logger.info("region %s reopened as a STANDBY replica",
+                            region.name)
+            else:
+                logger.warning("region %s reopened FENCED (handoff marker "
+                               "present)", region.name)
         return region
 
     def _sweep_orphan_ssts(self) -> int:
@@ -613,7 +639,7 @@ class Region:
         wal_ticket = None
         with timer("region_write"), self._writer_lock:
             if self.closed:
-                raise StorageError(f"region {self.name} closed")
+                raise RegionClosedError(f"region {self.name} closed")
             if self.fenced:
                 from ..errors import StaleRouteError
                 raise StaleRouteError(
@@ -676,6 +702,16 @@ class Region:
             # worker can commit) until the backlog drains
             increment_counter("region_write_stalls")
             self._flush_done.wait(timeout=300)
+        hook = self.on_commit
+        if hook is not None:
+            # continuous replica ship: the hook only wakes the shipper
+            # thread, after durability — a hook failure must never turn
+            # an acked write into an error
+            try:
+                hook(self)
+            except Exception:  # noqa: BLE001
+                logger.exception("region %s on_commit hook failed",
+                                 self.name)
         increment_counter("region_write_rows", batch.num_rows)
         return batch.num_rows
 
@@ -755,7 +791,7 @@ class Region:
             mark("pre_flush")
         with self._writer_lock:
             if self.closed:
-                raise StorageError(f"region {self.name} closed")
+                raise RegionClosedError(f"region {self.name} closed")
             if self.fenced:
                 # RE-checked under the lock: the early check races the
                 # fence — a bulk commit slipping past it would land rows
@@ -1335,7 +1371,8 @@ class Region:
         logger.info("region %s fenced for handoff", self.name)
 
     def unfence(self) -> None:
-        """Roll back a fence (aborted migration)."""
+        """Roll back a fence (aborted migration), or complete a standby
+        promotion: the region starts accepting writes again."""
         import os as _os
         with self._writer_lock:
             try:
@@ -1343,7 +1380,51 @@ class Region:
             except FileNotFoundError:
                 pass
             self.fenced = False
-        logger.info("region %s unfenced (handoff rolled back)", self.name)
+            self.standby = False
+        logger.info("region %s unfenced", self.name)
+
+    def make_standby(self) -> None:
+        """Mark this region a read-replica standby, durably: the marker
+        (content "standby", same node-local file as fence()) survives a
+        restart, so the replica reopens fenced-for-writes but
+        read-serving. A standby never flushes or compacts — the shared
+        region dir belongs to the leader — and catches up either from
+        shipped WAL records (ingest_wal_tail) or by reopening from the
+        leader's advanced manifest (StorageEngine.reopen_region)."""
+        import os as _os
+        from ..utils import atomic_write
+        with self._writer_lock:
+            _os.makedirs(self.descriptor.wal_dir, exist_ok=True)
+            atomic_write(self._fence_marker_path(), "standby\n",
+                         tmp_prefix=".fence-")
+            self.fenced = True
+            self.standby = True
+        logger.info("region %s is now a standby replica", self.name)
+
+    def wal_entries_since(self, after_seq: int,
+                          max_records: Optional[int] = None) -> List[dict]:
+        """WAL records in (after_seq, committed], wire-encodable — the
+        continuous replica ship feed. Unlike wal_tail() this is safe on
+        a LIVE region: records past the committed sequence (concurrent
+        in-flight appends) are excluded, and the WAL's read path never
+        truncates the active segment, so shipping proceeds under full
+        write load without fencing."""
+        import base64
+        if isinstance(self.wal, NoopWal):
+            return []        # disable_wal region: nothing to ship
+        committed = self.version_control.committed_sequence
+        out: List[dict] = []
+        for seq, schema_version, payload in self.wal.read_from(
+                after_seq + 1):
+            if seq <= after_seq:
+                continue
+            if seq > committed:
+                break
+            out.append({"seq": int(seq), "schema_version": schema_version,
+                        "payload": base64.b64encode(payload).decode()})
+            if max_records is not None and len(out) >= max_records:
+                break
+        return out
 
     def wal_tail(self) -> List[dict]:
         """Every WAL record past the flushed sequence, wire-encodable —
@@ -1370,7 +1451,7 @@ class Region:
         replayed = 0
         with self._writer_lock:
             if self.closed:
-                raise StorageError(f"region {self.name} closed")
+                raise RegionClosedError(f"region {self.name} closed")
             vc = self.version_control
             for e in entries:
                 seq = int(e["seq"])
@@ -1423,3 +1504,42 @@ class Region:
         with self._writer_lock:
             self.closed = True
             self.wal.close()
+
+
+# ---- promotion-time WAL salvage (datanode repl_promote drives these; the
+# old leader is DEAD, so its node-local WAL dir is operated on by path) ----
+
+def fence_wal_dir(wal_dir: str) -> None:
+    """Durably fence a region by WAL-directory path alone — written into
+    a dead leader's node-local WAL dir before salvaging its tail: if the
+    old owner resurrects, Region.open sees the marker and comes back
+    fenced, so it can never ack a write the promoted replica misses."""
+    import os as _os
+    from ..utils import atomic_write
+    _os.makedirs(wal_dir, exist_ok=True)
+    atomic_write(_os.path.join(wal_dir, FENCE_MARKER), "fenced\n",
+                 tmp_prefix=".fence-")
+
+
+def salvage_wal_entries(wal_dir: str, after_seq: int) -> List[dict]:
+    """Every record past after_seq from a dead node's WAL directory,
+    wire-encodable. Opening a fresh Wal over the dir recovers its
+    segments; a torn tail (the leader was killed mid-append) holds only
+    never-acked records — the ack always follows the fsync — so the
+    open-time repair-truncate cannot drop an acked row. A missing dir
+    degrades to an empty salvage (a leader that never wrote)."""
+    import base64
+    import os as _os
+    if not _os.path.isdir(wal_dir):
+        return []
+    wal = Wal(wal_dir)
+    try:
+        out: List[dict] = []
+        for seq, schema_version, payload in wal.read_from(after_seq + 1):
+            if seq <= after_seq:
+                continue
+            out.append({"seq": int(seq), "schema_version": schema_version,
+                        "payload": base64.b64encode(payload).decode()})
+        return out
+    finally:
+        wal.close()

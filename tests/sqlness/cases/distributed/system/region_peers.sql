@@ -43,6 +43,33 @@ ADMIN SPLIT REGION rph 0;
 -- splitting at a value outside the region's range is a clean error
 ADMIN SPLIT REGION rp 0 AT 'h6';
 
+-- attach a read replica: region 0's leader streams its WAL tail to a
+-- standby on dn2, and region_peers grows a follower row (this env's
+-- cooperative heartbeats carry no region stats, so the seq/lag columns
+-- stay at their no-telemetry defaults)
+ADMIN ADD REPLICA rp 0 TO 2;
+
+SELECT table_name, region_number, peer_id, is_leader, status,
+       replicated_seq, lag_ms
+FROM information_schema.region_peers
+WHERE table_name = 'greptime.public.rp' AND region_number = 0;
+
+-- follower regions never count toward cluster_info region_count
+SELECT peer_id, region_count FROM information_schema.cluster_info
+WHERE peer_type = 'datanode' ORDER BY peer_id;
+
+-- a replica cannot stack on the leader, nor attach twice
+ADMIN ADD REPLICA rp 0 TO 1;
+
+ADMIN ADD REPLICA rp 0 TO 2;
+
+-- detach: the follower row disappears and the standby region drops
+ADMIN REMOVE REPLICA rp 0 FROM 2;
+
+SELECT table_name, region_number, peer_id, is_leader, status
+FROM information_schema.region_peers
+WHERE table_name = 'greptime.public.rp' AND region_number = 0;
+
 DROP TABLE rp;
 
 DROP TABLE rph;

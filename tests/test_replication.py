@@ -1,0 +1,606 @@
+"""Per-region read replicas (ISSUE 19).
+
+A leader datanode streams committed WAL records to standby copies of
+its regions on other nodes (datanode/replication.py); the balancer's
+replica_add/replica_remove op docs drive attach/detach as resumable
+state machines; meta's failover_check PROMOTES the most-caught-up
+follower when a leader dies — salvaging the dead leader's surviving WAL
+records so zero acked rows are lost. These tests drive the whole loop
+cooperatively over the shared-data_home deployment shape (one data_home,
+node-scoped nodes/<id>/wal dirs) where promotion can reach the dead
+leader's WAL.
+
+tests/test_cluster.py holds the multi-process (real kill -9) acceptance
+twin; tests/test_balancer.py established the Cluster pump pattern.
+"""
+
+import threading
+import time
+
+import pytest
+
+from greptimedb_tpu import DEFAULT_CATALOG_NAME as CAT
+from greptimedb_tpu import DEFAULT_SCHEMA_NAME as SCH
+from greptimedb_tpu.client import LocalDatanodeClient
+from greptimedb_tpu.common import failpoint
+from greptimedb_tpu.common.failpoint import SimulatedCrash
+from greptimedb_tpu.datanode.instance import DatanodeInstance, DatanodeOptions
+from greptimedb_tpu.errors import (
+    GreptimeError, InvalidArgumentsError, StaleRouteError, UnsupportedError)
+from greptimedb_tpu.frontend.distributed import configure_read_replica
+from greptimedb_tpu.meta import DatanodeStat, MetaClient, MetaSrv, Peer
+from greptimedb_tpu.meta.kv import FileKv
+from greptimedb_tpu.meta.service import PROMOTE_PREFIX
+
+from test_balancer import FULL, Cluster, _region0_owner, _setup_table
+
+
+class ReplCluster(Cluster):
+    """Cluster whose datanodes share ONE data_home (node-scoped WAL dirs
+    under nodes/<id>/wal, the shared-object-store deployment shape) so a
+    promoted follower can fence + salvage a dead leader's WAL."""
+
+    def __init__(self, tmp_path, nodes=(1, 2, 3), kv=None,
+                 lease_secs=3600.0, sync_wal=False):
+        self._sync_wal = sync_wal
+        super().__init__(tmp_path, nodes=nodes, kv=kv,
+                         lease_secs=lease_secs)
+
+    def _start_datanode(self, i):
+        dn = DatanodeInstance(
+            DatanodeOptions(data_home=str(self.tmp_path / "home"),
+                            node_id=i, register_numbers_table=False,
+                            wal_sync_on_write=self._sync_wal),
+            store=self.shared)
+        dn.start()
+        dn.attach_meta(self.meta)
+        self.datanodes[i] = dn
+        self.clients[i] = LocalDatanodeClient(dn)
+        self.srv.register_datanode(Peer(i, f"dn{i}"))
+        self.srv.handle_heartbeat(i)
+        return dn
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    failpoint.reset()
+    configure_read_replica(mode="leader", max_lag_ms=5000)
+    c = ReplCluster(tmp_path)
+    yield c
+    failpoint.reset()
+    configure_read_replica(mode="leader", max_lag_ms=5000)
+    c.shutdown()
+
+
+def _beat_full(c, i, now=None):
+    """One stat-bearing heartbeat, the feed behind replicated_seq/lag_ms
+    (production: DatanodeInstance.start_heartbeat's full beats)."""
+    from greptimedb_tpu.query.stream_exec import region_stat_entries
+    dn = c.datanodes[i]
+    regions = dn.storage.list_regions()
+    entries, rows, nbytes = region_stat_entries(regions.values())
+    return c.srv.handle_heartbeat(
+        i, DatanodeStat(region_count=len(regions), approximate_rows=rows,
+                        approximate_bytes=nbytes, region_stats=entries),
+        now=now)
+
+
+def _add_replica(c, target=None, region=0):
+    """ADMIN ADD REPLICA region 0 onto `target` (default: any
+    non-leader); returns (leader_id, target_id)."""
+    leader = _region0_owner(c)
+    if target is None:
+        target = next(i for i in c.datanodes if i != leader)
+    out = c.fe.do_query(f"ADMIN ADD REPLICA ha {region} TO {target}")[-1]
+    assert out.batches, "ADMIN ADD REPLICA returned no op row"
+    assert c.pump(), f"replica_add never finished: {c.srv.balancer.ops()}"
+    assert c.srv.balancer.done_ops()[-1]["state"] == "done"
+    return leader, target
+
+
+def _r0(c, node):
+    """The region-0 Region object hosted on `node`."""
+    return c.datanodes[node].catalog.table(CAT, SCH, "ha").regions[0]
+
+
+def _deliver(c, node):
+    """Drain `node`'s meta mailbox (one heartbeat's worth)."""
+    resp = c.srv.handle_heartbeat(node)
+    for msg in resp.mailbox:
+        c.datanodes[node]._handle_mailbox(msg)
+
+
+def _fail_leader(c, leader):
+    """Silence the leader past 2x its lease and run failover."""
+    c.hard_kill(leader)
+    c.srv._last_seen[leader] = 0.0
+    return c.srv.failover_check()
+
+
+class TestReplicaLifecycle:
+    def test_add_replica_bootstraps_standby(self, cluster):
+        c = cluster
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        route = c.srv.table_route(FULL)
+        rr0 = next(r for r in route.region_routes if r.region_number == 0)
+        assert [f.id for f in rr0.followers] == [target]
+        assert rr0.leader.id == leader
+        assert route.version == 1
+        # the standby is fenced for writes but holds the leader's data
+        std = _r0(c, target)
+        assert std.standby and std.fenced
+        lead = _r0(c, leader)
+        assert (std.version_control.committed_sequence ==
+                lead.version_control.committed_sequence)
+        # the leader's shipper is wired for continuous tail shipping
+        targets = c.datanodes[leader].replication.targets()
+        assert lead.name in targets
+        assert len(targets[lead.name]["followers"]) == 1
+        # writes through the frontend still ack against the leader only
+        c.fe.do_query("INSERT INTO ha VALUES ('h1', 99000, 1.0)")
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == 21
+
+    def test_add_replica_validations(self, cluster):
+        c = cluster
+        _setup_table(c)
+        leader = _region0_owner(c)
+        with pytest.raises(GreptimeError, match="leads"):
+            c.fe.do_query(f"ADMIN ADD REPLICA ha 0 TO {leader}")
+        with pytest.raises(GreptimeError):
+            c.fe.do_query("ADMIN ADD REPLICA ha 0 TO 9")   # unregistered
+        with pytest.raises(GreptimeError, match="replica"):
+            c.fe.do_query("ADMIN REMOVE REPLICA ha 0 FROM 3")
+        _, target = _add_replica(c)
+        with pytest.raises(GreptimeError, match="already"):
+            c.fe.do_query(f"ADMIN ADD REPLICA ha 0 TO {target}")
+
+    def test_remove_replica_detaches_standby(self, cluster):
+        c = cluster
+        _setup_table(c)
+        leader, target = _add_replica(c)
+        lead_name = _r0(c, leader).name
+        out = c.fe.do_query(
+            f"ADMIN REMOVE REPLICA ha 0 FROM {target}")[-1]
+        assert out.batches
+        assert c.pump(), c.srv.balancer.ops()
+        assert c.srv.balancer.done_ops()[-1]["state"] == "done"
+        route = c.srv.table_route(FULL)
+        rr0 = next(r for r in route.region_routes if r.region_number == 0)
+        assert not rr0.followers
+        # the standby region is gone from the follower node and the
+        # leader's shipper is unwired
+        assert lead_name not in c.datanodes[target].storage.list_regions()
+        assert lead_name not in c.datanodes[leader].replication.targets()
+        # the leader keeps serving
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == 10
+
+
+class TestContinuousShip:
+    def test_wal_tail_ships_and_follower_serves_reads(self, cluster):
+        c = cluster
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        lead, std = _r0(c, leader), _r0(c, target)
+        vals = ", ".join(f"('h{i % 5}', {50_000 + i}, 2.0)"
+                         for i in range(40))
+        c.fe.do_query(f"INSERT INTO ha VALUES {vals}")
+        c.datanodes[leader].replication.drain(lead.name)
+        std = _r0(c, target)        # a gap-refresh may swap the object
+        assert (std.version_control.committed_sequence ==
+                lead.version_control.committed_sequence)
+        # stat beats feed lag tracking; the read router needs them
+        for i in c.datanodes:
+            _beat_full(c, i)
+        c.fe.do_query("SET read_replica = 'follower'")
+        try:
+            got = c.query_one("SELECT count(*) AS c FROM ha")[0]
+            assert got == 60
+            # successive single-region scatters rotate over the pool:
+            # the follower takes a share of the traffic
+            t = c.fe.catalog.table(CAT, SCH, "ha")
+            picked = set()
+            for _ in range(4):
+                for client, regions in t._read_owners_for([0]):
+                    assert regions == [0]
+                    picked.add(client.node_id)
+            assert picked == {leader, target}
+        finally:
+            c.fe.do_query("SET read_replica = 'leader'")
+
+    def test_follower_gap_refreshes_after_leader_flush(self, cluster):
+        c = cluster
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        lead = _r0(c, leader)
+        # stall shipping, write + flush on the leader: the WAL segments
+        # the follower missed are now obsoleted on the leader side
+        c.datanodes[leader].replication.stop()
+        vals = ", ".join(f"('h{i % 5}', {60_000 + i}, 3.0)"
+                         for i in range(30))
+        c.fe.do_query(f"INSERT INTO ha VALUES {vals}")
+        lead.flush()
+        c.fe.do_query("INSERT INTO ha VALUES ('h1', 70000, 4.0)")
+        # the next ship round carries leader_flushed ahead of the
+        # standby's manifest view -> it reopens from the shared manifest
+        c.datanodes[leader].replication.drain(lead.name)
+        std = _r0(c, target)
+        assert (std.version_control.committed_sequence ==
+                lead.version_control.committed_sequence)
+        assert std.standby and std.fenced
+
+    def test_acks_never_wait_on_a_dead_follower(self, cluster):
+        c = cluster
+        _setup_table(c, rows=10)
+        leader, target = _add_replica(c)
+        lead = _r0(c, leader)
+        c.hard_kill(target)
+        # writes ack from the leader's WAL alone; the failed ship is
+        # logged and retried, never surfaced to the writer
+        before = lead.version_control.committed_sequence
+        c.fe.do_query("INSERT INTO ha VALUES ('h2', 80000, 5.0)")
+        assert lead.version_control.committed_sequence > before
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == 11
+
+    def test_region_peers_and_cluster_info_feed(self, cluster):
+        c = cluster
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        for i in c.datanodes:
+            _beat_full(c, i)
+        rows = [r for r in c.srv.region_peers()
+                if r["table_name"] == FULL and r["region_number"] == 0]
+        assert [r["is_leader"] for r in rows] == ["Yes", "No"]
+        lead_row, fol_row = rows
+        assert lead_row["peer_id"] == leader and lead_row["lag_ms"] == 0
+        assert fol_row["peer_id"] == target
+        committed = _r0(c, leader).version_control.committed_sequence
+        assert lead_row["replicated_seq"] == committed
+        assert fol_row["replicated_seq"] == committed  # fully caught up
+        assert fol_row["lag_ms"] == 0
+        # cluster_info region_count counts LEADER regions only: the
+        # standby on `target` adds nothing
+        info = {r["peer_id"]: r["region_count"]
+                for r in c.srv.cluster_info() if r["peer_id"] > 0}
+        assert sum(info.values()) == 2
+        route = c.srv.table_route(FULL)
+        by_leader = {}
+        for rr in route.region_routes:
+            by_leader[rr.leader.id] = by_leader.get(rr.leader.id, 0) + 1
+        assert info == {i: by_leader.get(i, 0) for i in c.datanodes}
+
+
+class TestPromotion:
+    @pytest.mark.parametrize("sync_wal", [True, False],
+                             ids=["sync", "async"])
+    def test_leader_death_promotes_with_zero_acked_loss(self, tmp_path,
+                                                        sync_wal):
+        """The tentpole invariant: kill the leader with an acked,
+        UNSHIPPED, UNFLUSHED tail under sync_on_write -> the promoted
+        follower salvages the dead leader's WAL and serves every acked
+        row exactly once."""
+        failpoint.reset()
+        c = ReplCluster(tmp_path, sync_wal=sync_wal)
+        try:
+            _setup_table(c, rows=20)
+            c.fe.catalog.table(CAT, SCH, "ha").flush()
+            leader, target = _add_replica(c)
+            lead = _r0(c, leader)
+            c.datanodes[leader].replication.drain(lead.name)
+            acked = set(c.scan_keys())
+            # stall shipping, then land acked rows ONLY the leader's WAL
+            # holds (region 0 hosts: h0..h4)
+            c.datanodes[leader].replication.stop()
+            for i in range(25):
+                key = ("h3", 90_000 + i)
+                c.fe.do_query(
+                    f"INSERT INTO ha VALUES ('h3', {key[1]}, 7.0)")
+                acked.add(key)
+            std_seq = _r0(c, target).version_control.committed_sequence
+            assert lead.version_control.committed_sequence > std_seq, \
+                "test setup: the tail must be unshipped"
+            moves = _fail_leader(c, leader)
+            assert moves == [{"table": FULL, "region": 0, "from": leader,
+                              "to": target, "promoted": True}]
+            _deliver(c, target)
+            promoted = _r0(c, target)
+            assert not promoted.standby and not promoted.fenced
+            # zero acked loss, zero duplication
+            keys = c.scan_keys()
+            assert len(keys) == len(set(keys)), "duplicated rows"
+            missing = acked - set(keys)
+            assert not missing, f"lost {len(missing)} acked rows"
+            # post-promotion liveness: write + read through the new
+            # leader
+            c.fe.do_query("INSERT INTO ha VALUES ('h0', 95000, 8.0)")
+            assert c.query_one("SELECT count(*) AS c FROM ha")[0] == \
+                len(acked) + 1
+            # manifest references only existing SSTs
+            for dn in c.datanodes.values():
+                for region in dn.storage.list_regions().values():
+                    if region.closed:
+                        continue
+                    referenced = {f.file_name for f in region.
+                                  version_control.current.ssts.all_files()}
+                    on_disk = {k.rsplit("/", 1)[-1] for k in
+                               c.shared.list(f"{region.name}/sst/")}
+                    assert referenced <= on_disk
+        finally:
+            c.shutdown()
+
+    def test_promotion_picks_most_caught_up_follower(self, tmp_path):
+        failpoint.reset()
+        c = ReplCluster(tmp_path, nodes=(1, 2, 3, 4))
+        try:
+            _setup_table(c)
+            leader = _region0_owner(c)
+            followers = [i for i in c.datanodes if i != leader][:2]
+            for f in followers:
+                _add_replica(c, target=f)
+            route = c.srv.table_route(FULL)
+            rname = f"{route.table_id}_{0:010d}"
+            # crafted stat beats: follower[1] is further along
+            for f, seq in zip(followers, (3, 9)):
+                c.srv.handle_heartbeat(f, DatanodeStat(
+                    region_count=1, region_stats=[{
+                        "region": rname, "rows": 0, "size_bytes": 0,
+                        "standby": True, "replicated_seq": seq}]))
+            moves = _fail_leader(c, leader)
+            assert [m for m in moves if m["region"] == 0][0]["to"] == \
+                followers[1]
+            rr0 = next(r for r in c.srv.table_route(FULL).region_routes
+                       if r.region_number == 0)
+            assert rr0.leader.id == followers[1]
+            # the slower follower survives as a follower of the new
+            # leader
+            assert [f.id for f in rr0.followers] == [followers[0]]
+        finally:
+            c.shutdown()
+
+    def test_resurrected_old_leader_is_fenced(self, cluster):
+        c = cluster
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        lead_name = _r0(c, leader).name
+        c.datanodes[leader].replication.drain(lead_name)
+        _fail_leader(c, leader)
+        _deliver(c, target)
+        assert not _r0(c, target).standby
+        # the old leader comes back from the dead: its WAL dir was
+        # fenced by the promotion, so the region reopens write-rejecting
+        c.restart_datanode(leader)
+        back = _r0(c, leader)
+        assert back.fenced and not back.standby
+        with pytest.raises(StaleRouteError):
+            back.bulk_ingest({"host": ["h1"], "ts": [99_999],
+                              "v": [1.0]})
+        # a late ship from the deposed leader is ignored by the promoted
+        # region (no longer standby)
+        out = c.datanodes[target].repl_apply(
+            CAT, SCH, "ha", 0,
+            [{"seq": 10_000, "payload": None}], leader_flushed=0)
+        assert out["standby"] is False and out["replayed"] == 0
+
+    def test_meta_restart_resumes_mid_bootstrap(self, tmp_path):
+        """FileKv-backed meta dies mid replica-add; the restarted one
+        reloads the op doc and finishes the attach."""
+        failpoint.reset()
+        kv = FileKv(str(tmp_path / "meta.kv"))
+        c = ReplCluster(tmp_path, kv=kv)
+        try:
+            _setup_table(c)
+            leader = _region0_owner(c)
+            target = next(i for i in c.datanodes if i != leader)
+            c.fe.do_query(f"ADMIN ADD REPLICA ha 0 TO {target}")
+            for _ in range(20):
+                ops = c.srv.balancer.ops()
+                if ops and ops[0]["state"] in ("bootstrap", "attach"):
+                    break
+                c.pump(rounds=1)
+            ops = c.srv.balancer.ops()
+            assert ops and ops[0]["state"] in ("bootstrap", "attach"), ops
+            c.restart_meta()
+            assert c.srv.balancer.ops(), "op lost across meta restart"
+            assert c.pump(rounds=30)
+            assert c.srv.balancer.done_ops()[-1]["state"] == "done"
+            rr0 = next(r for r in c.srv.table_route(FULL).region_routes
+                       if r.region_number == 0)
+            assert [f.id for f in rr0.followers] == [target]
+            assert _r0(c, target).standby
+        finally:
+            c.shutdown()
+
+
+class TestReplicationTorture:
+    """Satellite: crash/err at every repl_* failpoint — the operation
+    resumes (or the ship round retries) and acked rows stay exactly-once
+    readable."""
+
+    @pytest.mark.parametrize("action", ["crash", "err"])
+    def test_bootstrap_failure_resumes_or_rolls_back(self, tmp_path,
+                                                     action, request):
+        failpoint.reset()
+        request.addfinalizer(failpoint.reset)
+        c = ReplCluster(tmp_path)
+        request.addfinalizer(c.shutdown)
+        _setup_table(c, rows=20)
+        leader = _region0_owner(c)
+        target = next(i for i in c.datanodes if i != leader)
+        c.fe.do_query(f"ADMIN ADD REPLICA ha 0 TO {target}")
+        failpoint.configure("repl_bootstrap", action)
+        if action == "crash":
+            with pytest.raises(SimulatedCrash):
+                c.pump(rounds=30)
+            # the leader "died" mid-step: restart it from durable state
+            failpoint.configure("repl_bootstrap", "off")
+            c.hard_kill(leader)
+            c.restart_datanode(leader)
+            assert c.pump(rounds=40), c.srv.balancer.ops()
+            assert c.srv.balancer.done_ops()[-1]["state"] == "done"
+        else:
+            # err: the step fails its ack; the pre-commit op rolls back
+            c.pump(rounds=30)
+            final = c.srv.balancer.done_ops()[-1]
+            failpoint.configure("repl_bootstrap", "off")
+            if final["state"] == "failed":
+                # rollback left no follower; a retry succeeds
+                rr0 = next(r for r in
+                           c.srv.table_route(FULL).region_routes
+                           if r.region_number == 0)
+                assert not rr0.followers
+                c.fe.do_query(f"ADMIN ADD REPLICA ha 0 TO {target}")
+                assert c.pump(rounds=40)
+                assert c.srv.balancer.done_ops()[-1]["state"] == "done"
+        rr0 = next(r for r in c.srv.table_route(FULL).region_routes
+                   if r.region_number == 0)
+        assert [f.id for f in rr0.followers] == [target]
+        lead, std = _r0(c, leader), _r0(c, target)
+        c.datanodes[leader].replication.drain(lead.name)
+        std = _r0(c, target)
+        assert (std.version_control.committed_sequence ==
+                lead.version_control.committed_sequence)
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == 20
+
+    @pytest.mark.parametrize("point,action", [
+        ("repl_ship", "crash"), ("repl_ship", "err"),
+        ("repl_apply", "crash"), ("repl_apply", "err"),
+    ])
+    def test_ship_failure_reships_exactly_once(self, tmp_path, point,
+                                               action, request):
+        failpoint.reset()
+        request.addfinalizer(failpoint.reset)
+        c = ReplCluster(tmp_path)
+        request.addfinalizer(c.shutdown)
+        _setup_table(c, rows=20)
+        leader, target = _add_replica(c)
+        lead = _r0(c, leader)
+        c.datanodes[leader].replication.stop()   # ship only via drain
+        vals = ", ".join(f"('h{i % 5}', {40_000 + i}, 6.0)"
+                         for i in range(30))
+        c.fe.do_query(f"INSERT INTO ha VALUES {vals}")
+        failpoint.configure(point, action)
+        shipper = c.datanodes[leader].replication
+        if action == "crash":
+            with pytest.raises(SimulatedCrash):
+                shipper.ship_region(lead.name)
+            failpoint.configure(point, "off")
+            if point == "repl_apply":
+                # the follower died mid-apply: reopen it from its WAL +
+                # standby marker
+                c.hard_kill(target)
+                c.restart_datanode(target)
+        else:
+            if point == "repl_ship":
+                # the err fires before any follower push; the cursor
+                # must not advance
+                with pytest.raises(GreptimeError):
+                    shipper.ship_region(lead.name)
+            else:
+                # per-follower apply errors are swallowed (at-least-
+                # once: the round just doesn't advance the cursor)
+                out = shipper.ship_region(lead.name)
+                assert out["followers_ok"] == 0 and not out["advanced"]
+            failpoint.configure(point, "off")
+        shipper.drain(lead.name)
+        std = _r0(c, target)
+        assert (std.version_control.committed_sequence ==
+                lead.version_control.committed_sequence)
+        assert std.standby
+        # exactly-once on the standby: a raw (pre-dedup) scan shows
+        # every (series, ts) key at most once — a re-shipped record
+        # applied twice would show here
+        raw = std.snapshot().scan()
+        raw_keys = list(zip(raw.series_ids.tolist(), raw.ts.tolist()))
+        assert len(raw_keys) == len(set(raw_keys)), "double-applied ship"
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == 50
+
+    def test_promote_crash_retries_until_promoted(self, tmp_path,
+                                                  request):
+        """The repl_promote mail is fire-and-forget; a new leader that
+        crashes mid-promote gets the (idempotent) mail again from the
+        durable __balancer/promote/ doc."""
+        failpoint.reset()
+        request.addfinalizer(failpoint.reset)
+        c = ReplCluster(tmp_path, sync_wal=True)
+        request.addfinalizer(c.shutdown)
+        _setup_table(c, rows=20)
+        c.fe.catalog.table(CAT, SCH, "ha").flush()
+        leader, target = _add_replica(c)
+        lead = _r0(c, leader)
+        c.datanodes[leader].replication.drain(lead.name)
+        acked = set(c.scan_keys())
+        c.datanodes[leader].replication.stop()
+        for i in range(10):
+            key = ("h2", 91_000 + i)
+            c.fe.do_query(f"INSERT INTO ha VALUES ('h2', {key[1]}, 9.0)")
+            acked.add(key)
+        failpoint.configure("repl_promote", "crash")
+        moves = _fail_leader(c, leader)
+        assert moves and moves[0]["promoted"]
+        with pytest.raises(SimulatedCrash):
+            _deliver(c, target)
+        assert c.srv.kv.range(PROMOTE_PREFIX), \
+            "pending promotion doc must survive the crash"
+        failpoint.configure("repl_promote", "off")
+        # the new leader died mid-promote; reopen it, then the next
+        # failover pass re-mails the promotion
+        c.hard_kill(target)
+        c.restart_datanode(target)
+        assert _r0(c, target).standby      # still a standby after crash
+        c.srv.failover_check()
+        _deliver(c, target)
+        promoted = _r0(c, target)
+        assert not promoted.standby and not promoted.fenced
+        keys = c.scan_keys()
+        assert len(keys) == len(set(keys)), "duplicated rows"
+        assert not acked - set(keys), "lost acked rows"
+        # a confirming stat beat clears the pending doc
+        _beat_full(c, target)
+        c.srv.failover_check()
+        assert not c.srv.kv.range(PROMOTE_PREFIX)
+        # duplicate promote mail (pre-confirmation re-send) is a no-op
+        c.datanodes[target]._handle_mailbox({
+            "type": "repl_promote", "catalog": CAT, "schema": SCH,
+            "table": "ha", "region": 0, "old_leader": leader})
+        assert c.query_one("SELECT count(*) AS c FROM ha")[0] == \
+            len(acked)
+
+
+class TestStandaloneParity:
+    def test_standalone_rejects_replica_controls(self, tmp_path):
+        """Satellite: ADMIN ADD/REMOVE REPLICA and SET read_replica get
+        the same clean UnsupportedError on a standalone frontend."""
+        from greptimedb_tpu.frontend import FrontendInstance
+        dn = DatanodeInstance(DatanodeOptions(
+            data_home=str(tmp_path / "sa"),
+            register_numbers_table=False))
+        fe = FrontendInstance(dn)
+        fe.start()
+        try:
+            errors = []
+            for sql in ("ADMIN ADD REPLICA t 0 TO 2",
+                        "ADMIN REMOVE REPLICA t 0 FROM 2",
+                        "SET read_replica = 'follower'",
+                        "SET replica_max_lag_ms = 100"):
+                with pytest.raises(UnsupportedError,
+                                   match="distributed") as exc:
+                    fe.do_query(sql)
+                errors.append(exc.value)
+            # parity: every rejection is the same clean error type
+            assert {type(e) for e in errors} == {UnsupportedError}
+        finally:
+            fe.shutdown()
+
+    def test_distributed_accepts_set_read_replica(self, cluster):
+        c = cluster
+        _setup_table(c)
+        c.fe.do_query("SET read_replica = 'follower'")
+        c.fe.do_query("SET replica_max_lag_ms = 250")
+        from greptimedb_tpu.frontend.distributed import (
+            _READ_REPLICA, _REPLICA_MAX_LAG_MS)
+        assert _READ_REPLICA[0] == "follower"
+        assert _REPLICA_MAX_LAG_MS[0] == 250
+        with pytest.raises(InvalidArgumentsError):
+            c.fe.do_query("SET read_replica = 'sideways'")
+        c.fe.do_query("SET read_replica = 'leader'")
+        c.fe.do_query("SET replica_max_lag_ms = 5000")
